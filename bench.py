@@ -1,0 +1,65 @@
+"""Benchmark entry — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current benchmark: flagship training-step throughput on the available chip.
+Baseline: reference ResNet-50 CPU training 84.08 img/s (2x Xeon 6148,
+MKL-DNN, bs 256 — BASELINE.md); upgraded to the ResNet-50 model as the
+model zoo lands.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_RESNET50_IMG_S = 84.08
+
+
+def main():
+    import jax
+
+    from __graft_entry__ import _build_mlp, _init_states
+    from paddle_tpu.core.executor import program_to_fn
+
+    batch = 512
+    main_p, startup, avg = _build_mlp(hidden=1024, classes=1000,
+                                      features=784)
+    fn = program_to_fn(main_p, ["x", "y"], [avg.name])
+    states = _init_states(startup, fn.state_in_names)
+    states = {k: jax.device_put(v) for k, v in states.items()}
+    key = jax.random.key(0)
+
+    @jax.jit
+    def step(feeds, states):
+        fetches, new_states = fn(feeds, states, key)
+        return fetches[avg.name], new_states
+
+    feeds = {
+        "x": jax.device_put(
+            np.random.rand(batch, 784).astype(np.float32)),
+        "y": jax.device_put(
+            np.random.randint(0, 1000, (batch, 1)).astype(np.int32)),
+    }
+    # warmup/compile
+    loss, states = step(feeds, states)
+    loss.block_until_ready()
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, states = step(feeds, states)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    samples_per_sec = iters * batch / dt
+    print(json.dumps({
+        "metric": "mlp_train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / BASELINE_RESNET50_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of early-2018 PaddlePaddle "Fluid" (reference: /root/reference).
+
+Architecture (see /root/repo/SURVEY.md for the reference map):
+  * Program/Block/Op/Var IR built by a Python DSL (core/framework.py)
+  * dual executor: op-by-op interpreter for debugging + whole-block XLA
+    compilation with an executable cache (core/executor.py)
+  * autodiff by op-desc rewriting with generic-VJP grad ops (backward.py)
+  * op corpus lowered to jax/lax; conv/matmul ride the MXU, collectives
+    ride ICI via the parallel package
+"""
+from . import initializer, layers, nets, regularizer  # noqa: F401
+from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Executor,
+    LoDTensor,
+    Program,
+    Scope,
+    SelectedRows,
+    TPUPlace,
+    Variable,
+    create_lod_tensor,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+)
+from . import optimizer  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    DecayedAdagrad,
+    Ftrl,
+    Momentum,
+    RMSProp,
+)
+from .data_feeder import DataFeeder  # noqa: F401
+
+__version__ = "0.1.0"

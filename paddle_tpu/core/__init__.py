@@ -1,0 +1,20 @@
+from . import registry  # noqa: F401
+from .executor import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Executor,
+    TPUPlace,
+    global_scope,
+)
+from .framework import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .lod import LoDTensor, SelectedRows, TensorArray, create_lod_tensor  # noqa: F401
+from .scope import Scope  # noqa: F401

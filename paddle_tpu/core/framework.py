@@ -1,0 +1,494 @@
+"""Program / Block / Operator / Variable graph IR.
+
+TPU-native re-design of the reference's ProgramDesc machinery:
+  * proto schema: /root/reference/paddle/fluid/framework/framework.proto:34-152
+  * python builders: /root/reference/python/paddle/v2/fluid/framework.py
+    (Variable :127, Operator :362, Block :630, Program :827, Parameter :988)
+
+The IR is Python-native (dataclass-ish objects, serializable to plain dicts /
+JSON) rather than protobuf: there is no C++ executor on the other side of a
+pybind boundary — the executable artifact is an XLA computation produced by
+tracing a Block (core/compiler.py), so the IR only needs to be cheap to build,
+clone, rewrite (backward/transpilers) and hash (compile cache keys).
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import registry
+from .types import VarType, canonical_dtype
+
+__all__ = [
+    "Variable",
+    "Parameter",
+    "Operator",
+    "Block",
+    "Program",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "switch_main_program",
+    "switch_startup_program",
+    "unique_name",
+    "grad_var_name",
+]
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# unique names
+# ---------------------------------------------------------------------------
+
+_name_counters: Dict[str, int] = {}
+
+
+def unique_name(prefix: str) -> str:
+    idx = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = idx + 1
+    return f"{prefix}_{idx}"
+
+
+def reset_unique_names():
+    _name_counters.clear()
+
+
+# ---------------------------------------------------------------------------
+# Variable
+# ---------------------------------------------------------------------------
+
+
+class Variable:
+    """A named slot in a Block (reference framework.py:127).
+
+    `shape` may contain -1 (batch / data-dependent dims); concrete shapes are
+    only fixed when the executor binds real arrays.
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        name: str,
+        shape: Optional[Sequence[int]] = None,
+        dtype: str = "float32",
+        lod_level: int = 0,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        type: str = VarType.LOD_TENSOR,
+        initializer=None,
+    ):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = canonical_dtype(dtype) if dtype is not None else None
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.type = type
+        self.initializer = initializer
+        # op that produced this var most recently (set by append_op)
+        self.op: Optional["Operator"] = None
+
+    # -- sugar used by layers ------------------------------------------------
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def astype(self, dtype):
+        from .. import layers
+
+        return layers.cast(self, dtype)
+
+    def __add__(self, other):
+        return _elementwise(self, other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _elementwise(self, other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        from .. import layers
+
+        # scalar - x == scale(x, -1) + scalar
+        return layers.scale(self, scale=-1.0, bias=float(other))
+
+    def __mul__(self, other):
+        return _elementwise(self, other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _elementwise(self, other, "elementwise_div")
+
+    def __rtruediv__(self, other):
+        from .. import layers
+
+        # scalar / x == scalar * reciprocal(x)
+        return layers.scale(layers.reciprocal(self), scale=float(other))
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "lod_level": self.lod_level,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "type": self.type,
+            "is_parameter": isinstance(self, Parameter),
+            "trainable": getattr(self, "trainable", None),
+        }
+
+    def __repr__(self):
+        return (
+            f"Var({self.name}, shape={self.shape}, dtype={self.dtype}, "
+            f"type={self.type}{', persistable' if self.persistable else ''})"
+        )
+
+
+class Parameter(Variable):
+    """A persistable, trainable Variable (reference framework.py:988)."""
+
+    def __init__(self, block, name, shape, dtype, **kw):
+        self.trainable = kw.pop("trainable", True)
+        self.optimize_attr = kw.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kw.pop("regularizer", None)
+        self.gradient_clip_attr = kw.pop("gradient_clip_attr", None)
+        self.do_model_average = kw.pop("do_model_average", None)
+        super().__init__(
+            block, name, shape=shape, dtype=dtype, persistable=True, **kw
+        )
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+
+def _as_name_list(v) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [x.name if isinstance(x, Variable) else str(x) for x in v]
+    return [v.name if isinstance(v, Variable) else str(v)]
+
+
+class Operator:
+    """An op desc: type + named input/output var lists + attrs.
+
+    Reference framework.py:362 / framework.proto:104 (OpDesc).  Attrs may hold
+    python scalars, lists, strings, numpy arrays, or Block indices (for
+    control-flow sub-blocks, stored as {"__block__": idx}).
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = {
+            k: _as_name_list(v) for k, v in (inputs or {}).items()
+        }
+        self.outputs: Dict[str, List[str]] = {
+            k: _as_name_list(v) for k, v in (outputs or {}).items()
+        }
+        self.attrs: Dict = dict(attrs or {})
+
+    def input_names(self) -> List[str]:
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self) -> List[str]:
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def input(self, slot) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    def sub_block(self, attr_name="sub_block") -> Optional["Block"]:
+        ref = self.attrs.get(attr_name)
+        if ref is None:
+            return None
+        idx = ref["__block__"] if isinstance(ref, dict) else int(ref)
+        return self.block.program.blocks[idx]
+
+    def to_dict(self):
+        def enc_attr(v):
+            if isinstance(v, np.ndarray):
+                return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            return v
+
+        return {
+            "type": self.type,
+            "inputs": self.inputs,
+            "outputs": self.outputs,
+            "attrs": {k: enc_attr(v) for k, v in self.attrs.items()},
+        }
+
+    def __repr__(self):
+        ins = ", ".join(f"{k}={v}" for k, v in self.inputs.items())
+        outs = ", ".join(f"{k}={v}" for k, v in self.outputs.items())
+        return f"{{{self.type}: ({ins}) -> ({outs})}}"
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+
+class Block:
+    """A straight-line list of ops + a var table (reference framework.py:630)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    # -- vars ---------------------------------------------------------------
+    def create_var(self, name=None, **kw) -> Variable:
+        if name is None:
+            name = unique_name("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name, **kw)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype, **kw) -> Parameter:
+        p = Parameter(self, name, shape, dtype, **kw)
+        self.vars[name] = p
+        return p
+
+    def var(self, name: str) -> Variable:
+        """Find var in this block or ancestors (scope-style lookup)."""
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent
+        raise KeyError(f"variable '{name}' not found in block {self.idx}")
+
+    def has_var(self, name: str) -> bool:
+        try:
+            self.var(name)
+            return True
+        except KeyError:
+            return False
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- ops ----------------------------------------------------------------
+    def append_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self._post_insert(op)
+        return op
+
+    def prepend_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        self._post_insert(op)
+        return op
+
+    def insert_op(self, index, type, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)
+        self._post_insert(op)
+        return op
+
+    def _post_insert(self, op: Operator):
+        self.program.bump_version()
+        # auto-create missing output vars (backward/transpiler convenience)
+        for n in op.output_names():
+            if n not in ("", "@EMPTY@") and not self.has_var(n):
+                self.create_var(name=n, dtype=None)
+        # record producer + run build-time shape inference when available
+        info = None
+        try:
+            info = registry.get_op_info(op.type)
+        except KeyError:
+            pass
+        if info is not None:
+            from . import shape_inference
+
+            try:
+                if info.infer_shape is not None and info.type == op.type:
+                    info.infer_shape(op, self)
+                elif op.type.endswith("_grad"):
+                    shape_inference.infer_grad_shapes(op, self)
+                else:
+                    shape_inference.default_infer_shape(op, self)
+            except KeyError:
+                pass  # vars created later (e.g. grad rewrites fill them in)
+        for n in op.output_names():
+            if n in self.vars:
+                self.vars[n].op = op
+
+    def to_dict(self):
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [o.to_dict() for o in self.ops],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Program
+# ---------------------------------------------------------------------------
+
+
+class Program:
+    """A list of Blocks; block 0 is global (reference framework.py:827)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self._current_block_idx = 0
+        self.seed = 0  # program-level RNG seed (0 = derive from executor)
+        self._version = 0  # bumped on mutation -> invalidates compile cache
+
+    # -- block management ---------------------------------------------------
+    @property
+    def current_block(self) -> Block:
+        return self.blocks[self._current_block_idx]
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def create_block(self, parent_idx=None) -> Block:
+        parent_idx = (
+            self._current_block_idx if parent_idx is None else parent_idx
+        )
+        b = Block(self, len(self.blocks), parent_idx)
+        self.blocks.append(b)
+        self._current_block_idx = b.idx
+        return b
+
+    def rollback(self):
+        self._current_block_idx = self.current_block.parent_idx
+
+    @contextlib.contextmanager
+    def block_guard(self, block: Block):
+        prev = self._current_block_idx
+        self._current_block_idx = block.idx
+        try:
+            yield block
+        finally:
+            self._current_block_idx = prev
+
+    # -- mutation tracking ---------------------------------------------------
+    def bump_version(self):
+        self._version += 1
+
+    def fingerprint(self) -> str:
+        """Stable hash of the whole program for compile-cache keys."""
+        payload = json.dumps(self.to_dict(), sort_keys=True, default=str)
+        import hashlib
+
+        return hashlib.sha1(payload.encode()).hexdigest()
+
+    # -- clone / serialization ----------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-copy the program.  `for_test=True` flips is_test attrs
+        (dropout/batch_norm switch to inference behavior), mirroring
+        reference framework.py Program.clone."""
+        p = copy.deepcopy(self)
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "is_test" in _op_declared_attrs(op.type):
+                        op.attrs["is_test"] = True
+        p.bump_version()
+        return p
+
+    def to_dict(self):
+        return {"blocks": [b.to_dict() for b in self.blocks], "seed": self.seed}
+
+    def __repr__(self):
+        lines = []
+        for b in self.blocks:
+            lines.append(f"-- block {b.idx} (parent {b.parent_idx}) --")
+            lines.extend(f"  {op}" for op in b.ops)
+        return "\n".join(lines)
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+
+def _op_declared_attrs(type):
+    try:
+        return registry.get_op_info(type).attrs
+    except KeyError:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards (reference framework.py:1046-1120)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    prev, _main_program = _main_program, p
+    return prev
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    prev, _startup_program = _startup_program, p
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    prev_main = switch_main_program(main_program)
+    prev_startup = None
+    if startup_program is not None:
+        prev_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_startup is not None:
+            switch_startup_program(prev_startup)
+
+
+def _elementwise(x: Variable, y, op_type: str) -> Variable:
+    from .. import layers
+
+    if not isinstance(y, Variable):
+        y = layers.fill_constant(
+            shape=[1], dtype=x.dtype, value=float(y)
+        )
+    fn = getattr(layers, op_type)
+    return fn(x, y)

@@ -1,0 +1,128 @@
+"""LoDTensor / SelectedRows / TensorArray runtime values.
+
+Reference:
+  * LoDTensor — /root/reference/paddle/fluid/framework/lod_tensor.h (+ design
+    note lod_tensor.md): a dense tensor whose rows pack variable-length,
+    possibly nested sequences, with a level-of-detail offset table instead of
+    padding.
+  * SelectedRows — framework/selected_rows.h:1-60: sparse row-slice gradient
+    representation (embedding grads).
+  * LoDTensorArray — used by dynamic-RNN / beam-search machinery.
+
+TPU mapping: the *API* keeps LoD semantics (flat concatenated rows + offset
+table, "no padding"); sequence ops lower to dense+segment-id/mask XLA code.
+The offset table is host-side metadata (a tuple of python int tuples) — it is
+part of the compile-cache key, so each length-bucket compiles once (the
+bucketing discipline replaces the reference's per-batch dynamic shapes).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+class LoDTensor:
+    """data: jax/numpy array whose dim-0 is the packed row axis; lod: nested
+    offset tables, outermost level first, e.g. [[0, 2, 5]] packs two sequences
+    of lengths 2 and 3."""
+
+    __slots__ = ("data", "lod")
+
+    def __init__(self, data, lod: Sequence[Sequence[int]] = ()):
+        self.data = data
+        self.lod: Tuple[Tuple[int, ...], ...] = tuple(
+            tuple(int(x) for x in level) for level in lod
+        )
+
+    # -- helpers -------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def seq_lens(self, level: int = 0) -> List[int]:
+        offs = self.lod[level]
+        return [offs[i + 1] - offs[i] for i in range(len(offs) - 1)]
+
+    def num_sequences(self, level: int = 0) -> int:
+        return len(self.lod[level]) - 1 if self.lod else self.data.shape[0]
+
+    def recursive_seq_lens(self):
+        return [self.seq_lens(i) for i in range(len(self.lod))]
+
+    def segment_ids(self, level: int = 0) -> np.ndarray:
+        """Row -> sequence index map for segment-sum style lowering."""
+        offs = self.lod[level]
+        out = np.zeros(offs[-1], dtype=np.int32)
+        for i in range(len(offs) - 1):
+            out[offs[i] : offs[i + 1]] = i
+        return out
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.shape}, lod={self.lod})"
+
+
+def lod_from_seq_lens(seq_lens: Sequence[int]) -> Tuple[int, ...]:
+    offs = [0]
+    for n in seq_lens:
+        offs.append(offs[-1] + int(n))
+    return tuple(offs)
+
+
+def create_lod_tensor(data, recursive_seq_lens=(), place=None) -> LoDTensor:
+    """Build a LoDTensor from flat data + per-level sequence lengths
+    (mirrors reference fluid.create_lod_tensor)."""
+    lod = [lod_from_seq_lens(lv) for lv in recursive_seq_lens]
+    return LoDTensor(np.asarray(data), lod)
+
+
+class SelectedRows:
+    """Sparse row slices: `rows[i]` is the row index into the dense var of
+    height `height`; `value[i]` is that row's data.  Duplicate rows allowed
+    (summed on materialization), matching reference semantics."""
+
+    __slots__ = ("rows", "value", "height")
+
+    def __init__(self, rows, value, height: int):
+        self.rows = rows  # int array [n]
+        self.value = value  # [n, ...] array
+        self.height = int(height)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        dense_shape = (self.height,) + tuple(self.value.shape[1:])
+        out = jnp.zeros(dense_shape, self.value.dtype)
+        return out.at[self.rows].add(self.value)
+
+    def __repr__(self):
+        return (
+            f"SelectedRows(height={self.height}, n={len(self.rows)}, "
+            f"row_dim={tuple(self.value.shape[1:])})"
+        )
+
+
+class TensorArray:
+    """LoDTensorArray: ordered list of tensors (dynamic RNN outputs,
+    beam-search trajectories)."""
+
+    __slots__ = ("tensors",)
+
+    def __init__(self, tensors=None):
+        self.tensors: List = list(tensors or [])
+
+    def append(self, t):
+        self.tensors.append(t)
+
+    def __len__(self):
+        return len(self.tensors)
+
+    def __getitem__(self, i):
+        return self.tensors[i]
+
+    def __repr__(self):
+        return f"TensorArray(len={len(self.tensors)})"

@@ -1,0 +1,128 @@
+"""Operator registry: the TPU-native answer to the reference's OpRegistry.
+
+Reference: /root/reference/paddle/fluid/framework/op_registry.h:127-241
+(`REGISTER_OP*` macros) and op_info.h:34 (`OpInfo{grad_op_maker_, infer_shape_}`).
+
+Instead of per-(place,dtype,layout,library) kernel pairs dispatched at runtime
+(operator.cc:494 RunImpl), every op registers ONE `lower` function expressed in
+jax.numpy / lax.  That single definition serves as:
+  * the CPU interpreter kernel (eager execution, debuggable), and
+  * the XLA lowering used when a whole Block is traced and jit-compiled
+    (core/compiler.py) — kernel fusion, tiling and layout are left to XLA,
+    which is the TPU replacement for the hand-written CUDA kernel corpus.
+
+Gradients: ops may register an explicit `grad_maker` (emitting grad-op descs
+like the reference's GradOpMaker), but the default is a *generic VJP grad*:
+a `<type>_grad` op whose lowering calls `jax.vjp` on the forward lowering.
+XLA CSE dedupes the re-traced forward, so this costs nothing after fusion and
+guarantees analytic gradients exactly consistent with the forward op.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class OpInfo:
+    type: str
+    # lower(ctx, ins, attrs) -> {output_slot: [values]}
+    lower: Callable = None
+    # infer_shape(op, block) -> None ; fills output VarDesc shapes at build time
+    infer_shape: Callable = None
+    # grad_maker(op, block, no_grad_set) -> list[OpSpec dicts] ; None = generic
+    grad_maker: Callable = None
+    # input slots that are differentiable (None = all float inputs)
+    diff_inputs: Optional[Sequence[str]] = None
+    # output slots that are differentiable (None = all)
+    diff_outputs: Optional[Sequence[str]] = None
+    # declared slot names (for validation / layer autogen); duplicable slots
+    # accept a list of vars
+    inputs: Sequence[str] = ()
+    outputs: Sequence[str] = ()
+    # attr defaults
+    attrs: Dict = dataclasses.field(default_factory=dict)
+    # in-place aliases {output_slot: input_slot} (optimizer ops: ParamOut<-Param)
+    inplace: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # True if op is stateful/random (needs a PRNG key via ctx)
+    random: bool = False
+    # True -> never differentiate through (metrics, optimizer ops)
+    not_differentiable: bool = False
+    # True -> must run on host (save/load, print, readers); forces the
+    # executor to interpret rather than trace the enclosing block segment
+    host: bool = False
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def register_op(
+    type: str,
+    inputs: Sequence[str] = (),
+    outputs: Sequence[str] = (),
+    attrs: Dict = None,
+    diff_inputs: Optional[Sequence[str]] = None,
+    diff_outputs: Optional[Sequence[str]] = None,
+    inplace: Dict[str, str] = None,
+    random: bool = False,
+    not_differentiable: bool = False,
+    host: bool = False,
+):
+    """Decorator: register `fn` as the lowering for op `type`."""
+
+    def deco(fn):
+        info = _REGISTRY.get(type) or OpInfo(type=type)
+        info.lower = fn
+        info.inputs = tuple(inputs)
+        info.outputs = tuple(outputs)
+        info.attrs = dict(attrs or {})
+        info.diff_inputs = diff_inputs
+        info.diff_outputs = diff_outputs
+        info.inplace = dict(inplace or {})
+        info.random = random
+        info.not_differentiable = not_differentiable
+        info.host = host
+        _REGISTRY[type] = info
+        return fn
+
+    return deco
+
+
+def register_infer_shape(type: str):
+    def deco(fn):
+        info = _REGISTRY.setdefault(type, OpInfo(type=type))
+        info.infer_shape = fn
+        return fn
+
+    return deco
+
+
+def register_grad_maker(type: str):
+    def deco(fn):
+        info = _REGISTRY.setdefault(type, OpInfo(type=type))
+        info.grad_maker = fn
+        return fn
+
+    return deco
+
+
+def get_op_info(type: str) -> OpInfo:
+    info = _REGISTRY.get(type)
+    if info is None or info.lower is None:
+        # grad ops resolve generically: "<fwd>_grad" with no explicit lowering
+        if type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY:
+            return _REGISTRY[type[: -len("_grad")]]
+        raise KeyError(f"op '{type}' is not registered")
+    return info
+
+
+def has_op(type: str) -> bool:
+    try:
+        get_op_info(type)
+        return True
+    except KeyError:
+        return False
+
+
+def registered_ops() -> List[str]:
+    return sorted(t for t, i in _REGISTRY.items() if i.lower is not None)

@@ -1,0 +1,86 @@
+"""Build-time shape inference.
+
+The reference implements a per-op `InferShape` twice (compile-time and
+runtime contexts, /root/reference/paddle/fluid/framework/shape_inference.h,
+operator.cc:330-493).  Here a single default covers most ops: abstractly
+evaluate the op's jax lowering with `jax.eval_shape`, substituting a sentinel
+size for unknown (-1) dims and mapping it back afterwards.  Ops whose shapes
+depend on runtime metadata (LoD, rows) register explicit infer functions via
+`registry.register_infer_shape`.
+"""
+from __future__ import annotations
+
+import jax
+
+from . import registry
+from .execution import ExecContext
+from .types import np_dtype
+
+# sentinel for unknown dims; any output dim equal to a multiple/exact match is
+# mapped back to -1.  Chosen large & prime so arithmetic collisions are rare.
+_SENTINEL = 8191
+
+_failed_ops = set()  # op types whose default inference failed (debug aid)
+
+
+def default_infer_shape(op, block):
+    info = registry.get_op_info(op.type)
+    if info.type != op.type:
+        return  # generic grad op: grads share forward shapes, handled below
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n in ("", "@EMPTY@"):
+                vals.append(None)
+                continue
+            v = block.var(n)
+            if v.shape is None or v.dtype is None:
+                return
+            shape = tuple(_SENTINEL if d < 0 else d for d in v.shape)
+            vals.append(jax.ShapeDtypeStruct(shape, np_dtype(v.dtype)))
+        ins[slot] = vals
+    attrs = {**info.attrs, **op.attrs}
+    ctx = ExecContext(jax.random.key(0))
+    try:
+        outs = jax.eval_shape(lambda i: info.lower(ctx, i, attrs), ins)
+    except Exception:
+        _failed_ops.add(op.type)
+        return
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for name, aval in zip(names, vals):
+            if name in ("", "@EMPTY@") or aval is None:
+                continue
+            leaves = jax.tree_util.tree_leaves(aval)
+            if len(leaves) != 1:
+                continue
+            aval = leaves[0]
+            var = block.vars.get(name)
+            if var is None:
+                continue
+            var.shape = tuple(
+                -1 if d == _SENTINEL else int(d) for d in aval.shape
+            )
+            from .types import canonical_dtype
+
+            var.dtype = canonical_dtype(aval.dtype)
+
+
+def infer_grad_shapes(op, block):
+    """'<x>@GRAD' vars mirror their forward var's shape/dtype."""
+    from .framework import GRAD_SUFFIX
+
+    for name in op.output_names():
+        if name.endswith(GRAD_SUFFIX):
+            fwd = name[: -len(GRAD_SUFFIX)]
+            var = block.vars.get(name)
+            if var is not None and block.has_var(fwd):
+                fv = block.var(fwd)
+                var.shape = fv.shape
+                if var.dtype is None:
+                    var.dtype = fv.dtype

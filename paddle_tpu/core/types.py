@@ -1,0 +1,76 @@
+"""Core dtype / variable-type vocabulary.
+
+TPU-native re-design of the reference's proto enums
+(/root/reference/paddle/fluid/framework/framework.proto:91-117 VarDesc.VarType,
+:142 LoDTensorDesc).  Dtypes are plain strings mapped onto numpy/jax dtypes;
+variable "types" describe what a Variable holds at runtime.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+_DTYPES = {
+    "float16": np.float16,
+    "bfloat16": None,  # filled lazily from ml_dtypes to avoid hard import
+    "float32": np.float32,
+    "float64": np.float64,
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64")
+
+
+def np_dtype(name):
+    """Canonical name -> numpy dtype (bfloat16 via ml_dtypes)."""
+    name = canonical_dtype(name)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_DTYPES[name])
+
+
+def canonical_dtype(d) -> str:
+    """Accept strings / numpy dtypes / jax arrays' dtypes -> canonical name."""
+    if isinstance(d, str):
+        if d in _DTYPES:
+            return d
+        # allow numpy-style names like '<f4'
+        return np.dtype(d).name
+    name = np.dtype(d).name
+    if name == "bfloat16":
+        return "bfloat16"
+    if name not in _DTYPES:
+        raise ValueError(f"unsupported dtype {d!r}")
+    return name
+
+
+def is_float_dtype(d) -> bool:
+    return canonical_dtype(d) in FLOAT_DTYPES
+
+
+# ---------------------------------------------------------------------------
+# variable types (what a Variable holds)
+# ---------------------------------------------------------------------------
+
+
+class VarType:
+    LOD_TENSOR = "lod_tensor"          # dense tensor (+ optional LoD)
+    SELECTED_ROWS = "selected_rows"    # sparse row-slices (embedding grads)
+    LOD_TENSOR_ARRAY = "tensor_array"  # list of tensors (dynamic RNN states)
+    LOD_RANK_TABLE = "lod_rank_table"  # sequence-length sort table
+    STEP_SCOPES = "step_scopes"        # control-flow local scopes
+    READER = "reader"                  # data pipeline handle
+    RAW = "raw"                        # opaque python object
+    FEED_MINIBATCH = "feed_minibatch"
+    FETCH_LIST = "fetch_list"

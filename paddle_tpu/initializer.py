@@ -1,0 +1,115 @@
+"""Parameter initializers — append init ops to the startup program.
+
+Reference: /root/reference/python/paddle/v2/fluid/initializer.py:1-437
+(Constant/Uniform/Normal/Xavier/MSRA, each emitting fill_constant /
+uniform_random / gaussian_random ops into the startup block).
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "Constant",
+    "Uniform",
+    "Normal",
+    "Xavier",
+    "MSRA",
+    "ConstantInitializer",
+    "UniformInitializer",
+    "NormalInitializer",
+    "XavierInitializer",
+    "MSRAInitializer",
+]
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0):
+        self.value = float(value)
+
+    def __call__(self, var, block):
+        block.append_op(
+            "fill_constant", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype,
+             "value": self.value})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        block.append_op(
+            "uniform_random", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype,
+             "min": self.low, "max": self.high, "seed": self.seed})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op(
+            "gaussian_random", {}, {"Out": [var.name]},
+            {"shape": list(var.shape), "dtype": var.dtype,
+             "mean": self.loc, "std": self.scale, "seed": self.seed})
+
+
+def _fan_in_out(var):
+    """Reference initializer.py _compute_fans: for conv filters
+    [out_c, in_c, k...] fan_in = in_c*prod(k), fan_out = out_c*prod(k)."""
+    shape = var.shape
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    recep = 1
+    for d in shape[2:]:
+        recep *= d
+    return shape[1] * recep, shape[0] * recep
+
+
+class XavierInitializer(Initializer):
+    """Glorot init (reference initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out = uniform, fan_in, fan_out
+        self.seed = seed
+
+    def __call__(self, var, block):
+        fi, fo = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """He init (reference initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / fi)
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer

@@ -1,0 +1,6 @@
+"""Layer DSL — fluid.layers equivalent surface."""
+from .. import ops as _ops  # noqa: F401  (registers op lowerings)
+from .io import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403

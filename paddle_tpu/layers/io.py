@@ -1,0 +1,28 @@
+"""Data-declaration layer.
+
+Reference: /root/reference/python/paddle/v2/fluid/layers/io.py (`data()`).
+"""
+from __future__ import annotations
+
+from ..core.framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         main_program=None, stop_gradient=True, type=None):
+    """Declare a feed variable.  `append_batch_size=True` prepends -1,
+    matching reference layers/io.py:data."""
+    prog = main_program or default_main_program()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    kw = {}
+    if type is not None:
+        kw["type"] = type
+    v = prog.global_block().create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, **kw)
+    # mirror the var desc into the startup program for symmetry
+    default_startup_program()
+    return v

@@ -1,0 +1,498 @@
+"""Rich NN layers.
+
+Reference: /root/reference/python/paddle/v2/fluid/layers/nn.py (fc :74,
+embedding :195, conv2d :1137, batch_norm :1482, layer_norm :1570,
+matmul :2388, softmax_with_cross_entropy :3098, …).
+"""
+from __future__ import annotations
+
+from ..core.framework import Variable
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc",
+    "embedding",
+    "dropout",
+    "cross_entropy",
+    "square_error_cost",
+    "accuracy",
+    "chunk_eval",
+    "conv2d",
+    "conv2d_transpose",
+    "pool2d",
+    "batch_norm",
+    "layer_norm",
+    "lrn",
+    "mean",
+    "mul",
+    "matmul",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "topk",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "split",
+    "l2_normalize",
+    "one_hot",
+    "autoincreased_step_counter",
+    "smooth_l1",
+]
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None, main_program=None, startup_program=None,
+       is_test=False, use_mkldnn=False):
+    """Fully-connected: mul per input + sum + bias + act
+    (reference layers/nn.py:74)."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = helper.input_dtype()
+    mul_results = []
+    for input_var in helper.multiple_input():
+        input_shape = input_var.shape
+        param_shape = [
+            abs(int(__import__("numpy").prod(
+                input_shape[num_flatten_dims:])))
+        ] + [size]
+        w = helper.create_parameter(param_attr, param_shape, dtype,
+                                    suffix="w")
+        tmp = helper.create_tmp_variable(dtype)
+        helper.append_op(
+            "mul", {"X": [input_var.name], "Y": [w.name]},
+            {"Out": [tmp.name]},
+            {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(dtype)
+        helper.append_op("sum", {"X": [v.name for v in mul_results]},
+                         {"Out": [pre_bias.name]})
+    pre_act = helper.append_bias_op(pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """Lookup-table layer (reference layers/nn.py:195).  `is_sparse=True`
+    makes the gradient a SelectedRows (lookup_table_op.cc:114-131
+    VarTypeInference analogue)."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(param_attr, size, dtype, suffix="w")
+    tmp = helper.create_tmp_variable(dtype)
+    tmp.lod_level = input.lod_level
+    helper.append_op(
+        "lookup_table", {"Ids": [input.name], "W": [w.name]},
+        {"Out": [tmp.name]},
+        {"is_sparse": bool(is_sparse),
+         "padding_idx": -1 if padding_idx is None else int(padding_idx)})
+    return tmp
+
+
+def dropout(x, dropout_prob, is_test=False, seed=0, name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    mask = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("dropout", {"X": [x.name]},
+                     {"Out": [out.name], "Mask": [mask.name]},
+                     {"dropout_prob": float(dropout_prob),
+                      "is_test": is_test, "seed": seed,
+                      "fix_seed": seed != 0})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("cross_entropy",
+                     {"X": [input.name], "Label": [label.name]},
+                     {"Y": [out.name]}, {"soft_label": soft_label})
+    return out
+
+
+def square_error_cost(input, label):
+    """(input - label)^2, reference layers/nn.py square_error_cost."""
+    helper = LayerHelper("square_error_cost")
+    minus_out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("elementwise_sub",
+                     {"X": [input.name], "Y": [label.name]},
+                     {"Out": [minus_out.name]}, {"axis": -1})
+    square_out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("square", {"X": [minus_out.name]},
+                     {"Out": [square_out.name]})
+    return square_out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """top-k accuracy metric built from top_k + accuracy ops
+    (reference layers/nn.py accuracy)."""
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    topk_indices = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op("top_k", {"X": [input.name]},
+                     {"Out": [topk_out.name],
+                      "Indices": [topk_indices.name]}, {"k": k})
+    acc_out = helper.create_tmp_variable("float32", stop_gradient=True)
+    correct = correct or helper.create_tmp_variable("int32",
+                                                    stop_gradient=True)
+    total = total or helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op(
+        "accuracy",
+        {"Out": [topk_out.name], "Indices": [topk_indices.name],
+         "Label": [label.name]},
+        {"Accuracy": [acc_out.name], "Correct": [correct.name],
+         "Total": [total.name]})
+    return acc_out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_tmp_variable("float32", stop_gradient=True)
+    recall = helper.create_tmp_variable("float32", stop_gradient=True)
+    f1 = helper.create_tmp_variable("float32", stop_gradient=True)
+    n_infer = helper.create_tmp_variable("int64", stop_gradient=True)
+    n_label = helper.create_tmp_variable("int64", stop_gradient=True)
+    n_correct = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op(
+        "chunk_eval",
+        {"Inference": [input.name], "Label": [label.name]},
+        {"Precision": [precision.name], "Recall": [recall.name],
+         "F1-Score": [f1.name], "NumInferChunks": [n_infer.name],
+         "NumLabelChunks": [n_label.name],
+         "NumCorrectChunks": [n_correct.name]},
+        {"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types,
+         "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_infer, n_label, n_correct
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, act=None,
+           name=None, use_cudnn=True, main_program=None,
+           startup_program=None):
+    helper = LayerHelper("conv2d", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = ([dilation, dilation] if isinstance(dilation, int)
+                else list(dilation))
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+    import math
+
+    fan_in = num_channels * filter_size[0] * filter_size[1]
+    std = math.sqrt(2.0 / fan_in)
+    from ..initializer import NormalInitializer
+
+    w = helper.create_parameter(param_attr, filter_shape, dtype,
+                                default_initializer=NormalInitializer(
+                                    0.0, std),
+                                suffix="w")
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        "conv2d", {"Input": [input.name], "Filter": [w.name]},
+        {"Output": [pre_bias.name]},
+        {"strides": stride, "paddings": padding, "dilations": dilation,
+         "groups": groups, "use_cudnn": use_cudnn})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    if filter_size is None:
+        h = input.shape[2]
+        out_h = output_size[0] if isinstance(output_size, (list, tuple)) \
+            else output_size
+        filter_size = [out_h - (h - 1) * stride[0] + 2 * padding[0]] * 2
+    elif isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    filter_shape = [num_channels, num_filters] + list(filter_size)
+    w = helper.create_parameter(param_attr, filter_shape, dtype, suffix="w")
+    pre_bias = helper.create_tmp_variable(dtype)
+    dilation = ([dilation, dilation] if isinstance(dilation, int)
+                else list(dilation))
+    helper.append_op(
+        "conv2d_transpose", {"Input": [input.name], "Filter": [w.name]},
+        {"Output": [pre_bias.name]},
+        {"strides": stride, "paddings": padding, "dilations": dilation})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    if isinstance(pool_size, int):
+        pool_size = [pool_size, pool_size]
+    if isinstance(pool_stride, int):
+        pool_stride = [pool_stride, pool_stride]
+    if isinstance(pool_padding, int):
+        pool_padding = [pool_padding, pool_padding]
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "pool2d", {"X": [input.name]}, {"Out": [out.name]},
+        {"pooling_type": pool_type, "ksize": list(pool_size),
+         "strides": list(pool_stride), "paddings": list(pool_padding),
+         "global_pooling": global_pooling, "use_cudnn": use_cudnn})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None):
+    helper = LayerHelper("batch_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    c_axis = 1 if data_layout == "NCHW" else len(input.shape) - 1
+    channels = input.shape[c_axis]
+    scale = helper.create_parameter(
+        param_attr, [channels], dtype,
+        default_initializer=ConstantInitializer(1.0), suffix="scale")
+    bias = helper.create_parameter(bias_attr or {}, [channels], dtype,
+                                   is_bias=True, suffix="offset")
+    mean = helper.create_parameter(
+        {"name": moving_mean_name, "trainable": False}, [channels], dtype,
+        default_initializer=ConstantInitializer(0.0), suffix="mean")
+    variance = helper.create_parameter(
+        {"name": moving_variance_name, "trainable": False}, [channels],
+        dtype, default_initializer=ConstantInitializer(1.0), suffix="var")
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+    saved_mean = helper.create_tmp_variable(dtype, stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype, stop_gradient=True)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        "batch_norm",
+        {"X": [input.name], "Scale": [scale.name], "Bias": [bias.name],
+         "Mean": [mean.name], "Variance": [variance.name]},
+        {"Y": [out.name], "MeanOut": [mean.name],
+         "VarianceOut": [variance.name], "SavedMean": [saved_mean.name],
+         "SavedVariance": [saved_var.name]},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    import numpy as np
+
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(
+            param_attr, norm_shape, dtype,
+            default_initializer=ConstantInitializer(1.0), suffix="scale")
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(bias_attr or {}, norm_shape, dtype,
+                                    is_bias=True, suffix="shift")
+        inputs["Bias"] = [b.name]
+    out = helper.create_tmp_variable(dtype)
+    mean = helper.create_tmp_variable(dtype, stop_gradient=True)
+    var = helper.create_tmp_variable(dtype, stop_gradient=True)
+    helper.append_op("layer_norm", inputs,
+                     {"Y": [out.name], "Mean": [mean.name],
+                      "Variance": [var.name]},
+                     {"epsilon": epsilon,
+                      "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    mid = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op("lrn", {"X": [input.name]},
+                     {"Out": [out.name], "MidOut": [mid.name]},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("mean", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    helper = LayerHelper("mul")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("mul", {"X": [x.name], "Y": [y.name]},
+                     {"Out": [out.name]},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("matmul", {"X": [x.name], "Y": [y.name]},
+                     {"Out": [out.name]},
+                     {"transpose_X": transpose_x,
+                      "transpose_Y": transpose_y})
+    return out
+
+
+def _reduce(op_type, input, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    attrs = {"keep_dim": keep_dim}
+    if dim is None:
+        attrs["reduce_all"] = True
+        attrs["dim"] = [0]
+    else:
+        attrs["reduce_all"] = False
+        attrs["dim"] = dim if isinstance(dim, (list, tuple)) else [dim]
+    helper.append_op(op_type, {"X": [input.name]}, {"Out": [out.name]},
+                     attrs)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def topk(input, k=1):
+    helper = LayerHelper("top_k")
+    values = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    indices = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op("top_k", {"X": [input.name]},
+                     {"Out": [values.name], "Indices": [indices.name]},
+                     {"k": k})
+    return values, indices
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax = helper.create_tmp_variable(logits.dtype)
+    loss = helper.create_tmp_variable(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     {"Logits": [logits.name], "Label": [label.name]},
+                     {"Softmax": [softmax.name], "Loss": [loss.name]},
+                     {"soft_label": soft_label})
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     {"X": [x.name], "Label": [label.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def split(input, num_or_sections, dim=-1):
+    helper = LayerHelper("split")
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = 0
+        sections = list(num_or_sections)
+    n_out = num if num else len(sections)
+    outs = [helper.create_tmp_variable(input.dtype) for _ in range(n_out)]
+    helper.append_op("split", {"X": [input.name]},
+                     {"Out": [o.name for o in outs]},
+                     {"axis": dim, "num": num, "sections": sections})
+    return outs
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    from . import ops as _ops
+    from .tensor import fill_constant  # noqa: F401
+
+    helper = LayerHelper("l2_normalize", name=name)
+    square = _ops.square(x)
+    ssum = reduce_sum(square, dim=axis, keep_dim=True)
+    helper2 = LayerHelper("l2_normalize")
+    norm = helper2.create_tmp_variable(x.dtype)
+    helper2.append_op("sqrt", {"X": [ssum.name]}, {"Out": [norm.name]})
+    out = helper2.create_tmp_variable(x.dtype)
+    helper2.append_op("elementwise_div", {"X": [x.name], "Y": [norm.name]},
+                      {"Out": [out.name]}, {"axis": 0})
+    return out
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op("one_hot", {"X": [input.name]}, {"Out": [out.name]},
+                     {"depth": depth, "dtype": "float32"})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 step counter incremented every run
+    (reference layers/nn.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    counter = helper.main_program.global_block().create_var(
+        name=name, dtype="int64", shape=(1,), persistable=True,
+        stop_gradient=True)
+    sb = helper.startup_program.global_block()
+    if name not in sb.vars:
+        sb.create_var(name=name, dtype="int64", shape=(1,),
+                      persistable=True)
+        sb.append_op("fill_constant", {}, {"Out": [name]},
+                     {"shape": [1], "dtype": "int64",
+                      "value": float(begin - step)})
+    helper.append_op("increment", {"X": [name]}, {"Out": [name]},
+                     {"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1")
+    diff = helper.create_tmp_variable(x.dtype)
+    out = helper.create_tmp_variable(x.dtype)
+    inputs = {"X": [x.name], "Y": [y.name]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight.name]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight.name]
+    helper.append_op("smooth_l1_loss", inputs,
+                     {"Diff": [diff.name], "Out": [out.name]},
+                     {"sigma": sigma or 1.0})
+    return out

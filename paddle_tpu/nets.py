@@ -1,0 +1,86 @@
+"""Composed network patterns.
+
+Reference: /root/reference/python/paddle/v2/fluid/nets.py:1-339
+(simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention).
+"""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = [
+    "simple_img_conv_pool",
+    "img_conv_group",
+    "glu",
+    "scaled_dot_product_attention",
+    "sequence_conv_pool",
+]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, param_attr=None,
+                         pool_type="max"):
+    conv_out = layers.conv2d(input=input, num_filters=num_filters,
+                             filter_size=filter_size, param_attr=param_attr,
+                             act=act)
+    return layers.pool2d(input=conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max"):
+    tmp = input
+    if isinstance(conv_padding, int):
+        conv_padding = [conv_padding] * len(conv_num_filter)
+    if isinstance(conv_filter_size, int):
+        conv_filter_size = [conv_filter_size] * len(conv_num_filter)
+    if isinstance(conv_with_batchnorm, bool):
+        conv_with_batchnorm = [conv_with_batchnorm] * len(conv_num_filter)
+    if isinstance(conv_batchnorm_drop_rate, (float, int)):
+        conv_batchnorm_drop_rate = ([conv_batchnorm_drop_rate]
+                                    * len(conv_num_filter))
+    for i, nf in enumerate(conv_num_filter):
+        local_conv_act = None if conv_with_batchnorm[i] else conv_act
+        tmp = layers.conv2d(input=tmp, num_filters=nf,
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i],
+                            param_attr=param_attr, act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i] > 0:
+                tmp = layers.dropout(x=tmp,
+                                     dropout_prob=conv_batchnorm_drop_rate[i])
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split + sigmoid gate (reference nets.py glu)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    gate = layers.sigmoid(b)
+    return layers.elementwise_mul(a, gate)
+
+
+def scaled_dot_product_attention(queries, keys, values,
+                                 num_heads=1, dropout_rate=0.0):
+    """Composed attention (reference nets.py:162-219): matmul(Q,K^T)/sqrt(d)
+    -> softmax -> matmul with V.  Single-head, batch-major 3-D tensors."""
+    import math
+
+    scaled_q = layers.scale(queries,
+                            scale=1.0 / math.sqrt(queries.shape[-1]))
+    product = layers.matmul(scaled_q, keys, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    return layers.matmul(weights, values)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)

@@ -1,0 +1,15 @@
+"""Op corpus: importing this package registers all op lowerings."""
+from . import (  # noqa: F401
+    activation,
+    conv,
+    creation,
+    elementwise,
+    embedding,
+    loss,
+    manip,
+    matmul,
+    metrics,
+    norm,
+    optimizer_ops,
+    reduce,
+)

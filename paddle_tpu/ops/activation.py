@@ -1,0 +1,117 @@
+"""Activation ops — the full functor set of the reference's
+/root/reference/paddle/fluid/operators/activation_op.h (30 activations in one
+template file; python registry list python/paddle/v2/fluid/layers/ops.py:16-46)
+plus softmax, prelu and dropout.
+
+Gradients come from the generic VJP (core/execution.py), matching the
+reference's hand-written grad functors analytically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one, with_lod_of
+from ..core.registry import register_op
+
+
+def _unary(name, fn, attrs=None):
+    @register_op(name, inputs=("X",), outputs=("Out",), attrs=attrs or {})
+    def lower(ctx, ins, attrs, _fn=fn):
+        xv = one(ins, "X")
+        return {"Out": with_lod_of(xv, _fn(data_of(xv), attrs))}
+
+    return lower
+
+
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_unary("softshrink",
+       lambda x, a: jnp.where(x > a["lambda"], x - a["lambda"],
+                              jnp.where(x < -a["lambda"], x + a["lambda"],
+                                        jnp.zeros_like(x))),
+       attrs={"lambda": 0.5})
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("reciprocal", lambda x, a: 1.0 / x)
+_unary("log", lambda x, a: jnp.log(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_unary("brelu", lambda x, a: jnp.clip(x, a["t_min"], a["t_max"]),
+       attrs={"t_min": 0.0, "t_max": 24.0})
+_unary("leaky_relu", lambda x, a: jnp.where(x > 0, x, a["alpha"] * x),
+       attrs={"alpha": 0.02})
+_unary("soft_relu",
+       lambda x, a: jnp.log1p(jnp.exp(jnp.clip(x, -a["threshold"],
+                                               a["threshold"]))),
+       attrs={"threshold": 40.0})
+_unary("elu", lambda x, a: jnp.where(x > 0, x, a["alpha"] * jnp.expm1(x)),
+       attrs={"alpha": 1.0})
+_unary("relu6", lambda x, a: jnp.clip(x, 0.0, a["threshold"]),
+       attrs={"threshold": 6.0})
+_unary("pow", lambda x, a: jnp.power(x, a["factor"]), attrs={"factor": 1.0})
+_unary("stanh",
+       lambda x, a: a["scale_b"] * jnp.tanh(a["scale_a"] * x),
+       attrs={"scale_a": 2.0 / 3.0, "scale_b": 1.7159})
+_unary("hard_shrink",
+       lambda x, a: jnp.where(jnp.abs(x) > a["threshold"], x,
+                              jnp.zeros_like(x)),
+       attrs={"threshold": 0.5})
+_unary("thresholded_relu",
+       lambda x, a: jnp.where(x > a["threshold"], x, jnp.zeros_like(x)),
+       attrs={"threshold": 1.0})
+_unary("hard_sigmoid",
+       lambda x, a: jnp.clip(a["slope"] * x + a["offset"], 0.0, 1.0),
+       attrs={"slope": 0.2, "offset": 0.5})
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(a["beta"] * x),
+       attrs={"beta": 1.0})
+
+
+@register_op("softmax", inputs=("X",), outputs=("Out",))
+def softmax(ctx, ins, attrs):
+    """Reference softmax_op.cc: softmax over the last dim of a 2D input."""
+    xv = one(ins, "X")
+    return {"Out": with_lod_of(xv, jax.nn.softmax(data_of(xv), axis=-1))}
+
+
+@register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",))
+def prelu(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    alpha = data_of(one(ins, "Alpha")).reshape(())
+    return {"Out": with_lod_of(xv, jnp.where(x > 0, x, alpha * x))}
+
+
+@register_op("dropout", inputs=("X",), outputs=("Out", "Mask"),
+             attrs={"dropout_prob": 0.5, "is_test": False, "seed": 0,
+                    "fix_seed": False},
+             diff_inputs=("X",), diff_outputs=("Out",), random=True)
+def dropout(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    if attrs.get("is_test"):
+        keep = jnp.asarray(1.0 - attrs["dropout_prob"], x.dtype)
+        return {"Out": with_lod_of(xv, x * keep),
+                "Mask": jnp.ones_like(x)}
+    key = (jax.random.key(attrs["seed"]) if attrs.get("fix_seed")
+           else ctx.rng())
+    mask = (jax.random.uniform(key, x.shape) >= attrs["dropout_prob"])
+    mask = mask.astype(x.dtype)
+    return {"Out": with_lod_of(xv, x * mask), "Mask": mask}
+
+
+@register_op("dropout_grad", inputs=("Mask", "Out@GRAD"),
+             outputs=("X@GRAD",))
+def dropout_grad(ctx, ins, attrs):
+    """Custom grad: reuse the saved mask (generic VJP would re-sample)."""
+    mask = data_of(one(ins, "Mask"))
+    og = data_of(one(ins, "Out@GRAD"))
+    return {"X@GRAD": og * mask}

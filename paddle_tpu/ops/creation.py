@@ -1,0 +1,141 @@
+"""Tensor creation / casting / random ops.
+
+Reference kernels: /root/reference/paddle/fluid/operators/fill_constant_op.cc,
+fill_constant_batch_size_like_op.cc, fill_zeros_like_op.cc, assign_op.cc,
+assign_value_op.cc, cast_op.cc, uniform_random_op.cc, gaussian_random_op.cc,
+increment_op.cc, one_hot_op.cc, shape-less host RNG replaced by jax PRNG keys
+threaded through ExecContext (deterministic per op occurrence).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+from ..core.types import np_dtype
+
+
+@register_op("fill_constant", outputs=("Out",),
+             attrs={"shape": [1], "value": 0.0, "dtype": "float32"},
+             not_differentiable=True)
+def fill_constant(ctx, ins, attrs):
+    dt = np_dtype(attrs["dtype"])
+    return {"Out": jnp.full(tuple(attrs["shape"]), attrs["value"], dtype=dt)}
+
+
+@register_op("fill_constant_batch_size_like", inputs=("Input",),
+             outputs=("Out",),
+             attrs={"shape": [1], "value": 0.0, "dtype": "float32",
+                    "input_dim_idx": 0, "output_dim_idx": 0},
+             not_differentiable=True)
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    x = data_of(one(ins, "Input"))
+    shape = list(attrs["shape"])
+    shape[attrs["output_dim_idx"]] = x.shape[attrs["input_dim_idx"]]
+    return {"Out": jnp.full(tuple(shape), attrs["value"],
+                            dtype=np_dtype(attrs["dtype"]))}
+
+
+@register_op("fill_zeros_like", inputs=("X",), outputs=("Out",),
+             not_differentiable=True)
+def fill_zeros_like(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": jax.tree_util.tree_map(jnp.zeros_like, x)}
+
+
+@register_op("assign", inputs=("X",), outputs=("Out",))
+def assign(ctx, ins, attrs):
+    return {"Out": one(ins, "X")}
+
+
+@register_op("assign_value", outputs=("Out",),
+             attrs={"shape": [1], "dtype": "float32", "values": []},
+             not_differentiable=True)
+def assign_value(ctx, ins, attrs):
+    dt = np_dtype(attrs["dtype"])
+    arr = np.asarray(attrs["values"], dtype=dt).reshape(tuple(attrs["shape"]))
+    return {"Out": jnp.asarray(arr)}
+
+
+@register_op("cast", inputs=("X",), outputs=("Out",),
+             attrs={"out_dtype": "float32"})
+def cast(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": x.astype(np_dtype(attrs["out_dtype"]))}
+
+
+@register_op("increment", inputs=("X",), outputs=("Out",),
+             attrs={"step": 1.0})
+def increment(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": x + jnp.asarray(attrs["step"], x.dtype)}
+
+
+@register_op("uniform_random", outputs=("Out",),
+             attrs={"shape": [1], "min": -1.0, "max": 1.0, "seed": 0,
+                    "dtype": "float32"},
+             random=True, not_differentiable=True)
+def uniform_random(ctx, ins, attrs):
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
+    dt = np_dtype(attrs["dtype"])
+    return {"Out": jax.random.uniform(
+        key, tuple(attrs["shape"]), dtype=jnp.float32,
+        minval=attrs["min"], maxval=attrs["max"]).astype(dt)}
+
+
+@register_op("gaussian_random", outputs=("Out",),
+             attrs={"shape": [1], "mean": 0.0, "std": 1.0, "seed": 0,
+                    "dtype": "float32"},
+             random=True, not_differentiable=True)
+def gaussian_random(ctx, ins, attrs):
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
+    dt = np_dtype(attrs["dtype"])
+    sample = jax.random.normal(key, tuple(attrs["shape"]), dtype=jnp.float32)
+    return {"Out": (sample * attrs["std"] + attrs["mean"]).astype(dt)}
+
+
+@register_op("uniform_random_batch_size_like", inputs=("Input",),
+             outputs=("Out",),
+             attrs={"shape": [1], "min": -1.0, "max": 1.0, "seed": 0,
+                    "dtype": "float32", "input_dim_idx": 0,
+                    "output_dim_idx": 0},
+             random=True, not_differentiable=True)
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    x = data_of(one(ins, "Input"))
+    shape = list(attrs["shape"])
+    shape[attrs["output_dim_idx"]] = x.shape[attrs["input_dim_idx"]]
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
+    return {"Out": jax.random.uniform(
+        key, tuple(shape), dtype=jnp.float32,
+        minval=attrs["min"], maxval=attrs["max"]
+    ).astype(np_dtype(attrs["dtype"]))}
+
+
+@register_op("one_hot", inputs=("X",), outputs=("Out",),
+             attrs={"depth": 1, "dtype": "float32"},
+             not_differentiable=True)
+def one_hot(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    if x.ndim > 1 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": jax.nn.one_hot(
+        x, attrs["depth"], dtype=np_dtype(attrs["dtype"]))}
+
+
+@register_op("shape", inputs=("Input",), outputs=("Out",),
+             not_differentiable=True)
+def shape_op(ctx, ins, attrs):
+    x = data_of(one(ins, "Input"))
+    return {"Out": jnp.asarray(x.shape, dtype=jnp.int64)}
+
+
+@register_op("isfinite", inputs=("X",), outputs=("Out",),
+             not_differentiable=True)
+def isfinite(ctx, ins, attrs):
+    xs = [data_of(v) for v in ins.get("X", []) if v is not None]
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": ok}

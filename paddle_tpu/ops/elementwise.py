@@ -1,0 +1,143 @@
+"""Elementwise binary ops with the reference's axis-broadcast semantics,
+plus scale / sum / clip.
+
+Reference: /root/reference/paddle/fluid/operators/elementwise_op_function.h —
+Y's shape must be a contiguous sub-sequence of X's shape starting at `axis`
+(axis == -1 means trailing alignment).  On XLA this is a reshape to a
+broadcast-compatible rank followed by the fused elementwise op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.execution import data_of, many, one, with_lod_of
+from ..core.lod import SelectedRows
+from ..core.registry import register_op
+
+
+def _broadcast_y(x, y, axis):
+    if x.shape == y.shape:
+        return y
+    axis = int(axis)
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    # trim trailing 1s of y (reference allows y shape (n,1) against axis dim n)
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > 1:
+        yshape = yshape[:-1]
+    target = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return y.reshape(target)
+
+
+def _make_elementwise(name, fn):
+    @register_op(name, inputs=("X", "Y"), outputs=("Out",),
+                 attrs={"axis": -1})
+    def lower(ctx, ins, attrs, _fn=fn):
+        xv, yv = one(ins, "X"), one(ins, "Y")
+        x, y = data_of(xv), data_of(yv)
+        out = _fn(x, _broadcast_y(x, y, attrs.get("axis", -1)))
+        return {"Out": with_lod_of(xv, out)}
+
+    return lower
+
+
+_make_elementwise("elementwise_add", jnp.add)
+_make_elementwise("elementwise_sub", jnp.subtract)
+_make_elementwise("elementwise_mul", jnp.multiply)
+_make_elementwise("elementwise_div", jnp.divide)
+_make_elementwise("elementwise_max", jnp.maximum)
+_make_elementwise("elementwise_min", jnp.minimum)
+_make_elementwise("elementwise_pow", jnp.power)
+
+
+@register_op("scale", inputs=("X",), outputs=("Out",),
+             attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True})
+def scale(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    s = jnp.asarray(attrs["scale"], x.dtype)
+    b = jnp.asarray(attrs.get("bias", 0.0), x.dtype)
+    if attrs.get("bias_after_scale", True):
+        out = x * s + b
+    else:
+        out = (x + b) * s
+    return {"Out": with_lod_of(xv, out)}
+
+
+@register_op("clip", inputs=("X",), outputs=("Out",),
+             attrs={"min": -1.0, "max": 1.0})
+def clip(ctx, ins, attrs):
+    xv = one(ins, "X")
+    out = jnp.clip(data_of(xv), attrs["min"], attrs["max"])
+    return {"Out": with_lod_of(xv, out)}
+
+
+@register_op("clip_by_norm", inputs=("X",), outputs=("Out",),
+             attrs={"max_norm": 1.0})
+def clip_by_norm(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    factor = jnp.where(norm > attrs["max_norm"],
+                       attrs["max_norm"] / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": with_lod_of(xv, x * factor.astype(x.dtype))}
+
+
+@register_op("sum", inputs=("X",), outputs=("Out",))
+def sum_op(ctx, ins, attrs):
+    """Fan-in accumulator.  Handles dense + SelectedRows mixtures exactly as
+    the reference sum_op / math/selected_rows_functor do: all-sparse in,
+    sparse out (rows concatenated); any dense in, dense out."""
+    xs = [v for v in many(ins, "X") if v is not None]
+    if not xs:
+        return {"Out": None}
+    sparse = [v for v in xs if isinstance(v, SelectedRows)]
+    if len(sparse) == len(xs):
+        rows = jnp.concatenate([s.rows for s in sparse])
+        vals = jnp.concatenate([s.value for s in sparse])
+        return {"Out": SelectedRows(rows, vals, sparse[0].height)}
+    acc = None
+    for v in xs:
+        d = v.to_dense() if isinstance(v, SelectedRows) else data_of(v)
+        acc = d if acc is None else acc + d
+    first = next((v for v in xs if not isinstance(v, SelectedRows)), None)
+    return {"Out": with_lod_of(first, acc) if first is not None else acc}
+
+
+def _make_compare(name, fn):
+    @register_op(name, inputs=("X", "Y"), outputs=("Out",),
+                 attrs={"axis": -1}, not_differentiable=True)
+    def lower(ctx, ins, attrs, _fn=fn):
+        x, y = data_of(one(ins, "X")), data_of(one(ins, "Y"))
+        return {"Out": _fn(x, _broadcast_y(x, y, attrs.get("axis", -1)))}
+
+    return lower
+
+
+_make_compare("less_than", jnp.less)
+_make_compare("less_equal", jnp.less_equal)
+_make_compare("greater_than", jnp.greater)
+_make_compare("greater_equal", jnp.greater_equal)
+_make_compare("equal", jnp.equal)
+_make_compare("not_equal", jnp.not_equal)
+
+
+def _make_logical(name, fn, unary=False):
+    ins_slots = ("X",) if unary else ("X", "Y")
+
+    @register_op(name, inputs=ins_slots, outputs=("Out",),
+                 not_differentiable=True)
+    def lower(ctx, ins, attrs, _fn=fn, _unary=unary):
+        x = data_of(one(ins, "X"))
+        if _unary:
+            return {"Out": _fn(x)}
+        return {"Out": _fn(x, data_of(one(ins, "Y")))}
+
+    return lower
+
+
+_make_logical("logical_and", jnp.logical_and)
+_make_logical("logical_or", jnp.logical_or)
+_make_logical("logical_xor", jnp.logical_xor)
+_make_logical("logical_not", jnp.logical_not, unary=True)

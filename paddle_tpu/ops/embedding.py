@@ -1,0 +1,99 @@
+"""Embedding / sparse ops: lookup_table (+SelectedRows grad), nce.
+
+Reference: /root/reference/paddle/fluid/operators/lookup_table_op.cc
+(`is_sparse` attr switches the grad var type to SelectedRows via
+VarTypeInference, :114-131), nce_op.cc,
+math/selected_rows_functor.
+
+TPU design: dense grads are segment-sum scatters (XLA scatter-add);
+sparse grads keep the SelectedRows representation so sharded-embedding /
+pserver-equivalent paths can ship only touched rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one, with_lod_of
+from ..core.lod import LoDTensor, SelectedRows
+from ..core.registry import register_grad_maker, register_op
+
+
+@register_op("lookup_table", inputs=("Ids", "W"), outputs=("Out",),
+             attrs={"is_sparse": False, "padding_idx": -1},
+             diff_inputs=("W",))
+def lookup_table(ctx, ins, attrs):
+    ids_v = one(ins, "Ids")
+    ids = data_of(ids_v)
+    w = data_of(one(ins, "W"))
+    flat = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        out = jnp.where((flat == pad)[:, None], jnp.zeros_like(out), out)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        out_shape = ids.shape[:-1] + (w.shape[1],)
+    else:
+        out_shape = ids.shape + (w.shape[1],)
+    return {"Out": with_lod_of(ids_v, out.reshape(out_shape))}
+
+
+@register_op("lookup_table_grad", inputs=("Ids", "W", "Out@GRAD"),
+             outputs=("W@GRAD",))
+def lookup_table_grad(ctx, ins, attrs):
+    ids = data_of(one(ins, "Ids"))
+    w = data_of(one(ins, "W"))
+    og = data_of(one(ins, "Out@GRAD"))
+    flat = ids.reshape(-1).astype(jnp.int32)
+    og2 = og.reshape(-1, w.shape[1])
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        og2 = jnp.where((flat == pad)[:, None], jnp.zeros_like(og2), og2)
+    if attrs.get("is_sparse"):
+        return {"W@GRAD": SelectedRows(flat, og2, w.shape[0])}
+    return {"W@GRAD": jnp.zeros_like(w).at[flat].add(
+        og2.astype(w.dtype))}
+
+
+@register_op("nce",
+             inputs=("Input", "Label", "Weight", "Bias", "SampleWeight"),
+             outputs=("Cost", "SampleLogits", "SampleLabels"),
+             attrs={"num_total_classes": 2, "num_neg_samples": 10,
+                    "seed": 0},
+             diff_inputs=("Input", "Weight", "Bias"),
+             diff_outputs=("Cost",), random=True)
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (reference nce_op.cc): uniform negative
+    sampling, logistic loss over true + sampled classes."""
+    x = data_of(one(ins, "Input"))          # [B, D]
+    label = data_of(one(ins, "Label"))      # [B, T]
+    w = data_of(one(ins, "Weight"))         # [C, D]
+    b = one(ins, "Bias")                    # [C] or None
+    num_classes = attrs["num_total_classes"]
+    k = attrs["num_neg_samples"]
+    bsz = x.shape[0]
+    if label.ndim == 1:
+        label = label[:, None]
+    n_true = label.shape[1]
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed")
+           else ctx.rng())
+    neg = jax.random.randint(key, (bsz, k), 0, num_classes)
+    samples = jnp.concatenate([label.astype(jnp.int32),
+                               neg.astype(jnp.int32)], axis=1)  # [B, T+k]
+    w_s = jnp.take(w, samples.reshape(-1), axis=0).reshape(
+        bsz, n_true + k, -1)
+    logits = jnp.einsum("bd,btd->bt", x, w_s)
+    if b is not None:
+        logits = logits + jnp.take(data_of(b), samples.reshape(-1)
+                                   ).reshape(bsz, n_true + k)
+    p_true = 1.0 / num_classes  # uniform sampler
+    # NCE logistic loss: P(D=1|x) for true, P(D=0|x) for noise
+    logit_adj = logits - jnp.log(jnp.asarray(k * p_true, logits.dtype))
+    lbl_mat = jnp.concatenate(
+        [jnp.ones((bsz, n_true), logits.dtype),
+         jnp.zeros((bsz, k), logits.dtype)], axis=1)
+    per = (jnp.maximum(logit_adj, 0) - logit_adj * lbl_mat +
+           jnp.log1p(jnp.exp(-jnp.abs(logit_adj))))
+    cost = jnp.sum(per, axis=1, keepdims=True)
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples.astype(jnp.int64)}

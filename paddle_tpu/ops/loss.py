@@ -1,0 +1,152 @@
+"""Loss ops.
+
+Reference: /root/reference/paddle/fluid/operators/{cross_entropy,
+softmax_with_cross_entropy,sigmoid_cross_entropy_with_logits,hinge_loss,
+huber_loss,log_loss,margin_rank_loss,modified_huber_loss,rank_loss,
+smooth_l1_loss,squared_l2_distance}_op.cc and math/cross_entropy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one, with_lod_of
+from ..core.registry import register_op
+
+
+def _take_label(x, label):
+    """x: [N, D] probabilities/logits; label: [N] or [N,1] int -> x[i, label[i]]."""
+    label = data_of(label)
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    return jnp.take_along_axis(x, label[:, None].astype(jnp.int32),
+                               axis=1), label
+
+
+@register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",),
+             attrs={"soft_label": False}, diff_inputs=("X",))
+def cross_entropy(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    eps = jnp.asarray(1e-10 if x.dtype == jnp.float32 else 1e-20, x.dtype)
+    if attrs.get("soft_label"):
+        lbl = data_of(one(ins, "Label"))
+        y = -jnp.sum(lbl * jnp.log(jnp.maximum(x, eps)), axis=-1,
+                     keepdims=True)
+    else:
+        picked, _ = _take_label(x, one(ins, "Label"))
+        y = -jnp.log(jnp.maximum(picked, eps))
+    return {"Y": with_lod_of(xv, y)}
+
+
+@register_op("softmax_with_cross_entropy", inputs=("Logits", "Label"),
+             outputs=("Softmax", "Loss"),
+             attrs={"soft_label": False},
+             diff_inputs=("Logits",), diff_outputs=("Loss",))
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = data_of(one(ins, "Logits"))
+    log_p = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label"):
+        lbl = data_of(one(ins, "Label"))
+        loss = -jnp.sum(lbl * log_p, axis=-1, keepdims=True)
+    else:
+        picked, _ = _take_label(log_p, one(ins, "Label"))
+        loss = -picked
+    return {"Softmax": jnp.exp(log_p), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", inputs=("X", "Label"),
+             outputs=("Out",), diff_inputs=("X",))
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    lbl = data_of(one(ins, "Label")).astype(x.dtype)
+    out = jnp.maximum(x, 0) - x * lbl + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": out}
+
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"), outputs=("Loss",),
+             diff_inputs=("Logits",))
+def hinge_loss(ctx, ins, attrs):
+    x = data_of(one(ins, "Logits"))
+    y = data_of(one(ins, "Labels")).astype(x.dtype)
+    return {"Loss": jnp.maximum(1.0 - (2.0 * y - 1.0) * x, 0.0)}
+
+
+@register_op("huber_loss", inputs=("X", "Y"), outputs=("Residual", "Out"),
+             attrs={"delta": 1.0}, diff_outputs=("Out",))
+def huber_loss(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    y = data_of(one(ins, "Y"))
+    d = jnp.asarray(attrs["delta"], x.dtype)
+    r = y - x
+    out = jnp.where(jnp.abs(r) <= d, 0.5 * jnp.square(r),
+                    d * (jnp.abs(r) - 0.5 * d))
+    return {"Residual": r, "Out": out}
+
+
+@register_op("log_loss", inputs=("Predicted", "Labels"), outputs=("Loss",),
+             attrs={"epsilon": 1e-4}, diff_inputs=("Predicted",))
+def log_loss(ctx, ins, attrs):
+    p = data_of(one(ins, "Predicted"))
+    y = data_of(one(ins, "Labels")).astype(p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    return {"Loss": -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)}
+
+
+@register_op("margin_rank_loss", inputs=("X1", "X2", "Label"),
+             outputs=("Out", "Activated"),
+             attrs={"margin": 0.0},
+             diff_inputs=("X1", "X2"), diff_outputs=("Out",))
+def margin_rank_loss(ctx, ins, attrs):
+    x1 = data_of(one(ins, "X1"))
+    x2 = data_of(one(ins, "X2"))
+    lbl = data_of(one(ins, "Label")).astype(x1.dtype)
+    m = jnp.asarray(attrs["margin"], x1.dtype)
+    out = jnp.maximum(-lbl * (x1 - x2) + m, 0.0)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("modified_huber_loss", inputs=("X", "Y"),
+             outputs=("IntermediateVal", "Out"),
+             diff_inputs=("X",), diff_outputs=("Out",))
+def modified_huber_loss(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    y = data_of(one(ins, "Y")).astype(x.dtype)
+    z = (2.0 * y - 1.0) * x
+    out = jnp.where(z < -1.0, -4.0 * z,
+                    jnp.where(z < 1.0, jnp.square(1.0 - z),
+                              jnp.zeros_like(z)))
+    return {"IntermediateVal": z, "Out": out}
+
+
+@register_op("rank_loss", inputs=("Label", "Left", "Right"), outputs=("Out",),
+             diff_inputs=("Left", "Right"))
+def rank_loss(ctx, ins, attrs):
+    lbl = data_of(one(ins, "Label"))
+    left = data_of(one(ins, "Left"))
+    right = data_of(one(ins, "Right"))
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - lbl.astype(d.dtype) * d}
+
+
+@register_op("smooth_l1_loss", inputs=("X", "Y", "InsideWeight",
+                                       "OutsideWeight"),
+             outputs=("Diff", "Out"),
+             attrs={"sigma": 1.0},
+             diff_inputs=("X",), diff_outputs=("Out",))
+def smooth_l1_loss(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    y = data_of(one(ins, "Y"))
+    iw = one(ins, "InsideWeight")
+    ow = one(ins, "OutsideWeight")
+    sigma2 = attrs["sigma"] ** 2
+    diff = x - y
+    if iw is not None:
+        diff = diff * data_of(iw)
+    ad = jnp.abs(diff)
+    val = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(diff),
+                    ad - 0.5 / sigma2)
+    if ow is not None:
+        val = val * data_of(ow)
+    return {"Diff": diff,
+            "Out": jnp.sum(val, axis=tuple(range(1, val.ndim))).reshape(-1, 1)}

@@ -1,0 +1,157 @@
+"""Tensor manipulation ops: reshape/transpose/concat/split/expand/pad/crop/
+gather/scatter/top_k/sequence-agnostic reorderings.
+
+Reference: /root/reference/paddle/fluid/operators/{reshape,transpose,concat,
+split,expand,pad,crop,gather,scatter,top_k}_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, many, one, with_lod_of
+from ..core.registry import register_op
+
+
+@register_op("reshape", inputs=("X",), outputs=("Out",),
+             attrs={"shape": []})
+def reshape(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    shape = list(attrs["shape"])
+    # reference reshape_op: 0 keeps the original dim, -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": with_lod_of(xv, x.reshape(shape))}
+
+
+@register_op("transpose", inputs=("X",), outputs=("Out",),
+             attrs={"axis": []})
+def transpose(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": jnp.transpose(x, attrs["axis"] or None)}
+
+
+@register_op("concat", inputs=("X",), outputs=("Out",),
+             attrs={"axis": 0})
+def concat(ctx, ins, attrs):
+    xs = [data_of(v) for v in many(ins, "X")]
+    return {"Out": jnp.concatenate(xs, axis=attrs["axis"])}
+
+
+@register_op("split", inputs=("X",), outputs=("Out",),
+             attrs={"axis": 0, "num": 0, "sections": []})
+def split(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    axis = attrs["axis"]
+    if attrs.get("sections"):
+        idx = np.cumsum(attrs["sections"])[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, attrs["num"], axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("expand", inputs=("X",), outputs=("Out",),
+             attrs={"expand_times": []})
+def expand(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": jnp.tile(x, attrs["expand_times"])}
+
+
+@register_op("pad", inputs=("X",), outputs=("Out",),
+             attrs={"paddings": [], "pad_value": 0.0})
+def pad(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=attrs["pad_value"])}
+
+
+@register_op("crop", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"offsets": [], "shape": []})
+def crop(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    y = one(ins, "Y")
+    shape = tuple(data_of(y).shape) if y is not None else tuple(attrs["shape"])
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
+
+
+@register_op("gather", inputs=("X", "Index"), outputs=("Out",),
+             diff_inputs=("X",))
+def gather(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    idx = data_of(one(ins, "Index")).reshape(-1)
+    return {"Out": jnp.take(x, idx, axis=0)}
+
+
+@register_op("scatter", inputs=("X", "Ids", "Updates"), outputs=("Out",),
+             diff_inputs=("X", "Updates"))
+def scatter(ctx, ins, attrs):
+    """Reference scatter_op: Out = X; Out[Ids] = Updates (overwrite)."""
+    x = data_of(one(ins, "X"))
+    ids = data_of(one(ins, "Ids")).reshape(-1)
+    upd = data_of(one(ins, "Updates"))
+    return {"Out": x.at[ids].set(upd)}
+
+
+@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"),
+             attrs={"k": 1}, diff_outputs=())
+def top_k(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    vals, idx = jax.lax.top_k(x, attrs["k"])
+    return {"Out": with_lod_of(xv, vals),
+            "Indices": with_lod_of(xv, idx.astype(jnp.int64))}
+
+
+@register_op("unsqueeze", inputs=("X",), outputs=("Out",),
+             attrs={"axes": []})
+def unsqueeze(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("squeeze", inputs=("X",), outputs=("Out",),
+             attrs={"axes": []})
+def squeeze(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    axes = attrs.get("axes")
+    return {"Out": jnp.squeeze(x, axis=tuple(axes) if axes else None)}
+
+
+@register_op("stack", inputs=("X",), outputs=("Out",), attrs={"axis": 0})
+def stack(ctx, ins, attrs):
+    xs = [data_of(v) for v in many(ins, "X")]
+    return {"Out": jnp.stack(xs, axis=attrs["axis"])}
+
+
+@register_op("slice", inputs=("Input",), outputs=("Out",),
+             attrs={"axes": [], "starts": [], "ends": []})
+def slice_op(ctx, ins, attrs):
+    x = data_of(one(ins, "Input"))
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        idx[a] = slice(s, e)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("flatten", inputs=("X",), outputs=("Out",), attrs={"axis": 1})
+def flatten(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    a = attrs["axis"]
+    lead = int(np.prod(x.shape[:a], dtype=np.int64)) if a else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op("reverse", inputs=("X",), outputs=("Out",), attrs={"axis": [0]})
+def reverse(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    ax = attrs["axis"]
+    ax = ax if isinstance(ax, (list, tuple)) else [ax]
+    return {"Out": jnp.flip(x, axis=tuple(ax))}

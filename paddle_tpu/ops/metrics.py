@@ -1,0 +1,129 @@
+"""Metric ops (metrics-as-ops, reference scheme).
+
+Reference: /root/reference/paddle/fluid/operators/{accuracy,auc,
+precision_recall,edit_distance}_op.cc.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+
+
+@register_op("accuracy", inputs=("Out", "Indices", "Label"),
+             outputs=("Accuracy", "Correct", "Total"),
+             not_differentiable=True)
+def accuracy(ctx, ins, attrs):
+    """Top-k accuracy from top_k outputs (reference accuracy_op.cc)."""
+    idx = data_of(one(ins, "Indices"))  # [N, k]
+    label = data_of(one(ins, "Label"))
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    hit = jnp.any(idx == label[:, None].astype(idx.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": acc.reshape(1), "Correct": correct.reshape(1),
+            "Total": total.reshape(1)}
+
+
+@register_op("auc",
+             inputs=("Out", "Indices", "Label"),
+             outputs=("AUC",),
+             attrs={"curve": "ROC", "num_thresholds": 200},
+             not_differentiable=True)
+def auc(ctx, ins, attrs):
+    """Single-batch AUC via threshold sweep (reference auc_op.cc)."""
+    probs = data_of(one(ins, "Out"))
+    if probs.ndim == 2:
+        pos = probs[:, -1] if probs.shape[1] > 1 else probs[:, 0]
+    else:
+        pos = probs
+    label = data_of(one(ins, "Label")).reshape(-1)
+    n_thr = attrs["num_thresholds"]
+    thr = jnp.linspace(0.0, 1.0, n_thr)
+    is_pos = (label > 0)
+    pred = pos[None, :] > thr[:, None]          # [T, N]
+    tp = jnp.sum(pred & is_pos[None, :], axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred & ~is_pos[None, :], axis=1).astype(jnp.float32)
+    p = jnp.maximum(jnp.sum(is_pos).astype(jnp.float32), 1.0)
+    n = jnp.maximum(jnp.sum(~is_pos).astype(jnp.float32), 1.0)
+    tpr = tp / p
+    fpr = fp / n
+    # trapezoidal area over decreasing fpr
+    area = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+    return {"AUC": area.reshape(1)}
+
+
+@register_op("precision_recall",
+             inputs=("MaxProbs", "Indices", "Labels", "Weights",
+                     "StatesInfo"),
+             outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"),
+             attrs={"class_number": 2},
+             not_differentiable=True)
+def precision_recall(ctx, ins, attrs):
+    c = attrs["class_number"]
+    idx = data_of(one(ins, "Indices")).reshape(-1)
+    labels = data_of(one(ins, "Labels")).reshape(-1)
+    onehot_pred = jnp.eye(c, dtype=jnp.float32)[idx]
+    onehot_lbl = jnp.eye(c, dtype=jnp.float32)[labels]
+    tp = jnp.sum(onehot_pred * onehot_lbl, axis=0)
+    fp = jnp.sum(onehot_pred * (1 - onehot_lbl), axis=0)
+    fn = jnp.sum((1 - onehot_pred) * onehot_lbl, axis=0)
+    states = jnp.stack([tp, fp, jnp.zeros_like(tp), fn], axis=1)
+    prev = one(ins, "StatesInfo")
+    acc = states if prev is None else states + data_of(prev)
+
+    def metrics(s):
+        tp_, fp_, _, fn_ = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-9), 0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-9), 0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-9), 0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        micro_p = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fp_), 1e-9)
+        micro_r = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fn_), 1e-9)
+        micro_f = jnp.where(micro_p + micro_r > 0,
+                            2 * micro_p * micro_r /
+                            jnp.maximum(micro_p + micro_r, 1e-9), 0)
+        return jnp.concatenate([macro, jnp.stack([micro_p, micro_r, micro_f])])
+
+    return {"BatchMetrics": metrics(states), "AccumMetrics": metrics(acc),
+            "AccumStatesInfo": acc}
+
+
+@register_op("edit_distance", inputs=("Hyps", "Refs"),
+             outputs=("Out", "SequenceNum"),
+             attrs={"normalized": False}, not_differentiable=True, host=True)
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance over LoD sequences — host op (dynamic lengths)."""
+    import numpy as np
+
+    hyps = one(ins, "Hyps")
+    refs = one(ins, "Refs")
+
+    def seqs(t):
+        d = np.asarray(data_of(t)).reshape(-1)
+        if hasattr(t, "lod") and t.lod:
+            offs = t.lod[0]
+            return [d[offs[i]:offs[i + 1]] for i in range(len(offs) - 1)]
+        return [d]
+
+    H, R = seqs(hyps), seqs(refs)
+    outs = []
+    for h, r in zip(H, R):
+        m, n = len(h), len(r)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                cost = 0 if h[i - 1] == r[j - 1] else 1
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + cost)
+        d = dp[n]
+        if attrs.get("normalized") and n > 0:
+            d /= n
+        outs.append(d)
+    return {"Out": np.asarray(outs, np.float32).reshape(-1, 1),
+            "SequenceNum": np.asarray([len(outs)], np.int64)}

@@ -1,0 +1,90 @@
+"""Normalization ops: batch_norm, layer_norm, lrn.
+
+Reference: /root/reference/paddle/fluid/operators/batch_norm_op.cc(+cu),
+layer_norm_op.cc, lrn_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+
+
+@register_op("batch_norm",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"),
+             attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+                    "data_layout": "NCHW"},
+             diff_inputs=("X", "Scale", "Bias"), diff_outputs=("Y",),
+             inplace={"MeanOut": "Mean", "VarianceOut": "Variance"})
+def batch_norm(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    scale = data_of(one(ins, "Scale"))
+    bias = data_of(one(ins, "Bias"))
+    mean = data_of(one(ins, "Mean"))
+    var = data_of(one(ins, "Variance"))
+    eps = attrs["epsilon"]
+    mom = attrs["momentum"]
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if (layout == "NCHW" and x.ndim > 1) else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if attrs.get("is_test"):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean, saved_var = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.mean(jnp.square(x - use_mean.reshape(bshape)),
+                           axis=axes)
+        mean_out = mom * mean + (1.0 - mom) * use_mean
+        var_out = mom * var + (1.0 - mom) * use_var
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    inv_std = 1.0 / jnp.sqrt(use_var + eps)
+    y = ((x - use_mean.reshape(bshape)) * inv_std.reshape(bshape)
+         * scale.reshape(bshape) + bias.reshape(bshape))
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register_op("layer_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"),
+             attrs={"epsilon": 1e-5, "begin_norm_axis": 1},
+             diff_inputs=("X", "Scale", "Bias"), diff_outputs=("Y",))
+def layer_norm(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    a = attrs["begin_norm_axis"]
+    axes = tuple(range(a, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + attrs["epsilon"])
+    scale = one(ins, "Scale")
+    bias = one(ins, "Bias")
+    norm_shape = [1] * a + list(x.shape[a:])
+    if scale is not None:
+        y = y * data_of(scale).reshape(norm_shape)
+    if bias is not None:
+        y = y + data_of(bias).reshape(norm_shape)
+    return {"Y": y, "Mean": mean.reshape(x.shape[:a]),
+            "Variance": var.reshape(x.shape[:a])}
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"),
+             attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75},
+             diff_outputs=("Out",))
+def lrn(ctx, ins, attrs):
+    """Cross-channel local response normalization (reference lrn_op.cc)."""
+    x = data_of(one(ins, "X"))  # [N, C, H, W]
+    n = attrs["n"]
+    half = n // 2
+    sq = jnp.square(x)
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = attrs["k"] + attrs["alpha"] * window
+    return {"Out": x / jnp.power(mid, attrs["beta"]), "MidOut": mid}

@@ -1,0 +1,255 @@
+"""Optimizer update ops — optimizers-as-ops, exactly the reference scheme
+(python optimizer.py appends these to the program).
+
+Reference kernels: /root/reference/paddle/fluid/operators/{sgd,momentum,adam,
+adamax,adagrad,adadelta,decayed_adagrad,rmsprop,ftrl,proximal_gd,
+proximal_adagrad}_op.cc.  All write Param/accumulators in place
+(ParamOut aliases Param); the compiled executor donates these buffers so the
+update is in-place at the XLA level too.
+
+Sparse (SelectedRows) gradients take the scatter path on sgd/adam/adagrad/
+momentum, mirroring the reference's SelectedRows kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one
+from ..core.lod import SelectedRows
+from ..core.registry import register_op
+
+
+def _lr(ins):
+    return data_of(one(ins, "LearningRate")).reshape(()).astype(jnp.float32)
+
+
+def _dense_grad(g):
+    return g.to_dense() if isinstance(g, SelectedRows) else data_of(g)
+
+
+@register_op("sgd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), inplace={"ParamOut": "Param"},
+             not_differentiable=True)
+def sgd(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = one(ins, "Grad")
+    lr = _lr(ins).astype(p.dtype)
+    if isinstance(g, SelectedRows):
+        return {"ParamOut": p.at[g.rows].add(-lr * g.value)}
+    return {"ParamOut": p - lr * data_of(g)}
+
+
+@register_op("momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate"),
+             outputs=("ParamOut", "VelocityOut"),
+             attrs={"mu": 0.9, "use_nesterov": False},
+             inplace={"ParamOut": "Param", "VelocityOut": "Velocity"},
+             not_differentiable=True)
+def momentum(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = _dense_grad(one(ins, "Grad"))
+    v = data_of(one(ins, "Velocity"))
+    lr = _lr(ins).astype(p.dtype)
+    mu = jnp.asarray(attrs["mu"], p.dtype)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov"):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("adam",
+             inputs=("Param", "Grad", "Moment1", "Moment2", "LearningRate",
+                     "Beta1Pow", "Beta2Pow"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out"),
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+             inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+                      "Moment2Out": "Moment2"},
+             not_differentiable=True)
+def adam(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    m1 = data_of(one(ins, "Moment1"))
+    m2 = data_of(one(ins, "Moment2"))
+    b1p = data_of(one(ins, "Beta1Pow")).reshape(()).astype(p.dtype)
+    b2p = data_of(one(ins, "Beta2Pow")).reshape(()).astype(p.dtype)
+    lr = _lr(ins).astype(p.dtype)
+    b1 = jnp.asarray(attrs["beta1"], p.dtype)
+    b2 = jnp.asarray(attrs["beta2"], p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    # SelectedRows grads densify first (duplicate-row-safe; XLA scatter-add);
+    # the dense-decay numerics match the reference's dense adam kernel.
+    g = _dense_grad(one(ins, "Grad"))
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out}
+
+
+@register_op("adamax",
+             inputs=("Param", "Grad", "Moment", "InfNorm", "LearningRate",
+                     "Beta1Pow"),
+             outputs=("ParamOut", "MomentOut", "InfNormOut"),
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+             inplace={"ParamOut": "Param", "MomentOut": "Moment",
+                      "InfNormOut": "InfNorm"},
+             not_differentiable=True)
+def adamax(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = _dense_grad(one(ins, "Grad"))
+    m = data_of(one(ins, "Moment"))
+    inf = data_of(one(ins, "InfNorm"))
+    b1p = data_of(one(ins, "Beta1Pow")).reshape(()).astype(p.dtype)
+    lr = _lr(ins).astype(p.dtype)
+    b1 = jnp.asarray(attrs["beta1"], p.dtype)
+    b2 = jnp.asarray(attrs["beta2"], p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    p_out = p - (lr / (1 - b1p)) * (m_out / inf_out)
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+@register_op("adagrad",
+             inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"),
+             attrs={"epsilon": 1e-6},
+             inplace={"ParamOut": "Param", "MomentOut": "Moment"},
+             not_differentiable=True)
+def adagrad(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    m = data_of(one(ins, "Moment"))
+    lr = _lr(ins).astype(p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    g = _dense_grad(one(ins, "Grad"))
+    m_out = m + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("adadelta",
+             inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"),
+             outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
+             attrs={"rho": 0.95, "epsilon": 1e-6},
+             inplace={"ParamOut": "Param",
+                      "AvgSquaredGradOut": "AvgSquaredGrad",
+                      "AvgSquaredUpdateOut": "AvgSquaredUpdate"},
+             not_differentiable=True)
+def adadelta(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = _dense_grad(one(ins, "Grad"))
+    asg = data_of(one(ins, "AvgSquaredGrad"))
+    asu = data_of(one(ins, "AvgSquaredUpdate"))
+    rho = jnp.asarray(attrs["rho"], p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    asg_out = rho * asg + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * jnp.square(update)
+    return {"ParamOut": p + update, "AvgSquaredGradOut": asg_out,
+            "AvgSquaredUpdateOut": asu_out}
+
+
+@register_op("decayed_adagrad",
+             inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"),
+             attrs={"decay": 0.95, "epsilon": 1e-6},
+             inplace={"ParamOut": "Param", "MomentOut": "Moment"},
+             not_differentiable=True)
+def decayed_adagrad(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = _dense_grad(one(ins, "Grad"))
+    m = data_of(one(ins, "Moment"))
+    lr = _lr(ins).astype(p.dtype)
+    decay = jnp.asarray(attrs["decay"], p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    return {"ParamOut": p - lr * g / (jnp.sqrt(m_out) + eps),
+            "MomentOut": m_out}
+
+
+@register_op("rmsprop",
+             inputs=("Param", "Grad", "MeanSquare", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MeanSquareOut", "MomentOut"),
+             attrs={"decay": 0.9, "momentum": 0.0, "epsilon": 1e-10},
+             inplace={"ParamOut": "Param", "MeanSquareOut": "MeanSquare",
+                      "MomentOut": "Moment"},
+             not_differentiable=True)
+def rmsprop(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = _dense_grad(one(ins, "Grad"))
+    ms = data_of(one(ins, "MeanSquare"))
+    mom = data_of(one(ins, "Moment"))
+    lr = _lr(ins).astype(p.dtype)
+    decay = jnp.asarray(attrs["decay"], p.dtype)
+    mu = jnp.asarray(attrs["momentum"], p.dtype)
+    eps = jnp.asarray(attrs["epsilon"], p.dtype)
+    ms_out = decay * ms + (1 - decay) * jnp.square(g)
+    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": p - mom_out, "MeanSquareOut": ms_out,
+            "MomentOut": mom_out}
+
+
+@register_op("ftrl",
+             inputs=("Param", "SquaredAccumulator", "LinearAccumulator",
+                     "Grad", "LearningRate"),
+             outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
+             attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5},
+             inplace={"ParamOut": "Param",
+                      "SquaredAccumOut": "SquaredAccumulator",
+                      "LinearAccumOut": "LinearAccumulator"},
+             not_differentiable=True)
+def ftrl(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    sq = data_of(one(ins, "SquaredAccumulator"))
+    lin = data_of(one(ins, "LinearAccumulator"))
+    g = _dense_grad(one(ins, "Grad"))
+    lr = _lr(ins).astype(p.dtype)
+    l1 = jnp.asarray(attrs["l1"], p.dtype)
+    l2 = jnp.asarray(attrs["l2"], p.dtype)
+    power = attrs["lr_power"]
+    sq_out = sq + jnp.square(g)
+    sigma = (jnp.power(sq_out, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    x = l1 * jnp.sign(lin_out) - lin_out
+    y = jnp.power(sq_out, -power) / lr + 2 * l2
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    return {"ParamOut": p_out, "SquaredAccumOut": sq_out,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("proximal_gd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",),
+             attrs={"l1": 0.0, "l2": 0.0},
+             inplace={"ParamOut": "Param"}, not_differentiable=True)
+def proximal_gd(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    g = _dense_grad(one(ins, "Grad"))
+    lr = _lr(ins).astype(p.dtype)
+    l1 = jnp.asarray(attrs["l1"], p.dtype)
+    l2 = jnp.asarray(attrs["l2"], p.dtype)
+    prox = p - lr * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": p_out}
+
+
+@register_op("proximal_adagrad",
+             inputs=("Param", "Moment", "Grad", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"),
+             attrs={"l1": 0.0, "l2": 0.0},
+             inplace={"ParamOut": "Param", "MomentOut": "Moment"},
+             not_differentiable=True)
+def proximal_adagrad(ctx, ins, attrs):
+    p = data_of(one(ins, "Param"))
+    m = data_of(one(ins, "Moment"))
+    g = _dense_grad(one(ins, "Grad"))
+    lr = _lr(ins).astype(p.dtype)
+    l1 = jnp.asarray(attrs["l1"], p.dtype)
+    l2 = jnp.asarray(attrs["l2"], p.dtype)
+    m_out = m + jnp.square(g)
+    lr_t = lr / jnp.sqrt(m_out)
+    prox = p - lr_t * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
+             / (1.0 + lr_t * l2))
+    return {"ParamOut": p_out, "MomentOut": m_out}

@@ -1,0 +1,112 @@
+"""Reductions: mean, reduce_{sum,mean,max,min,prod}, cumsum, norms, argmax.
+
+Reference: /root/reference/paddle/fluid/operators/mean_op.cc (scalar mean,
+shape {1}), reduce_op.cc (dim/keep_dim/reduce_all attrs), cum_op.h.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+
+
+@register_op("mean", inputs=("X",), outputs=("Out",))
+def mean(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    # reference mean_op outputs a {1}-shaped tensor (mean_op.cc InferShape)
+    return {"Out": jnp.mean(x).reshape(1)}
+
+
+def _make_reduce(name, fn):
+    @register_op(name, inputs=("X",), outputs=("Out",),
+                 attrs={"dim": [0], "keep_dim": False, "reduce_all": False})
+    def lower(ctx, ins, attrs, _fn=fn):
+        x = data_of(one(ins, "X"))
+        if attrs.get("reduce_all"):
+            out = _fn(x, axis=None, keepdims=attrs["keep_dim"])
+            if not attrs["keep_dim"]:
+                out = out.reshape(1)
+        else:
+            dim = attrs["dim"]
+            axes = tuple(dim) if isinstance(dim, (list, tuple)) else (int(dim),)
+            axes = tuple(a if a >= 0 else a + x.ndim for a in axes)
+            out = _fn(x, axis=axes, keepdims=attrs["keep_dim"])
+            if out.ndim == 0:
+                out = out.reshape(1)
+        return {"Out": out}
+
+    return lower
+
+
+_make_reduce("reduce_sum", jnp.sum)
+_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_max", jnp.max)
+_make_reduce("reduce_min", jnp.min)
+_make_reduce("reduce_prod", jnp.prod)
+
+
+@register_op("cumsum", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1, "exclusive": False, "reverse": False})
+def cumsum(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    axis = attrs["axis"]
+    if attrs.get("reverse"):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis, dtype=x.dtype)
+    if attrs.get("exclusive"):
+        out = out - x
+    if attrs.get("reverse"):
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+@register_op("l1_norm", inputs=("X",), outputs=("Out",))
+def l1_norm(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": jnp.sum(jnp.abs(x)).reshape(1)}
+
+
+@register_op("squared_l2_norm", inputs=("X",), outputs=("Out",))
+def squared_l2_norm(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": jnp.sum(jnp.square(x)).reshape(1)}
+
+
+@register_op("squared_l2_distance", inputs=("X", "Y"),
+             outputs=("Out", "sub_result"))
+def squared_l2_distance(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    y = data_of(one(ins, "Y"))
+    sub = x - y.reshape((1,) + y.shape[1:] if y.shape[0] == 1 else y.shape)
+    return {"sub_result": sub,
+            "Out": jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                           keepdims=False).reshape(-1, 1)}
+
+
+@register_op("norm", inputs=("X", "Scale"), outputs=("Out",),
+             attrs={"epsilon": 1e-10})
+def norm(ctx, ins, attrs):
+    """Cross-channel L2 norm scaling (reference norm_op.cc)."""
+    x = data_of(one(ins, "X"))          # [N, C, H, W]
+    scale = data_of(one(ins, "Scale"))  # [C]
+    l2 = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True)
+                  + attrs["epsilon"])
+    return {"Out": x / l2 * scale.reshape(1, -1, 1, 1)}
+
+
+@register_op("argmax", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1}, not_differentiable=True)
+def argmax(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": jnp.argmax(x, axis=attrs["axis"]).astype(jnp.int64)}
+
+
+@register_op("maxout", inputs=("X",), outputs=("Out",),
+             attrs={"groups": 1})
+def maxout(ctx, ins, attrs):
+    """Channel maxout (reference maxout_op.cc): NCHW, C split into groups."""
+    x = data_of(one(ins, "X"))
+    n, c, h, w = x.shape
+    g = attrs["groups"]
+    return {"Out": jnp.max(x.reshape(n, c // g, g, h, w), axis=2)}

@@ -1,0 +1,53 @@
+"""book/01 fit_a_line — linear regression acceptance test.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_fit_a_line.py:24-102 (train to a loss threshold, then round-trip the
+inference model).  Data: synthetic uci_housing-shaped regression (no
+network egress in this environment).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def make_data(n=512, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, 13).astype(np.float32)
+    w = r.randn(13, 1).astype(np.float32)
+    y = x @ w + 0.3
+    return x, y
+
+
+def test_fit_a_line_converges(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        test_program = main.clone(for_test=True)
+        fluid.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = make_data()
+    first = None
+    loss = None
+    for epoch in range(30):
+        for i in range(0, len(xs), 64):
+            loss, = exe.run(main,
+                            feed={"x": xs[i:i + 64], "y": ys[i:i + 64]},
+                            fetch_list=[avg_cost])
+            if first is None:
+                first = float(loss[0])
+    assert float(loss[0]) < 0.1, f"no convergence: {first} -> {loss[0]}"
+    assert float(loss[0]) < first
+
+    # interpreter and compiled paths agree (inference program: no updates)
+    l_interp, = exe.run(test_program, feed={"x": xs[:64], "y": ys[:64]},
+                        fetch_list=[avg_cost.name], compiled=False)
+    l_comp, = exe.run(test_program, feed={"x": xs[:64], "y": ys[:64]},
+                      fetch_list=[avg_cost.name], compiled=True)
+    np.testing.assert_allclose(l_interp, l_comp, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,41 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax import
+(multi-chip sharding tests run on the virtual mesh; see driver's
+dryrun_multichip protocol) and reset framework global state between tests."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's TPU-tunnel site hook (axon) force-sets
+# jax_platforms="axon,cpu" at interpreter boot, overriding JAX_PLATFORMS.
+# Pin the config back to cpu so tests never block on the tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Fresh default programs / scope / name counters per test."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import executor as executor_mod
+    from paddle_tpu.core import framework as fw
+    from paddle_tpu.core.scope import Scope
+
+    old_main = fw.switch_main_program(fluid.Program())
+    old_startup = fw.switch_startup_program(fluid.Program())
+    fw.reset_unique_names()
+    old_scope = executor_mod._global_scope
+    executor_mod._global_scope = Scope()
+    yield
+    fw.switch_main_program(old_main)
+    fw.switch_startup_program(old_startup)
+    executor_mod._global_scope = old_scope

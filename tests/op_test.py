@@ -1,0 +1,196 @@
+"""Per-op test harness: numpy reference for forward, central finite
+differences vs the analytic grad program for backward.
+
+Modeled on the reference harness
+/root/reference/python/paddle/v2/fluid/tests/op_test.py
+(check_output_with_place :251-335, get_numeric_gradient :97-160,
+check_grad_with_place :379-416) — adapted: the two "places" compared here are
+the interpreter and the XLA-compiled executor (this framework's analogue of
+the CPU/GPU kernel pair discipline).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.core.types import canonical_dtype
+
+
+def _as_feed(value):
+    if isinstance(value, tuple) and len(value) == 2:
+        data, lod = value
+        return LoDTensor(np.asarray(data), lod)
+    return np.asarray(value)
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs, outputs, attrs (optional).
+
+    inputs/outputs: {slot: value} or {slot: [(name, value), ...]} for
+    duplicable slots.  value may be (ndarray, lod) for LoD inputs.
+    """
+
+    op_type: str = None
+    inputs: dict = {}
+    outputs: dict = {}
+    attrs: dict = {}
+
+    # -- program construction ------------------------------------------------
+    def _entries(self, d):
+        out = {}
+        for slot, v in d.items():
+            if isinstance(v, list) and v and isinstance(v[0], tuple) \
+                    and isinstance(v[0][0], str):
+                out[slot] = [(name, _as_feed(val)) for name, val in v]
+            else:
+                out[slot] = [(slot, _as_feed(v))]
+        return out
+
+    def _build(self):
+        self.setUp()
+        main = fluid.Program()
+        startup = fluid.Program()
+        in_entries = self._entries(self.inputs)
+        out_entries = self._entries(self.outputs)
+        feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            op_inputs, op_outputs = {}, {}
+            for slot, pairs in in_entries.items():
+                names = []
+                for name, val in pairs:
+                    data = val.data if isinstance(val, LoDTensor) else val
+                    lod_level = len(val.lod) if isinstance(val, LoDTensor) \
+                        else 0
+                    block.create_var(
+                        name=name, shape=tuple(data.shape),
+                        dtype=canonical_dtype(data.dtype),
+                        lod_level=lod_level)
+                    feed[name] = val
+                    names.append(name)
+                op_inputs[slot] = names
+            for slot, pairs in out_entries.items():
+                op_outputs[slot] = [name for name, _ in pairs]
+            block.append_op(self.op_type, op_inputs, op_outputs,
+                            dict(self.attrs))
+        return main, startup, feed, in_entries, out_entries
+
+    def setUp(self):
+        pass
+
+    # -- forward check -------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, startup, feed, _, out_entries = self._build()
+        fetch_names = [name for slot, pairs in out_entries.items()
+                       if slot not in no_check_set
+                       for name, _ in pairs]
+        expected = {name: val for slot, pairs in out_entries.items()
+                    if slot not in no_check_set
+                    for name, val in pairs}
+        for compiled in (False, True):
+            exe = fluid.Executor(fluid.CPUPlace())
+            outs = exe.run(main, feed=dict(feed), fetch_list=fetch_names,
+                           compiled=compiled)
+            for name, got in zip(fetch_names, outs):
+                exp = expected[name]
+                exp_data = exp.data if isinstance(exp, LoDTensor) else exp
+                got_data = got.data if isinstance(got, LoDTensor) else got
+                np.testing.assert_allclose(
+                    np.asarray(got_data, np.float64),
+                    np.asarray(exp_data, np.float64),
+                    atol=atol, rtol=rtol,
+                    err_msg=f"op {self.op_type} output {name} "
+                            f"(compiled={compiled})")
+                if isinstance(exp, LoDTensor):
+                    assert isinstance(got, LoDTensor), \
+                        f"{name}: expected LoD output"
+                    assert got.lod == exp.lod, \
+                        f"{name}: lod mismatch {got.lod} vs {exp.lod}"
+
+    # -- gradient check ------------------------------------------------------
+    def _diff_output_slots(self):
+        """Output slots that participate in the scalar loss: the op's
+        declared differentiable outputs (registry diff_outputs), or all."""
+        from paddle_tpu.core import registry
+
+        info = registry.get_op_info(self.op_type)
+        if info.diff_outputs is not None:
+            return set(info.diff_outputs)
+        return set(self.outputs.keys())
+
+    def _scalar_loss_program(self):
+        """Program: op -> mean of each differentiable float output -> sum."""
+        main, startup, feed, in_entries, out_entries = self._build()
+        diff_slots = self._diff_output_slots()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            means = []
+            for slot, pairs in out_entries.items():
+                if slot not in diff_slots:
+                    continue
+                for name, val in pairs:
+                    data = val.data if isinstance(val, LoDTensor) else val
+                    if not np.issubdtype(np.asarray(data).dtype,
+                                         np.floating):
+                        continue
+                    m = block.create_var(
+                        name=f"{name}@MEAN", dtype="float32")
+                    block.append_op("mean", {"X": [name]},
+                                    {"Out": [m.name]})
+                    means.append(m.name)
+            loss = block.create_var(name="loss@TEST", dtype="float32")
+            block.append_op("sum", {"X": means}, {"Out": [loss.name]})
+            loss_var = block.var(loss.name)
+            loss_var.shape = (1,)
+        return main, startup, feed, loss_var
+
+    def check_grad(self, inputs_to_check, output_names=None,
+                   max_relative_error=5e-3, numeric_delta=5e-4,
+                   no_grad_set=None):
+        main, startup, feed, loss = self._scalar_loss_program()
+        with fluid.program_guard(main):
+            params_grads = fluid.append_backward(
+                loss, parameter_list=None, no_grad_set=no_grad_set)
+            del params_grads
+        grad_names = [n + "@GRAD" for n in inputs_to_check]
+        exe = fluid.Executor(fluid.CPUPlace())
+        analytic = exe.run(main, feed=dict(feed), fetch_list=grad_names)
+
+        # numeric: central differences on the forward-only program
+        fwd_main, fwd_startup, _, fwd_loss = self._scalar_loss_program()
+        fwd_exe = fluid.Executor(fluid.CPUPlace())
+
+        def eval_loss(f):
+            out, = fwd_exe.run(fwd_main, feed=f,
+                               fetch_list=[fwd_loss.name])
+            return float(np.asarray(out).reshape(-1)[0])
+
+        for in_name, got in zip(inputs_to_check, analytic):
+            base = feed[in_name]
+            base_data = (base.data if isinstance(base, LoDTensor)
+                         else base).astype(np.float64)
+            num = np.zeros_like(base_data, dtype=np.float64)
+            flat = base_data.reshape(-1)
+            for i in range(flat.size):
+                for sgn in (+1, -1):
+                    pert = flat.copy()
+                    pert[i] += sgn * numeric_delta
+                    pert_arr = pert.reshape(base_data.shape).astype(
+                        np.asarray(base_data).dtype)
+                    f = dict(feed)
+                    f[in_name] = (LoDTensor(pert_arr.astype(np.float32),
+                                            base.lod)
+                                  if isinstance(base, LoDTensor)
+                                  else pert_arr.astype(np.float32))
+                    val = eval_loss(f)
+                    num.reshape(-1)[i] += sgn * val / (2 * numeric_delta)
+            got_data = np.asarray(
+                got.data if isinstance(got, LoDTensor) else got,
+                np.float64)
+            abs_max = max(np.abs(num).max(), np.abs(got_data).max(), 1e-3)
+            diff = np.abs(got_data - num).max() / abs_max
+            assert diff <= max_relative_error, (
+                f"op {self.op_type} grad wrt {in_name}: max relative "
+                f"error {diff:.3e} > {max_relative_error:.0e}\n"
+                f"analytic:\n{got_data}\nnumeric:\n{num}")

@@ -1,0 +1,365 @@
+"""First-wave op tests: matmul/mul/elementwise/activations/reductions/
+softmax/losses — numpy-reference forward + finite-difference gradients.
+
+Mirrors reference tests python/paddle/v2/fluid/tests/test_{mul,matmul,
+elementwise_*,activation,softmax,cross_entropy,mean}_op.py.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setUp(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestMulOpFlatten(OpTest):
+    op_type = "mul"
+    attrs = {"x_num_col_dims": 2, "y_num_col_dims": 1}
+
+    def setUp(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 5)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+    attrs = {"transpose_X": False, "transpose_Y": True}
+
+    def setUp(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(5, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y.T}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmulBatched(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(2, 4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+    attrs = {"axis": 1}
+
+    def setUp(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setUp(self):
+        x = rng.rand(3, 4).astype(np.float32) + 1.0
+        y = rng.rand(3, 4).astype(np.float32) + 1.0
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], max_relative_error=1e-2)
+
+
+@pytest.mark.parametrize("act,fn", [
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("exp", np.exp),
+    ("square", np.square),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+    ("reciprocal", lambda x: 1 / x),
+    ("abs", np.abs),
+])
+def test_activation_forward(act, fn):
+    class T(OpTest):
+        op_type = act
+
+        def setUp(self):
+            x = rng.rand(3, 4).astype(np.float32) + 0.5
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x)}
+
+    t = T()
+    t.check_output()
+
+
+@pytest.mark.parametrize("act", ["sigmoid", "tanh", "square", "log",
+                                 "sqrt", "softplus"])
+def test_activation_grad(act):
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+
+    class T(OpTest):
+        op_type = act
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.zeros_like(x)}  # only dtype is used
+
+    T().check_grad(["X"], max_relative_error=1e-2)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setUp(self):
+        x = rng.rand(4, 7).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], max_relative_error=1e-2)
+
+
+class TestMean(OpTest):
+    op_type = "mean"
+
+    def setUp(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray([x.mean()], np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setUp(self):
+        p = rng.rand(4, 5).astype(np.float32) + 0.1
+        p /= p.sum(-1, keepdims=True)
+        label = rng.randint(0, 5, (4, 1)).astype(np.int64)
+        y = -np.log(p[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"X": p, "Label": label}
+        self.outputs = {"Y": y.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], max_relative_error=1e-2)
+
+
+class TestSoftmaxWithCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setUp(self):
+        logits = rng.rand(4, 5).astype(np.float32)
+        label = rng.randint(0, 5, (4, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Logits"], max_relative_error=1e-2)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+    attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+
+    def setUp(self):
+        x = rng.rand(3, 4, 2).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestConcatOp(OpTest):
+    op_type = "concat"
+    attrs = {"axis": 1}
+
+    def setUp(self):
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(2, 4).astype(np.float32)
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a", "b"])
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+    attrs = {"k": 2}
+
+    def setUp(self):
+        x = rng.rand(3, 5).astype(np.float32)
+        idx = np.argsort(-x, axis=1)[:, :2]
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.take_along_axis(x, idx, 1),
+                        "Indices": idx.astype(np.int64)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSgd(OpTest):
+    op_type = "sgd"
+
+    def setUp(self):
+        p = rng.rand(4, 3).astype(np.float32)
+        g = rng.rand(4, 3).astype(np.float32)
+        lr = np.asarray([0.1], np.float32)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAdam(OpTest):
+    op_type = "adam"
+    attrs = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}
+
+    def setUp(self):
+        p = rng.rand(4).astype(np.float32)
+        g = rng.rand(4).astype(np.float32)
+        m1 = rng.rand(4).astype(np.float32)
+        m2 = rng.rand(4).astype(np.float32)
+        lr = np.asarray([0.01], np.float32)
+        b1p = np.asarray([0.9], np.float32)
+        b2p = np.asarray([0.999], np.float32)
+        m1o = 0.9 * m1 + 0.1 * g
+        m2o = 0.999 * m2 + 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        po = p - lr_t * m1o / (np.sqrt(m2o) + 1e-8)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "LearningRate": lr, "Beta1Pow": b1p,
+                       "Beta2Pow": b2p}
+        self.outputs = {"ParamOut": po, "Moment1Out": m1o,
+                        "Moment2Out": m2o}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setUp(self):
+        w = rng.rand(10, 4).astype(np.float32)
+        ids = rng.randint(0, 10, (5, 1)).astype(np.int64)
+        self.inputs = {"Ids": ids, "W": w}
+        self.outputs = {"Out": w[ids.ravel()]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W"])
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+    attrs = {"momentum": 0.9, "epsilon": 1e-5, "is_test": False}
+
+    def setUp(self):
+        x = rng.rand(3, 2, 4, 4).astype(np.float32)
+        scale = rng.rand(2).astype(np.float32)
+        bias = rng.rand(2).astype(np.float32)
+        mean = np.zeros(2, np.float32)
+        var = np.ones(2, np.float32)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = ((x - bm.reshape(1, 2, 1, 1)) /
+             np.sqrt(bv.reshape(1, 2, 1, 1) + 1e-5)
+             * scale.reshape(1, 2, 1, 1) + bias.reshape(1, 2, 1, 1))
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                       "Variance": var}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": 0.9 * mean + 0.1 * bm,
+            "VarianceOut": 0.9 * var + 0.1 * bv,
+            "SavedMean": bm,
+            "SavedVariance": 1.0 / np.sqrt(bv + 1e-5),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+    attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+
+    def setUp(self):
+        x = rng.rand(3, 8).astype(np.float32)
+        scale = rng.rand(8).astype(np.float32)
+        bias = rng.rand(8).astype(np.float32)
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": y, "Mean": mean.ravel(), "Variance": var.ravel()}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], max_relative_error=2e-2)
