@@ -12,4 +12,6 @@ from . import (  # noqa: F401
     norm,
     optimizer_ops,
     reduce,
+    rnn,
+    sequence,
 )

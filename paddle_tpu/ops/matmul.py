@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from ..core.execution import data_of, one
+from ..core.execution import data_of, one, with_lod_of
 from ..core.registry import register_op
 
 
@@ -23,14 +23,16 @@ def _flatten2d(x, num_col_dims):
 @register_op("mul", inputs=("X", "Y"), outputs=("Out",),
              attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
 def mul(ctx, ins, attrs):
-    x = data_of(one(ins, "X"))
+    xv = one(ins, "X")
+    x = data_of(xv)
     y = data_of(one(ins, "Y"))
     xd, yd = attrs["x_num_col_dims"], attrs["y_num_col_dims"]
     x2 = _flatten2d(x, xd)
     y2 = y.reshape(int(np.prod(y.shape[:yd], dtype=np.int64)), -1)
     out = jnp.matmul(x2, y2)
     out_shape = x.shape[:xd] + y.shape[yd:]
-    return {"Out": out.reshape(out_shape)}
+    # rows map 1:1 -> sequence structure survives a projection
+    return {"Out": with_lod_of(xv, out.reshape(out_shape))}
 
 
 @register_op("matmul", inputs=("X", "Y"), outputs=("Out",),

@@ -1,0 +1,277 @@
+"""Recurrent ops: dynamic_lstm(p), dynamic_gru, lstm_unit, gru_unit.
+
+Reference: /root/reference/paddle/fluid/operators/{lstm,lstmp,gru,lstm_unit,
+gru_unit}_op.cc + math/{lstm,gru}_compute and the sequence2batch dynamic
+batching machinery (math/sequence2batch.h, LoDRankTable length-bucketing).
+
+TPU design: instead of the reference's shrinking-batch reorganization
+(sort-by-length + per-timestep variable batch), sequences are padded to
+[B, T, ·] with a static index/mask built from the LoD (host-side, compile
+cached) and the recurrence is ONE `lax.scan` over time with masked state
+updates — XLA fuses the per-step gate math into a few MXU matmuls; no
+dynamic shapes, grads come from scan's native VJP through the generic
+grad op.
+
+Gate layouts (self-consistent; documented for checkpoint portability):
+  lstm Input/Weight 4D blocks: [i, f, c(candidate), o]
+  gru  Input 3D blocks: [u(update), r(reset), c(candidate)];
+       Weight = [D, 2D] (u,r) concat [D, D] (candidate)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, one
+from ..core.lod import LoDTensor
+from ..core.registry import register_op
+from .sequence import lod_to_padded_index, padded_to_lod_index
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _padded(xv: LoDTensor, reverse=False):
+    lod = xv.lod[-1]
+    idx, mask = lod_to_padded_index(lod)
+    if reverse:
+        # reverse each sequence's rows in the gather index (time runs
+        # backwards within the valid region; padding stays at the tail)
+        for i in range(idx.shape[0]):
+            ln = int(mask[i].sum())
+            idx[i, :ln] = idx[i, :ln][::-1]
+    data = jnp.take(xv.data, jnp.asarray(idx).reshape(-1), axis=0)
+    data = data.reshape(idx.shape + xv.data.shape[1:])
+    return data, jnp.asarray(mask), lod
+
+
+def _repack(padded, lod, reverse=False):
+    b, t = padded.shape[:2]
+    if reverse:
+        lens = [lod[i + 1] - lod[i] for i in range(len(lod) - 1)]
+        flat_idx = []
+        for i, ln in enumerate(lens):
+            flat_idx.extend(i * t + (ln - 1 - k) for k in range(ln))
+        flat_idx = np.asarray(flat_idx, np.int32)
+    else:
+        flat_idx = padded_to_lod_index(lod)
+    flat = padded.reshape((b * t,) + padded.shape[2:])
+    return jnp.take(flat, jnp.asarray(flat_idx), axis=0)
+
+
+@register_op("lstm",
+             inputs=("Input", "H0", "C0", "Weight", "Bias"),
+             outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh"},
+             diff_inputs=("Input", "H0", "C0", "Weight", "Bias"),
+             diff_outputs=("Hidden", "Cell"))
+def lstm(ctx, ins, attrs):
+    xv = one(ins, "Input")                   # LoDTensor [N, 4D]
+    w = data_of(one(ins, "Weight"))          # [D, 4D]
+    bias = one(ins, "Bias")                  # [1, 4D] or [1, 7D] w/ peepholes
+    d = w.shape[0]
+    gact = _ACT[attrs["gate_activation"]]
+    cact = _ACT[attrs["cell_activation"]]
+    candact = _ACT[attrs["candidate_activation"]]
+    peep = attrs.get("use_peepholes", True)
+
+    x_pad, mask, lod = _padded(xv, attrs.get("is_reverse", False))
+    bsz = x_pad.shape[0]
+    if bias is not None:
+        b = data_of(bias).reshape(-1)
+        x_pad = x_pad + b[:4 * d]
+        if peep and b.shape[0] >= 7 * d:
+            w_ic, w_fc, w_oc = (b[4 * d:5 * d], b[5 * d:6 * d],
+                                b[6 * d:7 * d])
+        else:
+            w_ic = w_fc = w_oc = jnp.zeros((d,), x_pad.dtype)
+    else:
+        w_ic = w_fc = w_oc = jnp.zeros((d,), x_pad.dtype)
+
+    h0 = one(ins, "H0")
+    c0 = one(ins, "C0")
+    h_init = (data_of(h0) if h0 is not None
+              else jnp.zeros((bsz, d), x_pad.dtype))
+    c_init = (data_of(c0) if c0 is not None
+              else jnp.zeros((bsz, d), x_pad.dtype))
+
+    xs = jnp.swapaxes(x_pad, 0, 1)           # [T, B, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)[:, :, None]  # [T, B, 1]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + h_prev @ w             # [B, 4D]
+        gi, gf, gc, go = jnp.split(gates, 4, axis=1)
+        i = gact(gi + w_ic * c_prev)
+        f = gact(gf + w_fc * c_prev)
+        cand = candact(gc)
+        c = f * c_prev + i * cand
+        o = gact(go + w_oc * c)
+        h = o * cact(c)
+        h = m_t * h + (1 - m_t) * h_prev
+        c = m_t * c + (1 - m_t) * c_prev
+        return (h, c), (h, c, gates)
+
+    (_, _), (hs, cs, gs) = jax.lax.scan(step, (h_init, c_init), (xs, ms))
+    rev = attrs.get("is_reverse", False)
+    hidden = _repack(jnp.swapaxes(hs, 0, 1), lod, rev)
+    cell = _repack(jnp.swapaxes(cs, 0, 1), lod, rev)
+    batch_gate = _repack(jnp.swapaxes(gs, 0, 1), lod, rev)
+    return {"Hidden": LoDTensor(hidden, xv.lod),
+            "Cell": LoDTensor(cell, xv.lod),
+            "BatchGate": LoDTensor(batch_gate, xv.lod),
+            "BatchCellPreAct": LoDTensor(cell, xv.lod)}
+
+
+@register_op("lstmp",
+             inputs=("Input", "H0", "C0", "Weight", "ProjWeight", "Bias"),
+             outputs=("Projection", "Cell", "BatchGate",
+                      "BatchHidden", "BatchCellPreAct"),
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh",
+                    "proj_activation": "tanh"},
+             diff_inputs=("Input", "Weight", "ProjWeight", "Bias"),
+             diff_outputs=("Projection",))
+def lstmp(ctx, ins, attrs):
+    """LSTM with a recurrent projection layer (reference lstmp_op.cc):
+    r_t = proj_act(h_t @ P); the recurrent input is r, not h."""
+    xv = one(ins, "Input")                    # [N, 4D]
+    w = data_of(one(ins, "Weight"))           # [P, 4D]
+    pw = data_of(one(ins, "ProjWeight"))      # [D, P]
+    bias = one(ins, "Bias")
+    d = pw.shape[0]
+    p_dim = pw.shape[1]
+    gact = _ACT[attrs["gate_activation"]]
+    cact = _ACT[attrs["cell_activation"]]
+    candact = _ACT[attrs["candidate_activation"]]
+    pact = _ACT[attrs["proj_activation"]]
+    x_pad, mask, lod = _padded(xv, attrs.get("is_reverse", False))
+    bsz = x_pad.shape[0]
+    if bias is not None:
+        x_pad = x_pad + data_of(bias).reshape(-1)[:4 * d]
+    r_init = jnp.zeros((bsz, p_dim), x_pad.dtype)
+    c_init = jnp.zeros((bsz, d), x_pad.dtype)
+    xs = jnp.swapaxes(x_pad, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[:, :, None]
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + r_prev @ w
+        gi, gf, gc, go = jnp.split(gates, 4, axis=1)
+        i, f = gact(gi), gact(gf)
+        c = f * c_prev + i * candact(gc)
+        h = gact(go) * cact(c)
+        r = pact(h @ pw)
+        r = m_t * r + (1 - m_t) * r_prev
+        c = m_t * c + (1 - m_t) * c_prev
+        return (r, c), (r, c)
+
+    _, (rs, cs) = jax.lax.scan(step, (r_init, c_init), (xs, ms))
+    rev = attrs.get("is_reverse", False)
+    proj = _repack(jnp.swapaxes(rs, 0, 1), lod, rev)
+    cell = _repack(jnp.swapaxes(cs, 0, 1), lod, rev)
+    return {"Projection": LoDTensor(proj, xv.lod),
+            "Cell": LoDTensor(cell, xv.lod),
+            "BatchGate": LoDTensor(proj, xv.lod),
+            "BatchHidden": LoDTensor(proj, xv.lod),
+            "BatchCellPreAct": LoDTensor(cell, xv.lod)}
+
+
+@register_op("gru",
+             inputs=("Input", "H0", "Weight", "Bias"),
+             outputs=("Hidden", "BatchGate", "BatchResetHiddenPrev",
+                      "BatchHidden"),
+             attrs={"is_reverse": False, "gate_activation": "sigmoid",
+                    "activation": "tanh"},
+             diff_inputs=("Input", "H0", "Weight", "Bias"),
+             diff_outputs=("Hidden",))
+def gru(ctx, ins, attrs):
+    xv = one(ins, "Input")                    # [N, 3D]
+    w = data_of(one(ins, "Weight"))           # [D, 3D]: [u,r | cand]
+    bias = one(ins, "Bias")
+    d = w.shape[0]
+    gact = _ACT[attrs["gate_activation"]]
+    act = _ACT[attrs["activation"]]
+    w_ur = w[:, :2 * d]
+    w_c = w[:, 2 * d:]
+    x_pad, mask, lod = _padded(xv, attrs.get("is_reverse", False))
+    bsz = x_pad.shape[0]
+    if bias is not None:
+        x_pad = x_pad + data_of(bias).reshape(-1)
+    h0 = one(ins, "H0")
+    h_init = (data_of(h0) if h0 is not None
+              else jnp.zeros((bsz, d), x_pad.dtype))
+    xs = jnp.swapaxes(x_pad, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[:, :, None]
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        x_ur = x_t[:, :2 * d]
+        x_c = x_t[:, 2 * d:]
+        ur = gact(x_ur + h_prev @ w_ur)
+        u, r = jnp.split(ur, 2, axis=1)
+        cand = act(x_c + (r * h_prev) @ w_c)
+        # reference gru_compute: h = h_prev + u * (cand - h_prev)
+        h = h_prev + u * (cand - h_prev)
+        h = m_t * h + (1 - m_t) * h_prev
+        return h, h
+
+    _, hs = jax.lax.scan(step, h_init, (xs, ms))
+    rev = attrs.get("is_reverse", False)
+    hidden = _repack(jnp.swapaxes(hs, 0, 1), lod, rev)
+    return {"Hidden": LoDTensor(hidden, xv.lod),
+            "BatchGate": LoDTensor(hidden, xv.lod),
+            "BatchResetHiddenPrev": LoDTensor(hidden, xv.lod),
+            "BatchHidden": LoDTensor(hidden, xv.lod)}
+
+
+@register_op("lstm_unit", inputs=("X", "C_prev"), outputs=("C", "H"),
+             attrs={"forget_bias": 0.0})
+def lstm_unit(ctx, ins, attrs):
+    """Single LSTM step on dense tensors (reference lstm_unit_op.cc;
+    gate order i, f, o, c to match its kernel)."""
+    x = data_of(one(ins, "X"))                # [B, 4D]
+    c_prev = data_of(one(ins, "C_prev"))      # [B, D]
+    gi, gf, go, gc = jnp.split(x, 4, axis=1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + attrs.get("forget_bias", 0.0))
+    o = jax.nn.sigmoid(go)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = o * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit",
+             inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+             outputs=("Gate", "ResetHiddenPrev", "Hidden"),
+             attrs={"activation": "tanh", "gate_activation": "sigmoid"},
+             diff_outputs=("Hidden",))
+def gru_unit(ctx, ins, attrs):
+    x = data_of(one(ins, "Input"))            # [B, 3D]
+    h_prev = data_of(one(ins, "HiddenPrev"))  # [B, D]
+    w = data_of(one(ins, "Weight"))           # [D, 3D]
+    d = h_prev.shape[1]
+    bias = one(ins, "Bias")
+    if bias is not None:
+        x = x + data_of(bias).reshape(-1)
+    gact = _ACT[attrs["gate_activation"]]
+    act = _ACT[attrs["activation"]]
+    ur = gact(x[:, :2 * d] + h_prev @ w[:, :2 * d])
+    u, r = jnp.split(ur, 2, axis=1)
+    rh = r * h_prev
+    cand = act(x[:, 2 * d:] + rh @ w[:, 2 * d:])
+    h = h_prev + u * (cand - h_prev)
+    gate = jnp.concatenate([u, r, cand], axis=1)
+    return {"Gate": gate, "ResetHiddenPrev": rh, "Hidden": h}

@@ -1,0 +1,327 @@
+"""Sequence (LoD) ops.
+
+Reference: /root/reference/paddle/fluid/operators/sequence_*.cc,
+lod_reset_op.cc, im2sequence_op.cc and the math/ sequence kernels
+(sequence2batch.h, sequence_pooling.cc, context_project.h).
+
+TPU lowering strategy (SURVEY.md §5.7): the LoD offset table is host-side
+static metadata (part of the compile cache key), so ragged reductions become
+XLA segment ops over precomputed constant segment-id / index arrays, and
+recurrences become padded+masked `lax.scan` (ops/rnn.py).  No per-step
+dynamic shapes — each length bucket compiles once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, many, one
+from ..core.lod import LoDTensor, lod_from_seq_lens
+from ..core.registry import register_op
+
+
+def _seq_lens(lod_level):
+    return [lod_level[i + 1] - lod_level[i] for i in range(len(lod_level) - 1)]
+
+
+def _segment_ids(lod_level) -> np.ndarray:
+    n = lod_level[-1]
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(len(lod_level) - 1):
+        out[lod_level[i]:lod_level[i + 1]] = i
+    return out
+
+
+def lod_to_padded_index(lod_level):
+    """Static (rows->padded) scatter/gather indices.
+
+    Returns (index [B, T] int32 into the packed row axis — 0-padded past each
+    sequence's length, mask [B, T] float32)."""
+    lens = _seq_lens(lod_level)
+    bsz = len(lens)
+    tmax = max(lens) if lens else 0
+    idx = np.zeros((bsz, tmax), dtype=np.int32)
+    mask = np.zeros((bsz, tmax), dtype=np.float32)
+    for i, ln in enumerate(lens):
+        idx[i, :ln] = np.arange(lod_level[i], lod_level[i] + ln)
+        mask[i, :ln] = 1.0
+    return idx, mask
+
+
+def padded_to_lod_index(lod_level):
+    """Static flat gather indices mapping padded [B, T] back to packed rows."""
+    lens = _seq_lens(lod_level)
+    tmax = max(lens) if lens else 0
+    out = []
+    for i, ln in enumerate(lens):
+        out.extend(i * tmax + t for t in range(ln))
+    return np.asarray(out, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# pooling / softmax
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_pool", inputs=("X",), outputs=("Out", "MaxIndex"),
+             attrs={"pooltype": "AVERAGE"}, diff_outputs=("Out",))
+def sequence_pool(ctx, ins, attrs):
+    xv = one(ins, "X")
+    assert isinstance(xv, LoDTensor) and xv.lod, \
+        "sequence_pool requires a LoDTensor input"
+    lod = xv.lod[-1]
+    x = xv.data
+    nseq = len(lod) - 1
+    seg = jnp.asarray(_segment_ids(lod))
+    lens = jnp.asarray(_seq_lens(lod), x.dtype).reshape(-1, 1)
+    pt = attrs["pooltype"].upper()
+    if pt == "SUM":
+        out = jax.ops.segment_sum(x, seg, nseq)
+    elif pt == "AVERAGE":
+        out = jax.ops.segment_sum(x, seg, nseq) / jnp.maximum(lens, 1)
+    elif pt == "SQRT":
+        out = jax.ops.segment_sum(x, seg, nseq) / jnp.sqrt(
+            jnp.maximum(lens, 1))
+    elif pt == "MAX":
+        out = jax.ops.segment_max(x, seg, nseq)
+    elif pt == "LAST":
+        out = x[jnp.asarray([o - 1 for o in lod[1:]])]
+    elif pt == "FIRST":
+        out = x[jnp.asarray(lod[:-1])]
+    else:
+        raise ValueError(f"unknown pooltype {pt}")
+    new_lod = xv.lod[:-1]
+    if new_lod:
+        return {"Out": LoDTensor(out, new_lod), "MaxIndex": None}
+    return {"Out": out, "MaxIndex": None}
+
+
+@register_op("sequence_softmax", inputs=("X",), outputs=("Out",))
+def sequence_softmax(ctx, ins, attrs):
+    xv = one(ins, "X")
+    lod = xv.lod[-1]
+    x = xv.data.reshape(-1)
+    nseq = len(lod) - 1
+    seg = jnp.asarray(_segment_ids(lod))
+    smax = jax.ops.segment_max(x, seg, nseq)
+    e = jnp.exp(x - smax[seg])
+    ssum = jax.ops.segment_sum(e, seg, nseq)
+    return {"Out": LoDTensor((e / ssum[seg]).reshape(xv.data.shape),
+                             xv.lod)}
+
+
+# ---------------------------------------------------------------------------
+# expand / concat / reshape / erase / slice / lod_reset
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_expand", inputs=("X", "Y"), outputs=("Out",),
+             diff_inputs=("X",))
+def sequence_expand(ctx, ins, attrs):
+    """Expand X's sequences to match Y's outer LoD (reference
+    sequence_expand_op.cc): X item i is repeated len(Y seq i) times."""
+    xv = one(ins, "X")
+    yv = one(ins, "Y")
+    y_lod = yv.lod[0]
+    y_lens = _seq_lens(y_lod)
+    x = data_of(xv)
+    if isinstance(xv, LoDTensor) and xv.lod:
+        x_lod = xv.lod[-1]
+        reps, out_lens = [], []
+        for i, yl in enumerate(y_lens):
+            seq_rows = list(range(x_lod[i], x_lod[i + 1]))
+            for _ in range(yl):
+                reps.extend(seq_rows)
+            out_lens.append(yl * len(seq_rows))
+        out_lod = [lod_from_seq_lens(out_lens)]
+    else:
+        reps = []
+        for i, yl in enumerate(y_lens):
+            reps.extend([i] * yl)
+        out_lod = [lod_from_seq_lens(y_lens)]
+    out = jnp.take(x, jnp.asarray(np.asarray(reps, np.int32)), axis=0)
+    return {"Out": LoDTensor(out, out_lod)}
+
+
+@register_op("sequence_concat", inputs=("X",), outputs=("Out",),
+             attrs={"axis": 0, "level": 0})
+def sequence_concat(ctx, ins, attrs):
+    """Concatenate corresponding sequences from each input (reference
+    sequence_concat_op.cc, axis=0 path)."""
+    xs = many(ins, "X")
+    lods = [x.lod[-1] for x in xs]
+    nseq = len(lods[0]) - 1
+    order = []
+    offset = [0]
+    for x in xs:
+        offset.append(offset[-1] + int(x.data.shape[0]))
+    out_lens = []
+    for i in range(nseq):
+        total = 0
+        for k, x in enumerate(xs):
+            lo, hi = lods[k][i], lods[k][i + 1]
+            order.extend(range(offset[k] + lo, offset[k] + hi))
+            total += hi - lo
+        out_lens.append(total)
+    data = jnp.concatenate([x.data for x in xs], axis=0)
+    out = jnp.take(data, jnp.asarray(np.asarray(order, np.int32)), axis=0)
+    return {"Out": LoDTensor(out, [lod_from_seq_lens(out_lens)])}
+
+
+@register_op("sequence_reshape", inputs=("X",), outputs=("Out",),
+             attrs={"new_dim": 1})
+def sequence_reshape(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = xv.data
+    new_dim = attrs["new_dim"]
+    old_dim = x.shape[-1]
+    lod = xv.lod[-1]
+    out_lens = [ln * old_dim // new_dim for ln in _seq_lens(lod)]
+    out = x.reshape(-1, new_dim)
+    return {"Out": LoDTensor(out, [lod_from_seq_lens(out_lens)])}
+
+
+@register_op("sequence_erase", inputs=("X",), outputs=("Out",),
+             attrs={"tokens": []}, not_differentiable=True, host=True)
+def sequence_erase(ctx, ins, attrs):
+    """Remove given tokens (dynamic output size -> host op, reference
+    sequence_erase_op.cc)."""
+    xv = one(ins, "X")
+    x = np.asarray(xv.data)
+    tokens = set(attrs["tokens"])
+    lod = xv.lod[-1]
+    keep_rows, out_lens = [], []
+    for i in range(len(lod) - 1):
+        cnt = 0
+        for r in range(lod[i], lod[i + 1]):
+            if int(x[r].reshape(-1)[0]) not in tokens:
+                keep_rows.append(r)
+                cnt += 1
+        out_lens.append(cnt)
+    out = x[keep_rows] if keep_rows else x[:0]
+    return {"Out": LoDTensor(out, [lod_from_seq_lens(out_lens)])}
+
+
+@register_op("sequence_slice", inputs=("X", "Offset", "Length"),
+             outputs=("Out",), diff_inputs=("X",))
+def sequence_slice(ctx, ins, attrs):
+    xv = one(ins, "X")
+    off = np.asarray(data_of(one(ins, "Offset"))).reshape(-1)
+    length = np.asarray(data_of(one(ins, "Length"))).reshape(-1)
+    lod = xv.lod[-1]
+    rows, out_lens = [], []
+    for i in range(len(lod) - 1):
+        start = lod[i] + int(off[i])
+        rows.extend(range(start, start + int(length[i])))
+        out_lens.append(int(length[i]))
+    out = jnp.take(xv.data, jnp.asarray(np.asarray(rows, np.int32)), axis=0)
+    return {"Out": LoDTensor(out, [lod_from_seq_lens(out_lens)])}
+
+
+@register_op("lod_reset", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"target_lod": []})
+def lod_reset(ctx, ins, attrs):
+    xv = one(ins, "X")
+    x = data_of(xv)
+    y = one(ins, "Y")
+    if y is not None and isinstance(y, LoDTensor) and y.lod:
+        lod = y.lod[-1]
+    elif y is not None:
+        lod = tuple(int(v) for v in np.asarray(data_of(y)).reshape(-1))
+    else:
+        lod = tuple(int(v) for v in attrs["target_lod"])
+    return {"Out": LoDTensor(x, [lod])}
+
+
+@register_op("im2sequence", inputs=("X",), outputs=("Out",),
+             attrs={"kernels": [1, 1], "strides": [1, 1],
+                    "paddings": [0, 0, 0, 0]})
+def im2sequence(ctx, ins, attrs):
+    """Image -> sequence of flattened patches (reference
+    im2sequence_op.cc): output rows are sliding windows, one sequence per
+    image."""
+    x = data_of(one(ins, "X"))  # [N, C, H, W]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs["strides"]
+    pu, pl, pd, pr = (attrs["paddings"] + [0, 0, 0, 0])[:4]
+    x = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [N, C*kh*kw, oh, ow]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    lod = lod_from_seq_lens([oh * ow] * n)
+    return {"Out": LoDTensor(out, [lod])}
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv (context projection)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_conv", inputs=("X", "Filter", "PaddingData"),
+             outputs=("Out",),
+             attrs={"contextLength": 3, "contextStart": -1,
+                    "contextStride": 1},
+             diff_inputs=("X", "Filter"))
+def sequence_conv(ctx, ins, attrs):
+    """Context-window projection per sequence (reference sequence_conv_op.cc
+    + math/context_project.h): gather [ctx_len] neighbor rows (zero outside
+    the sequence), flatten, matmul with Filter [ctx_len*D, M]."""
+    xv = one(ins, "X")
+    w = data_of(one(ins, "Filter"))
+    lod = xv.lod[-1]
+    x = xv.data
+    n, d = x.shape
+    ctx_len = attrs["contextLength"]
+    ctx_start = attrs["contextStart"]
+    # static gather index + validity mask per (row, context offset)
+    idx = np.zeros((n, ctx_len), np.int32)
+    mask = np.zeros((n, ctx_len), np.float32)
+    for i in range(len(lod) - 1):
+        lo, hi = lod[i], lod[i + 1]
+        for r in range(lo, hi):
+            for j in range(ctx_len):
+                src = r + ctx_start + j
+                if lo <= src < hi:
+                    idx[r, j] = src
+                    mask[r, j] = 1.0
+    gathered = jnp.take(x, jnp.asarray(idx), axis=0)  # [N, ctx, D]
+    gathered = gathered * jnp.asarray(mask)[:, :, None]
+    out = gathered.reshape(n, ctx_len * d) @ w
+    return {"Out": LoDTensor(out, xv.lod)}
+
+
+# ---------------------------------------------------------------------------
+# padding helpers exposed as ops (reference math/sequence_padding)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_pad", inputs=("X",), outputs=("Out", "Length"),
+             attrs={"pad_value": 0.0}, diff_outputs=("Out",))
+def sequence_pad(ctx, ins, attrs):
+    xv = one(ins, "X")
+    lod = xv.lod[-1]
+    idx, mask = lod_to_padded_index(lod)
+    out = jnp.take(xv.data, jnp.asarray(idx).reshape(-1), axis=0)
+    out = out.reshape(idx.shape + xv.data.shape[1:])
+    m = jnp.asarray(mask).reshape(mask.shape + (1,) * (out.ndim - 2))
+    pad = jnp.asarray(attrs["pad_value"], out.dtype)
+    out = out * m + pad * (1 - m)
+    return {"Out": out,
+            "Length": jnp.asarray(_seq_lens(lod), jnp.int32)}
+
+
+@register_op("sequence_unpad", inputs=("X", "Length"), outputs=("Out",),
+             diff_inputs=("X",))
+def sequence_unpad(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))  # [B, T, ...]
+    lens = [int(v) for v in np.asarray(data_of(one(ins, "Length")))]
+    lod = lod_from_seq_lens(lens)
+    flat_idx = padded_to_lod_index(lod)
+    flat = x.reshape((-1,) + x.shape[2:])
+    out = jnp.take(flat, jnp.asarray(flat_idx), axis=0)
+    return {"Out": LoDTensor(out, [lod])}

@@ -1,0 +1,88 @@
+"""book/05 understand_sentiment — LSTM / conv text classification.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_understand_sentiment.py (stacked-LSTM and conv variants over IMDB).
+Synthetic data: class determined by which token range dominates a
+variable-length sequence — exercises the LoD feed path (DataFeeder),
+embedding, dynamic_lstm over ragged batches, and sequence pooling.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+DICT = 40
+EMB = 16
+HID = 32
+CLS = 2
+
+
+def _lstm_net(data, label):
+    emb = fluid.layers.embedding(input=data, size=[DICT, EMB])
+    fc1 = fluid.layers.fc(input=emb, size=HID * 4)
+    lstm_h, _ = fluid.layers.dynamic_lstm(input=fc1, size=HID * 4,
+                                          use_peepholes=False)
+    lstm_max = fluid.layers.sequence_pool(input=lstm_h, pool_type="max")
+    prediction = fluid.layers.fc(input=lstm_max, size=CLS, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc
+
+
+def _conv_net(data, label):
+    emb = fluid.layers.embedding(input=data, size=[DICT, EMB])
+    conv = fluid.layers.sequence_conv(input=emb, num_filters=HID,
+                                      filter_size=3, act="tanh")
+    pooled = fluid.layers.sequence_pool(input=conv, pool_type="max")
+    prediction = fluid.layers.fc(input=pooled, size=CLS, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc
+
+
+def _make_batch(r, n=16):
+    rows = []
+    for _ in range(n):
+        ln = int(r.randint(3, 9))
+        cls = int(r.randint(0, CLS))
+        lo, hi = (0, DICT // 2) if cls == 0 else (DICT // 2, DICT)
+        seq = r.randint(lo, hi, (ln,)).astype(np.int64)
+        rows.append((seq, [cls]))
+    return rows
+
+
+def _run(net_fn, steps=120, lr=0.05):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, acc = net_fn(data, label)
+        fluid.Adam(learning_rate=lr).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = fluid.DataFeeder(feed_list=[data, label],
+                              place=fluid.CPUPlace())
+    r = np.random.RandomState(0)
+    # fixed bucket of batches so LoD shapes cycle through a small set of
+    # compiled executables (the bucketing discipline)
+    batches = [_make_batch(r) for _ in range(4)]
+    accs = []
+    for step in range(steps):
+        batch = batches[step % len(batches)]
+        loss, a = exe.run(main, feed=feeder.feed(batch),
+                          fetch_list=[avg_cost, acc])
+        accs.append(float(a[0]))
+    return np.mean(accs[-8:])
+
+
+def test_sentiment_lstm():
+    final_acc = _run(_lstm_net)
+    assert final_acc > 0.9, f"LSTM sentiment acc too low: {final_acc}"
+
+
+def test_sentiment_conv():
+    final_acc = _run(_conv_net)
+    assert final_acc > 0.9, f"conv sentiment acc too low: {final_acc}"

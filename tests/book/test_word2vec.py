@@ -1,0 +1,51 @@
+"""book/04 word2vec — N-gram language model acceptance test.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_word2vec.py (4-gram context -> embeddings -> concat fc -> softmax).
+Synthetic corpus (zero egress): token t+1 follows token t deterministically
+modulo the dict size, so the model can drive the loss near zero.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+DICT = 32
+EMB = 16
+
+
+def test_word2vec_converges():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [
+            fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+            for i in range(4)
+        ]
+        next_word = fluid.layers.data(name="next", shape=[1], dtype="int64")
+        embeds = [
+            fluid.layers.embedding(
+                input=w, size=[DICT, EMB],
+                param_attr={"name": "shared_w"})
+            for w in words
+        ]
+        concat = fluid.layers.concat(input=embeds, axis=1)
+        hidden = fluid.layers.fc(input=concat, size=64, act="sigmoid")
+        predict = fluid.layers.fc(input=hidden, size=DICT, act="softmax")
+        cost = fluid.layers.cross_entropy(input=predict, label=next_word)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    r = np.random.RandomState(0)
+    first = last = None
+    for step in range(300):
+        base = r.randint(0, DICT, (64, 1)).astype(np.int64)
+        feed = {f"w{i}": (base + i) % DICT for i in range(4)}
+        feed["next"] = (base + 4) % DICT
+        loss, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        if first is None:
+            first = float(loss[0])
+        last = float(loss[0])
+    assert last < 0.3, f"word2vec did not converge: {first} -> {last}"
