@@ -1,0 +1,3 @@
+"""Model zoo built on the layers DSL (reference book + benchmark models)."""
+from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
+from .vgg import vgg, vgg16_bn_drop  # noqa: F401

@@ -1,0 +1,94 @@
+"""ResNet model family built through the layers DSL.
+
+Reference model defs: /root/reference/benchmark/paddle/image/resnet.py
+(224x224 ImageNet, layer_num 50/101/152) and
+/root/reference/python/paddle/v2/fluid/tests/book/test_image_classification_train.py
+(resnet_cifar10).  Rebuilt fluid-style: conv2d + batch_norm + elementwise_add
+residual blocks; XLA fuses BN+ReLU into the conv epilogues on TPU.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["resnet_imagenet", "resnet_cifar10"]
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                  bias_attr=False, is_test=False):
+    conv = layers.conv2d(input=input, num_filters=ch_out,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, act=None, bias_attr=bias_attr)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+
+
+def _shortcut(input, ch_in, ch_out, stride, is_test=False):
+    if stride != 1 or ch_in != ch_out:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             is_test=is_test)
+    return input
+
+
+def _add_relu(a, b):
+    s = layers.elementwise_add(a, b)
+    return layers.relu(s)
+
+
+def basicblock(input, ch_in, ch_out, stride, is_test=False):
+    short = _shortcut(input, ch_in, ch_out, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return _add_relu(short, conv2)
+
+
+def bottleneck(input, ch_in, ch_out, stride, is_test=False):
+    short = _shortcut(input, ch_in, ch_out * 4, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test)
+    return _add_relu(short, conv3)
+
+
+def _layer_warp(block_func, input, ch_in, ch_out, count, stride,
+                is_test=False):
+    res = block_func(input, ch_in, ch_out, stride, is_test)
+    for _ in range(1, count):
+        ch_in_cur = ch_out * (4 if block_func is bottleneck else 1)
+        res = block_func(res, ch_in_cur, ch_out, 1, is_test)
+    return res
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    """ResNet-50/101/152 (bottleneck) for 224x224 NCHW input."""
+    cfg = {
+        50: ([3, 4, 6, 3], bottleneck),
+        101: ([3, 4, 23, 3], bottleneck),
+        152: ([3, 8, 36, 3], bottleneck),
+        18: ([2, 2, 2, 2], basicblock),
+        34: ([3, 4, 6, 3], basicblock),
+    }
+    stages, block = cfg[depth]
+    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    pool1 = layers.pool2d(input=conv1, pool_size=3, pool_stride=2,
+                          pool_padding=1, pool_type="max")
+    expansion = 4 if block is bottleneck else 1
+    res = pool1
+    ch_in = 64
+    for i, (count, ch_out) in enumerate(zip(stages, [64, 128, 256, 512])):
+        stride = 1 if i == 0 else 2
+        res = _layer_warp(block, res, ch_in, ch_out, count, stride, is_test)
+        ch_in = ch_out * expansion
+    pool2 = layers.pool2d(input=res, pool_type="avg", global_pooling=True)
+    return layers.fc(input=pool2, size=class_dim, act="softmax")
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    """CIFAR ResNet (basicblock), depth = 6n+2 (reference book model)."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, 16, 3, 1, 1, is_test=is_test)
+    res1 = _layer_warp(basicblock, conv1, 16, 16, n, 1, is_test)
+    res2 = _layer_warp(basicblock, res1, 16, 32, n, 2, is_test)
+    res3 = _layer_warp(basicblock, res2, 32, 64, n, 2, is_test)
+    pool = layers.pool2d(input=res3, pool_type="avg", global_pooling=True)
+    return layers.fc(input=pool, size=class_dim, act="softmax")

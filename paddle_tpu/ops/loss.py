@@ -28,14 +28,15 @@ def _take_label(x, label):
 def cross_entropy(ctx, ins, attrs):
     xv = one(ins, "X")
     x = data_of(xv)
+    # additive eps (not clamp): keeps a finite, recovery-capable gradient
+    # -1/(p+eps) when the softmax saturates to p≈0 on the true class
     eps = jnp.asarray(1e-10 if x.dtype == jnp.float32 else 1e-20, x.dtype)
     if attrs.get("soft_label"):
         lbl = data_of(one(ins, "Label"))
-        y = -jnp.sum(lbl * jnp.log(jnp.maximum(x, eps)), axis=-1,
-                     keepdims=True)
+        y = -jnp.sum(lbl * jnp.log(x + eps), axis=-1, keepdims=True)
     else:
         picked, _ = _take_label(x, one(ins, "Label"))
-        y = -jnp.log(jnp.maximum(picked, eps))
+        y = -jnp.log(picked + eps)
     return {"Y": with_lod_of(xv, y)}
 
 

@@ -1,0 +1,68 @@
+"""book/03 image_classification — VGG + ResNet on CIFAR-shaped data.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_image_classification_train.py (vgg16_bn_drop and resnet_cifar10,
+trained until loss threshold).  Synthetic CIFAR: class templates + noise;
+smaller nets than the book (depth-8 resnet, 1-block vgg stack) keep CPU
+test time bounded while exercising conv/batch_norm/dropout/residual paths.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.resnet import resnet_cifar10
+
+CLS = 4
+
+
+def _make_data(r, n=32):
+    templates = np.random.RandomState(5).rand(CLS, 3, 16, 16).astype(
+        np.float32)
+    y = r.randint(0, CLS, (n, 1)).astype(np.int64)
+    x = templates[y.ravel()] + 0.05 * r.randn(n, 3, 16, 16).astype(
+        np.float32)
+    return x, y
+
+
+def _train(build, steps=40, lr=0.01):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.layers.data(name="pixel", shape=[3, 16, 16],
+                                   dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        predict = build(images)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=predict, label=label)
+        fluid.Adam(learning_rate=lr).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    accs = []
+    for _ in range(steps):
+        x, y = _make_data(r)
+        _, a = exe.run(main, feed={"pixel": x, "label": y},
+                       fetch_list=[avg_cost, acc])
+        accs.append(float(a[0]))
+    return float(np.mean(accs[-5:]))
+
+
+def _small_vgg(images):
+    from paddle_tpu import nets
+
+    conv1 = nets.img_conv_group(
+        input=images, pool_size=2, pool_stride=2,
+        conv_num_filter=[16, 16], conv_filter_size=3, conv_act="relu",
+        conv_with_batchnorm=True, conv_batchnorm_drop_rate=[0.0, 0.0])
+    fc1 = fluid.layers.fc(input=conv1, size=64, act="relu")
+    return fluid.layers.fc(input=fc1, size=CLS, act="softmax")
+
+
+def test_image_classification_vgg():
+    acc = _train(_small_vgg, steps=60, lr=0.002)
+    assert acc > 0.9, f"vgg acc too low: {acc}"
+
+
+def test_image_classification_resnet():
+    acc = _train(lambda img: resnet_cifar10(img, class_dim=CLS, depth=8),
+                 steps=50)
+    assert acc > 0.85, f"resnet acc too low: {acc}"

@@ -1,0 +1,71 @@
+"""book/02 recognize_digits — MLP and conv-pool MNIST classifiers.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_recognize_digits_mlp.py / test_recognize_digits_conv.py.
+Synthetic MNIST-shaped data: each class is a distinct fixed template plus
+noise, learnable to high accuracy in a few steps.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import nets
+
+CLS = 10
+
+
+def _make_data(r, n=64, conv=False):
+    templates = np.random.RandomState(123).rand(CLS, 784).astype(np.float32)
+    y = r.randint(0, CLS, (n, 1)).astype(np.int64)
+    x = templates[y.ravel()] + 0.1 * r.randn(n, 784).astype(np.float32)
+    if conv:
+        x = x.reshape(n, 1, 28, 28)
+    return x, y
+
+
+def _train(build_net, conv, steps, lr=0.01):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        shape = [1, 28, 28] if conv else [784]
+        img = fluid.layers.data(name="img", shape=shape, dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        prediction = build_net(img)
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        fluid.Adam(learning_rate=lr).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    accs = []
+    for _ in range(steps):
+        x, y = _make_data(r, conv=conv)
+        _, a = exe.run(main, feed={"img": x, "label": y},
+                       fetch_list=[avg_cost, acc])
+        accs.append(float(a[0]))
+    return float(np.mean(accs[-5:]))
+
+
+def _mlp(img):
+    h1 = fluid.layers.fc(input=img, size=128, act="relu")
+    h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+    return fluid.layers.fc(input=h2, size=CLS, act="softmax")
+
+
+def _conv_net(img):
+    conv_pool_1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    return fluid.layers.fc(input=conv_pool_2, size=CLS, act="softmax")
+
+
+def test_recognize_digits_mlp():
+    acc = _train(_mlp, conv=False, steps=60)
+    assert acc > 0.95, f"MLP digits acc too low: {acc}"
+
+
+def test_recognize_digits_conv():
+    acc = _train(_conv_net, conv=True, steps=40)
+    assert acc > 0.9, f"conv digits acc too low: {acc}"
