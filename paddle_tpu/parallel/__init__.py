@@ -1,0 +1,24 @@
+"""Parallelism package: mesh, SPMD ParallelExecutor, collectives,
+ring/Ulysses attention, sharded embedding (SURVEY.md §2.5/§5.8 rebuilt as
+ICI-native XLA collectives)."""
+from . import collective  # noqa: F401  (registers c_* ops)
+from .collective import (  # noqa: F401
+    shard_embedding_table,
+    sharded_embedding_grad,
+    sharded_embedding_lookup,
+)
+from .executor import DistributeTranspiler, ParallelExecutor  # noqa: F401
+from .mesh import (  # noqa: F401
+    NamedSharding,
+    PartitionSpec,
+    data_sharding,
+    get_places,
+    init_distributed,
+    make_mesh,
+    replicated,
+)
+from .ring_attention import (  # noqa: F401
+    all_to_all_attention,
+    attention_reference,
+    ring_attention,
+)

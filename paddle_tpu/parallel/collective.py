@@ -1,0 +1,142 @@
+"""Collective ops + sharded embedding.
+
+IR-level collectives (the analogue of the reference's NCCL op family,
+/root/reference/paddle/fluid/operators/nccl_op.cc ncclAllReduce/ncclReduce/
+ncclBcast, and the send/recv pserver path): registered as ordinary ops so
+transpiled programs can express them; their lowerings call `jax.lax.p*`
+primitives, valid when the block is executed under `shard_map` (spmd mode).
+
+`sharded_embedding` is the large-model sparse-embedding capability
+(reference: MAT_SPARSE_ROW_PREFETCH / SparseRowMatrix remote prefetch,
+doc/design/cluster_train/large_model_dist_train.md): the table is
+row-sharded over a mesh axis; lookups psum the per-shard partial gathers
+(each shard contributes rows it owns), so only touched rows move — over ICI
+instead of a pserver RPC.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+
+__all__ = ["sharded_embedding_lookup", "shard_embedding_table"]
+
+
+@register_op("c_allreduce_sum", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp"})
+def c_allreduce_sum(ctx, ins, attrs):
+    return {"Out": jax.lax.psum(data_of(one(ins, "X")), attrs["ring_id"])}
+
+
+@register_op("c_allreduce_mean", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp"})
+def c_allreduce_mean(ctx, ins, attrs):
+    return {"Out": jax.lax.pmean(data_of(one(ins, "X")), attrs["ring_id"])}
+
+
+@register_op("c_allreduce_max", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp"})
+def c_allreduce_max(ctx, ins, attrs):
+    return {"Out": jax.lax.pmax(data_of(one(ins, "X")), attrs["ring_id"])}
+
+
+@register_op("c_allgather", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp", "axis": 0})
+def c_allgather(ctx, ins, attrs):
+    return {"Out": jax.lax.all_gather(
+        data_of(one(ins, "X")), attrs["ring_id"],
+        axis=attrs.get("axis", 0), tiled=True)}
+
+
+@register_op("c_reducescatter", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp", "axis": 0})
+def c_reducescatter(ctx, ins, attrs):
+    return {"Out": jax.lax.psum_scatter(
+        data_of(one(ins, "X")), attrs["ring_id"],
+        scatter_dimension=attrs.get("axis", 0), tiled=True)}
+
+
+@register_op("c_broadcast", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp", "root": 0})
+def c_broadcast(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    idx = jax.lax.axis_index(attrs["ring_id"])
+    root_val = jax.lax.psum(
+        jnp.where(idx == attrs.get("root", 0), x, jnp.zeros_like(x)),
+        attrs["ring_id"])
+    return {"Out": root_val}
+
+
+@register_op("c_ppermute", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": "dp", "shift": 1})
+def c_ppermute(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    axis = attrs["ring_id"]
+    n = jax.lax.axis_size(axis)
+    s = attrs.get("shift", 1)
+    perm = [(j, (j + s) % n) for j in range(n)]
+    return {"Out": jax.lax.ppermute(x, axis, perm)}
+
+
+# ---------------------------------------------------------------------------
+# sharded embedding
+# ---------------------------------------------------------------------------
+
+
+def shard_embedding_table(mesh: Mesh, table, axis: str = "mp"):
+    """Place an embedding table row-sharded over `axis`."""
+    return jax.device_put(table, NamedSharding(mesh, P(axis)))
+
+
+def sharded_embedding_lookup(ids, table, mesh: Mesh, axis: str = "mp"):
+    """ids: [n] int32 global; table: [vocab, dim] row-sharded over `axis`.
+    Each shard gathers the ids it owns (others contribute zeros) and a psum
+    over `axis` assembles full vectors."""
+    vocab = table.shape[0]
+    n_shards = mesh.shape[axis]
+    rows_per = vocab // n_shards
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(axis, None)),
+        out_specs=P())
+    def _lookup(ids_l, tbl_l):
+        shard = jax.lax.axis_index(axis)
+        lo = shard * rows_per
+        local = ids_l - lo
+        owned = (local >= 0) & (local < rows_per)
+        safe = jnp.clip(local, 0, rows_per - 1)
+        vecs = jnp.take(tbl_l, safe, axis=0)
+        vecs = jnp.where(owned[:, None], vecs, jnp.zeros_like(vecs))
+        return jax.lax.psum(vecs, axis)
+
+    return _lookup(ids, table)
+
+
+def sharded_embedding_grad(ids, grad_out, vocab, mesh: Mesh,
+                           axis: str = "mp"):
+    """Scatter per-row grads back to the owning shards (SelectedRows ->
+    shard-local dense scatter-add), returning a row-sharded dense grad."""
+    n_shards = mesh.shape[axis]
+    rows_per = vocab // n_shards
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(axis, None))
+    def _scatter(ids_l, g_l):
+        shard = jax.lax.axis_index(axis)
+        lo = shard * rows_per
+        local = ids_l - lo
+        owned = (local >= 0) & (local < rows_per)
+        safe = jnp.clip(local, 0, rows_per - 1)
+        g = jnp.where(owned[:, None], g_l, jnp.zeros_like(g_l))
+        return jnp.zeros((rows_per, g_l.shape[1]), g_l.dtype
+                         ).at[safe].add(g)
+
+    return _scatter(ids, grad_out)

@@ -1,0 +1,208 @@
+"""ParallelExecutor: SPMD execution of a Program over a device mesh.
+
+This one component replaces ALL of the reference's parallelism machinery
+(SURVEY.md §2.5):
+  * `parallel_do_op` worker threads + per-place scopes + grad sum
+    (/root/reference/paddle/fluid/operators/parallel_do_op.cc:113-346)
+    -> batch dp-sharded into one jit; XLA splits the work per device.
+  * NCCL allreduce ops (operators/nccl_op.cu.cc, doc/design/paddle_nccl.md)
+    -> the gradient all-reduce is inserted BY XLA's sharding propagation
+    (replicated params x dp-sharded batch), riding ICI.
+  * DistributeTranspiler + gRPC pserver (distribute_transpiler.py:133,
+    operators/listen_and_serv_op.cc) -> `shard_optimizer_states=True`
+    partitions optimizer accumulators across the mesh (the pserver
+    block-shard analogue, ZeRO-1 numerics == sync pserver SGD), with
+    reduce-scatter/all-gather chosen by the compiler.
+  * MultiGradientMachine ring (gserver/gradientmachines/MultiGradientMachine.h)
+    -> same allreduce, no hand-rolled ring.
+
+Tensor-parallel layers: pass `param_shardings={param_name: PartitionSpec}`
+to split weight matrices over a 'tp'/'mp' axis; activations follow by
+propagation.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.executor import CPUPlace, Executor, program_to_fn
+from ..core.framework import Variable, default_startup_program
+from ..core.scope import Scope
+from .mesh import make_mesh
+
+__all__ = ["ParallelExecutor", "DistributeTranspiler"]
+
+
+class ParallelExecutor:
+    def __init__(
+        self,
+        program,
+        feed_names: Sequence[str],
+        fetch_list: Sequence,
+        mesh,
+        startup_program=None,
+        batch_axis: str = "dp",
+        param_shardings: Optional[Dict[str, P]] = None,
+        shard_optimizer_states: bool = False,
+        seed: int = 0,
+    ):
+        if isinstance(mesh, dict):
+            mesh = make_mesh(mesh)
+        self.mesh: Mesh = mesh
+        self.batch_axis = batch_axis
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in fetch_list
+        ]
+        self._fn = program_to_fn(program, self.feed_names, self.fetch_names)
+        self._seed = seed
+        self._step = 0
+        param_shardings = dict(param_shardings or {})
+
+        # --- initialize states on host, then place with shardings ---------
+        startup = startup_program or default_startup_program()
+        scope = Scope()
+        Executor(CPUPlace()).run(startup, scope=scope)
+
+        param_names = {
+            v.name for v in program.global_block().all_parameters()
+        }
+        self._state_shardings = {}
+        states = {}
+        for n in self._fn.state_in_names:
+            val = scope.find_var(n)
+            if val is None:
+                raise RuntimeError(
+                    f"state var {n!r} not produced by the startup program")
+            spec = self._spec_for(n, np.asarray(val), param_names,
+                                  param_shardings,
+                                  shard_optimizer_states)
+            sh = NamedSharding(self.mesh, spec)
+            states[n] = jax.device_put(np.asarray(val), sh)
+            self._state_shardings[n] = sh
+        self._states = states
+
+        data_sh = NamedSharding(self.mesh, P(self.batch_axis))
+        self._data_sharding = data_sh
+
+        fn = self._fn
+
+        def step(feeds, states, key):
+            fetches, new_states = fn(feeds, states, key)
+            return fetches, new_states
+
+        self._jit_step = jax.jit(
+            step,
+            out_shardings=(None, self._out_state_shardings()),
+            donate_argnums=(1,),
+        )
+
+    # -- sharding policy -----------------------------------------------------
+    def _spec_for(self, name, val, param_names, param_shardings,
+                  shard_opt) -> P:
+        # explicit spec wins (params and their accumulators)
+        for pname, spec in param_shardings.items():
+            if name == pname:
+                return spec
+            if name.startswith(pname + "_") and name.endswith("_acc"):
+                # accumulator inherits its parameter's sharding
+                if tuple(val.shape) and len(spec) <= len(val.shape):
+                    return spec
+        if shard_opt and name.endswith("_acc") and val.ndim >= 1:
+            # ZeRO-1 / pserver-shard analogue: split accumulator dim 0
+            dp = self.mesh.shape[self.batch_axis]
+            if val.shape[0] % dp == 0 and val.shape[0] >= dp:
+                return P(self.batch_axis)
+        return P()
+
+    def _out_state_shardings(self):
+        return {n: self._state_shardings[n]
+                for n in sorted(set(self._fn.state_in_names)
+                                | set(self._fn.state_out_names))
+                if n in self._state_shardings} or None
+
+    # -- execution -----------------------------------------------------------
+    def run(self, feed: Dict, fetch_list=None, return_numpy=True):
+        fetch_names = ([v.name if isinstance(v, Variable) else str(v)
+                        for v in fetch_list]
+                       if fetch_list is not None else self.fetch_names)
+        assert fetch_names == self.fetch_names, \
+            "fetch_list must match construction-time fetch_list"
+        feeds = {
+            n: jax.device_put(np.asarray(v), self._data_sharding)
+            for n, v in feed.items()
+        }
+        key = jax.random.fold_in(jax.random.key(self._seed), self._step)
+        self._step += 1
+        fetches, self._states = self._jit_step(feeds, self._states, key)
+        out = [fetches[n] for n in fetch_names]
+        if return_numpy:
+            out = [np.asarray(v) for v in out]
+        return out
+
+    def state(self, name, return_numpy=True):
+        v = self._states[name]
+        return np.asarray(v) if return_numpy else v
+
+    def set_state(self, name, value):
+        self._states[name] = jax.device_put(
+            np.asarray(value), self._state_shardings[name])
+
+
+class DistributeTranspiler:
+    """API-compatible entry point for the reference's transpiler workflow
+    (/root/reference/python/paddle/v2/fluid/distribute_transpiler.py:133).
+
+    The reference rewrites the program into trainer (split/send/concat) and
+    per-pserver (listen_and_serv + optimize-block) programs.  On a TPU mesh
+    none of that rewriting exists as program surgery: `transpile` records
+    the mesh layout, `get_trainer_program` returns the ORIGINAL program
+    (configuration-as-compilation — sharding is an execution property), and
+    `build_executor` yields a ParallelExecutor where
+      * grad aggregation = psum over the dp axis (was: send + fan-in barrier
+        + sum at the pserver, listen_and_serv_op.cc:114-153)
+      * optimizer-state sharding = ZeRO-1 accumulator partitioning (was:
+        ~1024-element param blocks round-robined over pservers,
+        distribute_transpiler.py:91-132)
+    """
+
+    def __init__(self):
+        self._mesh_axes = None
+        self._program = None
+        self._shard_opt = True
+
+    def transpile(self, optimize_ops=None, params_grads=None,
+                  trainers=1, pservers: str = "", program=None,
+                  mesh_axes: Optional[Dict[str, int]] = None,
+                  shard_optimizer_states: bool = True):
+        from ..core.framework import default_main_program
+
+        self._program = program or default_main_program()
+        if mesh_axes is None:
+            # reference-style arg mapping: `trainers` data-parallel workers
+            mesh_axes = {"dp": trainers}
+        self._mesh_axes = mesh_axes
+        self._shard_opt = shard_optimizer_states
+
+    def get_trainer_program(self):
+        return self._program
+
+    def get_pserver_program(self, endpoint=None):
+        """No pserver role exists on a TPU mesh; kept for API parity."""
+        return self._program
+
+    def get_startup_program(self, *a, **kw):
+        return default_startup_program()
+
+    def build_executor(self, feed_names, fetch_list, startup_program=None,
+                       **kw) -> ParallelExecutor:
+        return ParallelExecutor(
+            self._program, feed_names, fetch_list,
+            mesh=self._mesh_axes, startup_program=startup_program,
+            shard_optimizer_states=self._shard_opt, **kw)

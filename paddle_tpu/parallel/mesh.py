@@ -1,0 +1,66 @@
+"""Device-mesh utilities — the TPU answer to device enumeration and
+process-group setup.
+
+Replaces (SURVEY.md §2.5/§5.8): `get_places_op`
+(/root/reference/paddle/fluid/operators/get_places_op.cc), NCCL communicator
+init (operators/nccl_op.cc ncclInit), pserver endpoint lists
+(distribute_transpiler.py pserver_endpoints) and etcd membership
+(go/pserver/etcd_client.go).  On TPU, membership is the jax distributed
+coordination service and topology is a `jax.sharding.Mesh` whose axes map
+onto ICI; DCN-spanning meshes put the slowest-varying axis across hosts.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "get_places", "data_sharding", "replicated",
+           "init_distributed", "PartitionSpec", "NamedSharding"]
+
+
+def get_places(device_count: Optional[int] = None):
+    """Device list (reference get_places_op / fluid.layers.get_places)."""
+    devs = jax.devices()
+    return devs[:device_count] if device_count else devs
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None
+              ) -> Mesh:
+    """Build a named mesh, e.g. make_mesh({'dp': 2, 'tp': 4}).
+
+    Axis order follows dict order: earlier axes vary slowest — put the
+    inter-host (DCN) axis first, ICI axes last, so collectives on the
+    fast-varying axes ride ICI neighbors."""
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    devs = list(devices if devices is not None else jax.devices())[:n]
+    if len(devs) < n:
+        raise ValueError(f"mesh needs {n} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs).reshape(shape), names)
+
+
+def data_sharding(mesh: Mesh, batch_axis: str = "dp") -> NamedSharding:
+    """Shard dim-0 (batch) over `batch_axis`, replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(batch_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: int = 1, process_id: int = 0):
+    """Multi-host bring-up (replaces etcd registration + gRPC endpoints):
+    wires this process into the jax coordination service.  No-op for
+    single-process runs."""
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)

@@ -1,0 +1,144 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has NO attention kernel or sequence parallelism (SURVEY.md
+§5.7: long sequences are handled by LoD bucketing + dynamic RNN); this is
+the TPU-native long-context capability the rebuild adds as first-class:
+queries stay resident per shard while key/value blocks rotate around the
+ring via `ppermute` (one ICI hop per step), accumulating streaming-softmax
+(flash-style) partial results — memory O(seq/N) per chip, compute fully
+overlapped with neighbor transfers by XLA's async collectives.
+
+Also provides `all_to_all_attention` (DeepSpeed-Ulysses layout): heads
+scatter / sequence gather so each chip computes full-sequence attention for
+a head subset — cheaper at moderate sequence lengths.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "all_to_all_attention", "attention_reference"]
+
+
+def _block_attn(q, k, v, scale, causal, q_off, kv_off):
+    """One (q-block, kv-block) tile: returns (unnormalized out, running max,
+    running denom) for streaming softmax."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        ql = q.shape[1]
+        kl = k.shape[1]
+        qi = q_off + jnp.arange(ql)[:, None]
+        ki = kv_off + jnp.arange(kl)[None, :]
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                     # [b,h,q]
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    denom = jnp.sum(p, axis=-1)                 # [b,h,q]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)   # unnormalized
+    return out, m_safe, denom
+
+
+def _merge(acc, new):
+    """Merge two streaming-softmax partials (flash-attention combine)."""
+    out_a, m_a, d_a = acc
+    out_n, m_n, d_n = new
+    m = jnp.maximum(m_a, m_n)
+    ca = jnp.exp(m_a - m)
+    cn = jnp.exp(m_n - m)
+    out = out_a * ca.transpose(0, 2, 1)[..., None] \
+        + out_n * cn.transpose(0, 2, 1)[..., None]
+    return out, m, d_a * ca + d_n * cn
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = False, scale: float = None):
+    """Attention with sequence sharded over `axis`.
+
+    q/k/v: [batch, seq, heads, dim] GLOBAL arrays (sharded or to-be-sharded
+    on dim 1).  Returns the attention output with the same layout."""
+    n = mesh.shape[axis]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    seq = q.shape[1]
+    assert seq % n == 0, "seq length must divide the sp axis"
+    blk = seq // n
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None))
+    def _ring(q_blk, k_blk, v_blk):
+        idx = jax.lax.axis_index(axis)
+        q_off = idx * blk
+
+        def body(i, carry):
+            acc, k_cur, v_cur, src = carry
+            kv_off = src * blk
+            new = _block_attn(q_blk, k_cur, v_cur, scale, causal,
+                              q_off, kv_off)
+            acc = _merge(acc, new)
+            # rotate kv to the next ring position (one ICI hop)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return acc, k_nxt, v_nxt, (src - 1) % n
+        b, _, h, d = q_blk.shape
+        acc0 = (jnp.zeros((b, blk, h, d), q_blk.dtype),
+                jnp.full((b, h, blk), -jnp.inf, q_blk.dtype),
+                jnp.zeros((b, h, blk), q_blk.dtype))
+        # constants are device-invariant; the loop carry becomes
+        # device-varying after the first merge — pcast to match
+        acc0 = jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(a, (axis,), to="varying"), acc0)
+        (out, m, denom), _, _, _ = jax.lax.fori_loop(
+            0, n, body, (acc0, k_blk, v_blk, idx))
+        denom = jnp.maximum(denom, 1e-20)
+        return out / denom.transpose(0, 2, 1)[..., None]
+
+    return _ring(q, k, v)
+
+
+def all_to_all_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                         causal: bool = False, scale: float = None):
+    """Ulysses-style: all_to_all swaps the sharded dim from sequence to
+    heads, full-sequence attention per head shard, swap back."""
+    n = mesh.shape[axis]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    assert q.shape[2] % n == 0, "head count must divide the sp axis"
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None))
+    def _u(q_blk, k_blk, v_blk):
+        def seq_to_heads(x):
+            # [b, s/n, h, d] -> gather seq, scatter heads -> [b, s, h/n, d]
+            x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                   tiled=True)
+            return x
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+        qh, kh, vh = seq_to_heads(q_blk), seq_to_heads(k_blk), \
+            seq_to_heads(v_blk)
+        out, m, denom = _block_attn(qh, kh, vh, scale, causal, 0, 0)
+        out = out / jnp.maximum(denom, 1e-20).transpose(0, 2, 1)[..., None]
+        return heads_to_seq(out)
+
+    return _u(q, k, v)
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Single-device reference for tests."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        ql, kl = q.shape[1], k.shape[1]
+        mask = jnp.arange(ql)[:, None] >= jnp.arange(kl)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
