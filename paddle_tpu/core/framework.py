@@ -422,6 +422,51 @@ class Program:
     def to_dict(self):
         return {"blocks": [b.to_dict() for b in self.blocks], "seed": self.seed}
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Program":
+        """Reconstruct a Program from `to_dict()` output — the deserialization
+        half of the model format (reference: ProgramDesc parsed back from the
+        `__model__` protobuf in inference/io.cc:?Load; here the schema is
+        JSON, framework.py to_dict)."""
+        p = cls()
+        p.seed = d.get("seed", 0)
+        # materialize blocks first so sub_block attr refs resolve
+        for bd in d["blocks"][1:]:
+            b = Block(p, bd["idx"], bd.get("parent_idx", -1))
+            p.blocks.append(b)
+        for bd in d["blocks"]:
+            b = p.blocks[bd["idx"]]
+            for vd in bd.get("vars", []):
+                kw = dict(
+                    shape=vd.get("shape"),
+                    dtype=vd.get("dtype"),
+                    lod_level=vd.get("lod_level", 0),
+                    persistable=vd.get("persistable", False),
+                    stop_gradient=vd.get("stop_gradient", False),
+                    type=vd.get("type", VarType.LOD_TENSOR),
+                )
+                if vd.get("is_parameter"):
+                    kw.pop("persistable")
+                    v = Parameter(b, vd["name"], kw.pop("shape"),
+                                  kw.pop("dtype"),
+                                  trainable=vd.get("trainable", True), **kw)
+                else:
+                    v = Variable(b, vd["name"], **kw)
+                b.vars[vd["name"]] = v
+            for od in bd.get("ops", []):
+                attrs = {
+                    k: _dec_attr(v) for k, v in od.get("attrs", {}).items()
+                }
+                op = Operator(b, od["type"], od.get("inputs"),
+                              od.get("outputs"), attrs)
+                b.ops.append(op)
+                for n in op.output_names():
+                    if n in b.vars:
+                        b.vars[n].op = op
+        p._current_block_idx = 0
+        p.bump_version()
+        return p
+
     def __repr__(self):
         lines = []
         for b in self.blocks:
@@ -432,6 +477,12 @@ class Program:
     def list_vars(self):
         for b in self.blocks:
             yield from b.vars.values()
+
+
+def _dec_attr(v):
+    if isinstance(v, dict) and "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], dtype=np.dtype(v["dtype"]))
+    return v
 
 
 def _op_declared_attrs(type):

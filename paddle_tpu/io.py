@@ -1,0 +1,223 @@
+"""Model persistence: save/load vars and the inference-model format.
+
+Reference: /root/reference/python/paddle/v2/fluid/io.py:1-442
+(save_vars/save_params/save_persistables, save_inference_model/
+load_inference_model) and framework/prune.cc (drop ops not reachable from
+the fetch targets).
+
+Layout mirrors the reference: one file per variable named after the var
+inside `dirname` (or a single combined file when `filename` is given), plus
+a `__model__` file holding the serialized (pruned, inference-mode) Program.
+The Program schema is JSON (core/framework.py to_dict/from_dict) rather than
+protobuf — see that module's rationale.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Sequence
+
+from .core.framework import (
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+)
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "prune",
+    "get_inference_program",
+]
+
+MODEL_FILENAME = "__model__"
+
+
+def is_persistable(var: Variable) -> bool:
+    return bool(var.persistable)
+
+
+def is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def _build_save_load_program(op_type, var_names, dirname, filename):
+    """A little program of save/load ops, run through the executor — the
+    persistence path exercises the same op machinery as the reference
+    (io.py appends save/load ops and executes them)."""
+    prog = Program()
+    block = prog.global_block()
+    for name in var_names:
+        block.create_var(name=name, dtype=None, persistable=True)
+    if filename is None:
+        for name in var_names:
+            path = os.path.join(dirname, name)
+            if op_type == "save":
+                block.append_op("save", inputs={"X": [name]},
+                                attrs={"file_path": path})
+            else:
+                block.append_op("load", outputs={"Out": [name]},
+                                attrs={"file_path": path})
+    else:
+        path = os.path.join(dirname, filename)
+        if op_type == "save":
+            block.append_op("save_combine", inputs={"X": list(var_names)},
+                            attrs={"file_path": path})
+        else:
+            block.append_op("load_combine",
+                            outputs={"Out": list(var_names)},
+                            attrs={"file_path": path})
+    return prog
+
+
+def _select_vars(program, predicate, vars):
+    if vars is not None:
+        return [v.name if isinstance(v, Variable) else str(v) for v in vars]
+    return sorted(
+        v.name for v in program.list_vars() if predicate(v)
+    )
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=is_persistable, filename=None, scope=None):
+    """Save variables selected by `vars` or `predicate` (reference
+    io.py:save_vars)."""
+    program = main_program or default_main_program()
+    names = _select_vars(program, predicate, vars)
+    os.makedirs(dirname, exist_ok=True)
+    prog = _build_save_load_program("save", names, dirname, filename)
+    executor.run(prog, scope=scope)
+    return names
+
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename, scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename,
+                     scope=scope)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=is_persistable, filename=None, scope=None):
+    program = main_program or default_main_program()
+    names = _select_vars(program, predicate, vars)
+    prog = _build_save_load_program("load", names, dirname, filename)
+    executor.run(prog, scope=scope)
+    return names
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename,
+                     scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# prune + inference model
+# ---------------------------------------------------------------------------
+
+
+def prune(program: Program, targets: Sequence,
+          for_test: bool = False) -> Program:
+    """Drop ops in block 0 not needed to compute `targets` (reference
+    framework/prune.cc, driven by pybind `prune` for save_inference_model).
+    An op with sub-blocks is kept whole if any of its outputs is needed;
+    names read anywhere inside its sub-blocks count as its inputs so their
+    block-0 producers are kept too."""
+    target_names = {
+        t.name if isinstance(t, Variable) else str(t) for t in targets
+    }
+    pruned = program.clone(for_test=for_test)
+
+    def op_reads(op):
+        names = set(op.input_names())
+        for attr in op.attrs:
+            sub = op.sub_block(attr) if attr.endswith("block") else None
+            if sub is not None:
+                for sub_op in sub.ops:
+                    names.update(op_reads(sub_op))
+        return names
+
+    block = pruned.global_block()
+    needed = set(target_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_names()):
+            keep.append(op)
+            needed.update(op_reads(op))
+    keep.reverse()
+    block.ops = keep
+    referenced = set()
+    for op in keep:
+        referenced.update(op_reads(op))
+        referenced.update(op.output_names())
+    referenced.update(target_names)
+    block.vars = {
+        n: v for n, v in block.vars.items() if n in referenced
+    }
+    pruned.bump_version()
+    return pruned
+
+
+def get_inference_program(target_vars, main_program=None) -> Program:
+    program = main_program or default_main_program()
+    return prune(program, target_vars, for_test=True)
+
+
+def save_inference_model(dirname, feeded_var_names: Sequence[str],
+                         target_vars, executor, main_program=None,
+                         model_filename=None, params_filename=None,
+                         scope=None) -> List[str]:
+    """Prune to the fetch targets, flip is_test, write `__model__` +
+    persistables (reference io.py:save_inference_model)."""
+    program = main_program or default_main_program()
+    inference_program = get_inference_program(target_vars, program)
+    fetch_names = [
+        t.name if isinstance(t, Variable) else str(t) for t in target_vars
+    ]
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
+    payload = {
+        "program": inference_program.to_dict(),
+        "feed_var_names": list(feeded_var_names),
+        "fetch_var_names": fetch_names,
+    }
+    with open(model_path, "w") as f:
+        json.dump(payload, f)
+    save_persistables(executor, dirname, inference_program,
+                      filename=params_filename, scope=scope)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    """-> (inference_program, feed_var_names, fetch_var_names)
+    (reference io.py:load_inference_model)."""
+    model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
+    with open(model_path) as f:
+        payload = json.load(f)
+    program = Program.from_dict(payload["program"])
+    load_persistables(executor, dirname, program,
+                      filename=params_filename, scope=scope)
+    return (program, payload["feed_var_names"],
+            payload["fetch_var_names"])

@@ -5,6 +5,7 @@ from . import (  # noqa: F401
     creation,
     elementwise,
     embedding,
+    io_ops,
     loss,
     manip,
     matmul,

@@ -1,0 +1,180 @@
+"""Checkpoint ops: save / load / save_combine / load_combine.
+
+Reference: /root/reference/paddle/fluid/operators/save_op.cc:99 (tensor
+serialized as uint32 version header + TensorDesc + raw bytes + LoD;
+`SerializeToStream` lod_tensor.cc:236-267), load_op.cc, save_combine_op.cc,
+load_combine_op.cc, tested by save_load_op_test.cc.
+
+TPU-native format: same layering (version header, self-describing tensor
+desc, raw little-endian buffer, LoD offsets) but the desc is JSON instead of
+a protobuf TensorDesc — there is no C++ executor on the other side that
+needs proto.  These are `host` ops: the executor runs the enclosing block in
+interpreter mode and the op does host file IO, exactly like the reference's
+save/load kernels which always run on CPU after a device->host copy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..core.execution import many, one
+from ..core.lod import LoDTensor
+from ..core.registry import register_op
+
+MAGIC = b"PTP0"
+VERSION = 0
+
+
+def _tensor_payload(value):
+    """-> (header dict, raw bytes) for one tensor value."""
+    lod = ()
+    if isinstance(value, LoDTensor):
+        lod = value.lod
+        value = value.data
+    arr = np.ascontiguousarray(np.asarray(value))
+    header = {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "lod": [list(level) for level in lod],
+    }
+    return header, arr.tobytes()
+
+
+def _write_tensor(f, value, name=None):
+    header, raw = _tensor_payload(value)
+    if name is not None:
+        header["name"] = name
+    hb = json.dumps(header).encode("utf-8")
+    f.write(struct.pack("<I", VERSION))
+    f.write(struct.pack("<I", len(hb)))
+    f.write(hb)
+    f.write(struct.pack("<Q", len(raw)))
+    f.write(raw)
+
+
+def _read_tensor(f):
+    ver_bytes = f.read(4)
+    if len(ver_bytes) < 4:
+        return None  # EOF
+    (ver,) = struct.unpack("<I", ver_bytes)
+    if ver != VERSION:
+        raise ValueError(f"unsupported tensor file version {ver}")
+    (hlen,) = struct.unpack("<I", f.read(4))
+    header = json.loads(f.read(hlen).decode("utf-8"))
+    (rlen,) = struct.unpack("<Q", f.read(8))
+    arr = np.frombuffer(f.read(rlen), dtype=np.dtype(header["dtype"]))
+    arr = arr.reshape(header["shape"]).copy()
+    if header.get("lod"):
+        return header, LoDTensor(arr, header["lod"])
+    return header, arr
+
+
+def save_tensor_to_file(path, value):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        _write_tensor(f, value)
+
+
+def load_tensor_from_file(path):
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path} is not a paddle_tpu tensor file")
+        _, value = _read_tensor(f)
+        return value
+
+
+@register_op(
+    "save",
+    inputs=("X",),
+    outputs=(),
+    attrs={"file_path": "", "overwrite": True},
+    not_differentiable=True,
+    host=True,
+)
+def save_lower(ctx, ins, attrs):
+    path = attrs["file_path"]
+    if os.path.exists(path) and not attrs.get("overwrite", True):
+        raise IOError(f"{path} exists; overwrite=False (save_op.cc:45)")
+    value = one(ins, "X")
+    if value is None:
+        raise ValueError(
+            f"save: variable {ctx.op.input('X')} is not initialized "
+            "(reference save_op.cc enforce)")
+    save_tensor_to_file(path, value)
+    return {}
+
+
+@register_op(
+    "load",
+    inputs=(),
+    outputs=("Out",),
+    attrs={"file_path": ""},
+    not_differentiable=True,
+    host=True,
+)
+def load_lower(ctx, ins, attrs):
+    return {"Out": load_tensor_from_file(attrs["file_path"])}
+
+
+@register_op(
+    "save_combine",
+    inputs=("X",),
+    outputs=(),
+    attrs={"file_path": "", "overwrite": True},
+    not_differentiable=True,
+    host=True,
+)
+def save_combine_lower(ctx, ins, attrs):
+    path = attrs["file_path"]
+    if os.path.exists(path) and not attrs.get("overwrite", True):
+        raise IOError(f"{path} exists; overwrite=False")
+    names = ctx.op.input("X")
+    values = many(ins, "X")
+    missing = [n for n, v in zip(names, values) if v is None]
+    if missing:
+        raise ValueError(
+            f"save_combine: variables {missing} are not initialized")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        for name, v in zip(names, values):
+            _write_tensor(f, v, name=name)
+    return {}
+
+
+@register_op(
+    "load_combine",
+    inputs=(),
+    outputs=("Out",),
+    attrs={"file_path": ""},
+    not_differentiable=True,
+    host=True,
+)
+def load_combine_lower(ctx, ins, attrs):
+    path = attrs["file_path"]
+    out_names = ctx.op.output("Out")
+    by_name = {}
+    order = []
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path} is not a paddle_tpu tensor file")
+        while True:
+            rec = _read_tensor(f)
+            if rec is None:
+                break
+            header, value = rec
+            by_name[header.get("name")] = value
+            order.append(value)
+    if all(n in by_name for n in out_names):
+        return {"Out": [by_name[n] for n in out_names]}
+    # fall back to positional order (reference load_combine semantics)
+    if len(order) < len(out_names):
+        raise ValueError(
+            f"{path} holds {len(order)} tensors; program expects "
+            f"{len(out_names)}"
+        )
+    return {"Out": order[: len(out_names)]}

@@ -51,3 +51,16 @@ def test_fit_a_line_converges(tmp_path):
     l_comp, = exe.run(test_program, feed={"x": xs[:64], "y": ys[:64]},
                       fetch_list=[avg_cost.name], compiled=True)
     np.testing.assert_allclose(l_interp, l_comp, rtol=1e-5, atol=1e-6)
+
+    # save/load_inference_model round-trip (reference
+    # test_fit_a_line.py:64-102)
+    ref, = exe.run(test_program, feed={"x": xs[:16], "y": ys[:16]},
+                   fetch_list=[y_predict.name])
+    model_dir = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y_predict], exe, main)
+    scope2 = fluid.Scope()
+    prog, feeds, fetches = fluid.io.load_inference_model(model_dir, exe,
+                                                         scope=scope2)
+    out, = exe.run(prog, feed={"x": xs[:16]}, fetch_list=fetches,
+                   scope=scope2)
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,98 @@
+"""fluid.io persistence tests.
+
+Reference analogues: save_load_op_test.cc, save_load_combine_op_test.cc,
+and the save/load_inference_model round-trip every book test performs
+(tests/book/test_fit_a_line.py:64-102 in the reference).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def build_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.SGD(learning_rate=0.01).minimize(cost)
+    return main, startup, pred, cost
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup, pred, cost = build_model()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    x = np.random.RandomState(0).rand(8, 13).astype(np.float32)
+    y = np.zeros((8, 1), np.float32)
+    exe.run(main, feed={"x": x, "y": y}, fetch_list=[cost], scope=scope)
+
+    names = fluid.io.save_persistables(exe, str(tmp_path / "ckpt"),
+                                       main, scope=scope)
+    assert names, "no persistables saved"
+    saved = {n: np.asarray(scope.find_var(n)) for n in names}
+
+    # clobber, reload, compare
+    scope2 = fluid.Scope()
+    exe.run(startup, scope=scope2)
+    fluid.io.load_persistables(exe, str(tmp_path / "ckpt"), main,
+                               scope=scope2)
+    for n in names:
+        np.testing.assert_array_equal(saved[n],
+                                      np.asarray(scope2.find_var(n)))
+
+
+def test_save_load_combine(tmp_path):
+    main, startup, pred, cost = build_model()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    names = fluid.io.save_params(exe, str(tmp_path), main,
+                                 filename="params.bin", scope=scope)
+    saved = {n: np.asarray(scope.find_var(n)) for n in names}
+    scope2 = fluid.Scope()
+    exe.run(startup, scope=scope2)
+    fluid.io.load_params(exe, str(tmp_path), main, filename="params.bin",
+                         scope=scope2)
+    for n in names:
+        np.testing.assert_array_equal(saved[n],
+                                      np.asarray(scope2.find_var(n)))
+
+
+def test_prune_drops_optimizer_ops():
+    main, startup, pred, cost = build_model()
+    pruned = fluid.io.prune(main, [pred])
+    types = {op.type for op in pruned.global_block().ops}
+    assert "sgd" not in types
+    assert not any(t.endswith("_grad") for t in types)
+    assert "mul" in types or "matmul" in types
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup, pred, cost = build_model()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(1)
+    x = r.rand(8, 13).astype(np.float32)
+    y = (x.sum(1, keepdims=True)).astype(np.float32)
+    for _ in range(5):
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[cost],
+                scope=scope)
+    infer_prog = fluid.io.get_inference_program([pred], main)
+    ref, = exe.run(infer_prog, feed={"x": x}, fetch_list=[pred],
+                   scope=scope)
+
+    path = str(tmp_path / "model")
+    fluid.io.save_inference_model(path, ["x"], [pred], exe, main,
+                                  scope=scope)
+
+    scope2 = fluid.Scope()
+    prog, feeds, fetches = fluid.io.load_inference_model(path, exe,
+                                                         scope=scope2)
+    assert feeds == ["x"]
+    out, = exe.run(prog, feed={"x": x}, fetch_list=fetches, scope=scope2)
+    np.testing.assert_allclose(ref, out, rtol=1e-6, atol=1e-7)
