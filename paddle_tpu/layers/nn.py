@@ -16,6 +16,7 @@ __all__ = [
     "dropout",
     "cross_entropy",
     "square_error_cost",
+    "cos_sim",
     "accuracy",
     "chunk_eval",
     "conv2d",
@@ -137,6 +138,19 @@ def square_error_cost(input, label):
     helper.append_op("square", {"X": [minus_out.name]},
                      {"Out": [square_out.name]})
     return square_out
+
+
+def cos_sim(X, Y):
+    """Row-wise cosine similarity (reference layers/nn.py cos_sim,
+    operators/cos_sim_op.cc); Y may have a single row, broadcast to X."""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_tmp_variable(X.dtype)
+    xnorm = helper.create_tmp_variable(X.dtype, stop_gradient=True)
+    ynorm = helper.create_tmp_variable(X.dtype, stop_gradient=True)
+    helper.append_op("cos_sim", {"X": [X.name], "Y": [Y.name]},
+                     {"Out": [out.name], "XNorm": [xnorm.name],
+                      "YNorm": [ynorm.name]})
+    return out
 
 
 def accuracy(input, label, k=1, correct=None, total=None):

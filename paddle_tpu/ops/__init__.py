@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     manip,
     matmul,
     metrics,
+    misc,
     norm,
     optimizer_ops,
     reduce,

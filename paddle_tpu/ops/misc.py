@@ -1,0 +1,60 @@
+"""Miscellaneous ops: cos_sim, is_empty, print.
+
+Reference: /root/reference/paddle/fluid/operators/cos_sim_op.{cc,h},
+is_empty_op.cc, print_op.cc.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, one, with_lod_of
+from ..core.registry import register_op
+
+
+@register_op("cos_sim", inputs=("X", "Y"),
+             outputs=("Out", "XNorm", "YNorm"),
+             diff_outputs=("Out",))
+def cos_sim(ctx, ins, attrs):
+    """Row-wise cosine similarity; Y may have 1 row (broadcast against every
+    row of X), matching cos_sim_op.h."""
+    xv = one(ins, "X")
+    x = data_of(xv)
+    y = data_of(one(ins, "Y"))
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": with_lod_of(xv, out), "XNorm": xn, "YNorm": yn}
+
+
+@register_op("is_empty", inputs=("X",), outputs=("Out",),
+             not_differentiable=True)
+def is_empty(ctx, ins, attrs):
+    x = data_of(one(ins, "X"))
+    return {"Out": jnp.asarray(x.size == 0)}
+
+
+@register_op("print", inputs=("In",), outputs=("Out",),
+             attrs={"first_n": -1, "message": "", "summarize": 20,
+                    "print_tensor_name": True, "print_tensor_type": True,
+                    "print_tensor_shape": True, "print_tensor_lod": True,
+                    "print_phase": "BOTH"},
+             not_differentiable=True, host=True)
+def print_op(ctx, ins, attrs):
+    """Debug print (reference print_op.cc); identity pass-through."""
+    v = one(ins, "In")
+    x = np.asarray(data_of(v))
+    parts = [attrs.get("message") or ""]
+    if attrs.get("print_tensor_name", True):
+        parts.append(f"name={ctx.op.input('In')[0]}")
+    if attrs.get("print_tensor_shape", True):
+        parts.append(f"shape={tuple(x.shape)}")
+    if attrs.get("print_tensor_type", True):
+        parts.append(f"dtype={x.dtype}")
+    if attrs.get("print_tensor_lod", True) and hasattr(v, "lod"):
+        parts.append(f"lod={v.lod}")
+    n = int(attrs.get("summarize", 20))
+    flat = x.reshape(-1)
+    data = flat if (n < 0 or flat.size <= n) else flat[:n]
+    print(" ".join(p for p in parts if p), "data:", data)
+    return {"Out": v}

@@ -1,0 +1,98 @@
+"""book/05 recommender_system — personalized movie rating regression.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_recommender_system.py — embeddings for user (id/gender/age/job) and
+movie (id/category sequence/title sequence), two fused fc towers, cos_sim
+scaled to [0,5], square_error_cost vs the rating.  Data: synthetic
+movielens-shaped batches (no network egress here).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+USR_N, GENDER_N, AGE_N, JOB_N = 40, 2, 7, 21
+MOV_N, CAT_N, TITLE_VOCAB = 60, 18, 100
+
+
+def get_usr_combined_features():
+    uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+    gender = fluid.layers.data(name="gender_id", shape=[1], dtype="int64")
+    age = fluid.layers.data(name="age_id", shape=[1], dtype="int64")
+    job = fluid.layers.data(name="job_id", shape=[1], dtype="int64")
+    emb = lambda x, n: fluid.layers.fc(
+        input=fluid.layers.embedding(input=x, size=[n, 16]), size=16)
+    concat = fluid.layers.concat(
+        input=[emb(uid, USR_N), emb(gender, GENDER_N), emb(age, AGE_N),
+               emb(job, JOB_N)], axis=1)
+    return fluid.layers.fc(input=concat, size=32, act="tanh")
+
+
+def get_mov_combined_features():
+    mov_id = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+    category = fluid.layers.data(name="category_id", shape=[1],
+                                 dtype="int64", lod_level=1)
+    title = fluid.layers.data(name="movie_title", shape=[1],
+                              dtype="int64", lod_level=1)
+    mov_fc = fluid.layers.fc(
+        input=fluid.layers.embedding(input=mov_id, size=[MOV_N, 16]),
+        size=16)
+    cat_pool = fluid.layers.sequence_pool(
+        input=fluid.layers.embedding(input=category, size=[CAT_N, 16]),
+        pool_type="sum")
+    title_pool = fluid.nets.sequence_conv_pool(
+        input=fluid.layers.embedding(input=title, size=[TITLE_VOCAB, 16]),
+        num_filters=16, filter_size=3, act="tanh", pool_type="sum")
+    concat = fluid.layers.concat(input=[mov_fc, cat_pool, title_pool],
+                                 axis=1)
+    return fluid.layers.fc(input=concat, size=32, act="tanh")
+
+
+def build_model():
+    usr = get_usr_combined_features()
+    mov = get_mov_combined_features()
+    inference = fluid.layers.cos_sim(X=usr, Y=mov)
+    scale_infer = fluid.layers.scale(x=inference, scale=5.0)
+    label = fluid.layers.data(name="score", shape=[1], dtype="float32")
+    cost = fluid.layers.square_error_cost(input=scale_infer, label=label)
+    return fluid.layers.mean(cost), scale_infer
+
+
+def make_batch(r, n=32):
+    def seq(vocab, max_len):
+        lens = r.randint(1, max_len + 1, n)
+        flat = r.randint(0, vocab, (int(lens.sum()), 1)).astype(np.int64)
+        return fluid.create_lod_tensor(flat, [list(lens)])
+
+    ids = lambda k: r.randint(0, k, (n, 1)).astype(np.int64)
+    feed = {
+        "user_id": ids(USR_N), "gender_id": ids(GENDER_N),
+        "age_id": ids(AGE_N), "job_id": ids(JOB_N),
+        "movie_id": ids(MOV_N),
+        "category_id": seq(CAT_N, 4), "movie_title": seq(TITLE_VOCAB, 8),
+    }
+    # learnable synthetic signal: rating depends on user/movie ids
+    score = (feed["user_id"] % 5 + feed["movie_id"] % 3).astype(np.float32)
+    score = score / 6.0 * 4.0 + 1.0
+    feed["score"] = score
+    return feed
+
+
+def test_recommender_system_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        avg_cost, scale_infer = build_model()
+        fluid.SGD(learning_rate=0.2).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    batches = [make_batch(r) for _ in range(8)]
+    first = last = None
+    for epoch in range(30):
+        for feed in batches:
+            out, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            last = float(np.asarray(out).reshape(()))
+            if first is None:
+                first = last
+    assert last < first * 0.5, f"no convergence: {first} -> {last}"
+    assert last < 1.0, f"loss too high: {first} -> {last}"
