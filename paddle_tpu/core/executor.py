@@ -157,8 +157,8 @@ class Executor:
         ]
         block = program.global_block()
 
-        if compiled is None:
-            compiled = not self._has_host_ops(block)
+        if compiled is None and not self._has_host_ops(block):
+            compiled = True
         step_key = jax.random.fold_in(
             jax.random.key(program.seed or self._seed), self._step
         )
@@ -174,6 +174,12 @@ class Executor:
                     f"persistable variable {e.args[0]!r} has no value in scope "
                     "— run the startup program first"
                 ) from None
+        elif compiled is None:
+            # host ops present: compile maximal device segments, interpret
+            # host ops eagerly between them
+            outs = self._run_segmented(
+                program, block, scope, feed, fetch_names, step_key
+            )
         else:
             outs = self._run_interpreted(
                 program, block, scope, feed, fetch_names, step_key
@@ -233,6 +239,98 @@ class Executor:
             outs = [env.get(n) for n in fetch_names]
         scope.kids.remove(local)
         return outs
+
+    # -- segmented: compiled device segments between eager host ops ---------
+    def _op_is_host(self, op) -> bool:
+        try:
+            info = registry.get_op_info(op.type)
+        except KeyError:
+            return True
+        if info.host:
+            return True
+        sub = op.sub_block() if "sub_block" in op.attrs else None
+        return sub is not None and self._has_host_ops(sub)
+
+    def _segments(self, block):
+        """Split ops into maximal (is_host, [ops]) runs."""
+        segs = []
+        for op in block.ops:
+            h = self._op_is_host(op)
+            if segs and segs[-1][0] == h:
+                segs[-1][1].append(op)
+            else:
+                segs.append((h, [op]))
+        return segs
+
+    def _run_segmented(self, program, block, scope, feed, fetch_names, key):
+        """Interpreter-shaped env, but each maximal run of non-host ops is
+        traced+jitted once and cached — host ops (save/load/print/metrics)
+        run eagerly between compiled segments.  The per-op PRNG keys are
+        derived from op identity (execution.py:_op_rng_tag), so randomness
+        is identical across interpreted/compiled/segmented modes."""
+        device = self.place.jax_device()
+        local = scope.new_scope()
+        persistable = {v.name for v in program.list_vars() if v.persistable}
+        root = scope
+        while root.parent is not None:
+            root = root.parent
+
+        class _Env(ScopeEnv):
+            def set(self, name, value):
+                if name in persistable:
+                    root.set_var(name, value)
+                else:
+                    self.scope.set_var(name, value, local=True)
+                self.written.add(name)
+
+        env = _Env(local)
+        fp = self._fingerprint(program)
+        with jax.default_device(device):
+            for name, v in feed.items():
+                env.set(name, _to_device_value(v, device))
+            ctx = ExecContext(key, scope=local, executor=self)
+            for seg_idx, (is_host, ops) in enumerate(self._segments(block)):
+                if is_host:
+                    for op in ops:
+                        run_op(ctx, op, env)
+                    continue
+                self._run_segment_compiled(fp, seg_idx, ops, env, key)
+            missing = [n for n in fetch_names if not env.has(n)]
+            if missing:
+                raise KeyError(
+                    f"fetch variable(s) {missing} were never produced by "
+                    "the program")
+            outs = [env.get(n) for n in fetch_names]
+        scope.kids.remove(local)
+        return outs
+
+    def _run_segment_compiled(self, fp, seg_idx, ops, env, key):
+        # names this segment reads from the surrounding env
+        read, written = [], set()
+        for op in ops:
+            for n in op.input_names():
+                if n not in written and n not in read and env.has(n):
+                    read.append(n)
+            written.update(op.output_names())
+        in_vals = {n: env.get(n) for n in read}
+        cache_key = (
+            fp, "seg", seg_idx,
+            tuple((n, _aval_key(v)) for n, v in sorted(in_vals.items())),
+        )
+        fn = self._cache.get(cache_key)
+        if fn is None:
+            def fn(vals, rng_key, _ops=tuple(ops)):
+                seg_env = DictEnv(vals)
+                seg_ctx = ExecContext(rng_key, executor=self, compiled=True)
+                for op in _ops:
+                    run_op(seg_ctx, op, seg_env)
+                return {n: seg_env.d[n] for n in seg_env.written
+                        if n in seg_env.d}
+            fn = jax.jit(fn)
+            self._cache[cache_key] = fn
+        out = fn(in_vals, key)
+        for n, v in out.items():
+            env.set(n, v)
 
     # -- compiled ------------------------------------------------------------
     def _fingerprint(self, program) -> str:

@@ -17,6 +17,8 @@ __all__ = [
     "cross_entropy",
     "square_error_cost",
     "cos_sim",
+    "linear_chain_crf",
+    "crf_decoding",
     "accuracy",
     "chunk_eval",
     "conv2d",
@@ -138,6 +140,50 @@ def square_error_cost(input, label):
     helper.append_op("square", {"X": [minus_out.name]},
                      {"Out": [square_out.name]})
     return square_out
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF negative log-likelihood cost over a LoD emission sequence
+    (reference layers/nn.py linear_chain_crf, linear_chain_crf_op.cc).
+    The transition parameter has shape [D+2, D] (start/end rows first)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(param_attr, [size + 2, size],
+                                         input.dtype, suffix="transition")
+    alpha = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    em_exps = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    tr_exps = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    log_likelihood = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "linear_chain_crf",
+        {"Emission": [input.name], "Transition": [transition.name],
+         "Label": [label.name]},
+        {"Alpha": [alpha.name], "EmissionExps": [em_exps.name],
+         "TransitionExps": [tr_exps.name],
+         "LogLikelihood": [log_likelihood.name]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode using the transition parameter learned by
+    linear_chain_crf (shared via param_attr name)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    name = (param_attr or {}).get("name")
+    block = helper.main_program.global_block()
+    if name and name in block.vars:
+        transition = block.vars[name]
+    else:
+        size = input.shape[-1]
+        transition = helper.create_parameter(param_attr, [size + 2, size],
+                                             input.dtype,
+                                             suffix="transition")
+    path = helper.create_tmp_variable("int64", stop_gradient=True)
+    inputs = {"Emission": [input.name], "Transition": [transition.name]}
+    if label is not None:
+        inputs["Label"] = [label.name]
+    helper.append_op("crf_decoding", inputs,
+                     {"ViterbiPath": [path.name]})
+    return path
 
 
 def cos_sim(X, Y):

@@ -1,0 +1,259 @@
+"""Linear-chain CRF ops: linear_chain_crf, crf_decoding, chunk_eval.
+
+Reference: /root/reference/paddle/fluid/operators/linear_chain_crf_op.{cc,h}
+(forward alpha recursion + hand-written backward), crf_decoding_op.{cc,h}
+(Viterbi), chunk_eval_op.{cc,h} (segment extraction + P/R/F1).
+
+TPU-native design: sequences are packed LoD rows; the LoD offsets are host
+metadata (static under trace — see core/lod.py), so each batch is padded to
+its max length with statically-built gather indices, and the alpha/Viterbi
+recursions run as `lax.scan` over the time axis — MXU-friendly [S, D] x
+[D, D] steps instead of the reference's per-sequence C++ loops.  The
+backward pass is the generic VJP of the forward scan (no hand-written
+gradient needed).
+
+Transition layout matches the reference exactly (linear_chain_crf_op.h):
+row 0 = start weights, row 1 = end weights, rows 2..D+1 = transition
+matrix [D, D].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, one
+from ..core.lod import LoDTensor
+from ..core.registry import register_op
+
+
+def _pad_layout(lod):
+    """Static (numpy) padding layout from LoD offsets:
+    -> (idx [S,T], mask [S,T], lens [S])."""
+    offs = lod[0]
+    lens = np.diff(np.asarray(offs, np.int64))
+    S, T = len(lens), int(lens.max()) if len(lens) else 0
+    idx = np.zeros((S, T), np.int32)
+    mask = np.zeros((S, T), bool)
+    for s in range(S):
+        idx[s, : lens[s]] = np.arange(offs[s], offs[s + 1], dtype=np.int32)
+        mask[s, : lens[s]] = True
+    return idx, mask, lens.astype(np.int32)
+
+
+def _split_transition(transition):
+    start, end, trans = transition[0], transition[1], transition[2:]
+    return start, end, trans
+
+
+@register_op("linear_chain_crf", inputs=("Emission", "Transition", "Label"),
+             outputs=("Alpha", "EmissionExps", "TransitionExps",
+                      "LogLikelihood"),
+             diff_inputs=("Emission", "Transition"),
+             diff_outputs=("LogLikelihood",))
+def linear_chain_crf(ctx, ins, attrs):
+    ev = one(ins, "Emission")
+    if not (isinstance(ev, LoDTensor) and ev.lod):
+        raise ValueError("linear_chain_crf requires a LoD emission input")
+    emission = data_of(ev)
+    transition = data_of(one(ins, "Transition"))
+    label = data_of(one(ins, "Label"))
+    if label.ndim == 2:
+        label = label[:, 0]
+    idx, mask, lens = _pad_layout(ev.lod)
+    S, T = idx.shape
+    D = emission.shape[-1]
+    start, end, trans = _split_transition(transition)
+
+    em = emission[idx]                       # [S, T, D]
+    lab = label[idx].astype(jnp.int32)       # [S, T]
+    maskf = jnp.asarray(mask, emission.dtype)
+
+    # --- partition function: alpha recursion as lax.scan over time -------
+    a0 = start[None, :] + em[:, 0, :]        # [S, D]
+
+    def step(alpha, xs):
+        em_t, m_t = xs                       # [S, D], [S]
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1) + em_t
+        alpha = jnp.where(m_t[:, None] > 0, nxt, alpha)
+        return alpha, alpha
+
+    alpha_last, alphas = jax.lax.scan(
+        step, a0, (jnp.swapaxes(em, 0, 1)[1:], maskf.T[1:]))
+    log_z = jax.scipy.special.logsumexp(alpha_last + end[None, :], axis=1)
+
+    # --- gold path score -------------------------------------------------
+    em_path = jnp.take_along_axis(em, lab[:, :, None], axis=2)[:, :, 0]
+    em_score = jnp.sum(em_path * maskf, axis=1)
+    tr_path = trans[lab[:, :-1], lab[:, 1:]] if T > 1 else jnp.zeros((S, 0))
+    tr_score = jnp.sum(tr_path * maskf[:, 1:], axis=1)
+    last_lab = lab[np.arange(S), lens - 1]
+    score = em_score + tr_score + start[lab[:, 0]] + end[last_lab]
+
+    nll = (log_z - score)[:, None]           # [S, 1] negative log-likelihood
+
+    # Alpha per packed row (parity output; the reference caches it for its
+    # hand-written backward — here it is informational)
+    all_alphas = jnp.concatenate([a0[:, None, :],
+                                  jnp.swapaxes(alphas, 0, 1)], axis=1) \
+        if T > 1 else a0[:, None, :]
+    # padded slots scatter out-of-bounds and are dropped
+    scatter_idx = np.where(mask, idx, emission.shape[0]).reshape(-1)
+    alpha_rows = jnp.zeros_like(emission).at[scatter_idx].set(
+        all_alphas.reshape(-1, D), mode="drop")
+
+    return {
+        "Alpha": LoDTensor(alpha_rows, ev.lod),
+        "EmissionExps": LoDTensor(jax.nn.softmax(emission, axis=-1), ev.lod),
+        "TransitionExps": jnp.exp(transition),
+        "LogLikelihood": nll,
+    }
+
+
+@register_op("crf_decoding", inputs=("Emission", "Transition", "Label"),
+             outputs=("ViterbiPath",), not_differentiable=True)
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode.  Without Label: per-token best tag ids.  With Label:
+    1 where the decoded tag equals the label, else 0 (crf_decoding_op.cc
+    semantics, feeding chunk_eval/error counts)."""
+    ev = one(ins, "Emission")
+    emission = data_of(ev)
+    transition = data_of(one(ins, "Transition"))
+    idx, mask, lens = _pad_layout(ev.lod)
+    S, T = idx.shape
+    start, end, trans = _split_transition(transition)
+    em = emission[idx]
+
+    a0 = start[None, :] + em[:, 0, :]
+
+    def fwd(alpha, xs):
+        em_t, m_t = xs
+        scores = alpha[:, :, None] + trans[None, :, :]   # [S, D, D]
+        best = jnp.max(scores, axis=1) + em_t
+        ptr = jnp.argmax(scores, axis=1)                 # [S, D]
+        nxt = jnp.where(m_t[:, None] > 0, best, alpha)
+        return nxt, ptr
+
+    maskf = jnp.asarray(mask, emission.dtype)
+    alpha_last, ptrs = jax.lax.scan(
+        fwd, a0, (jnp.swapaxes(em, 0, 1)[1:], maskf.T[1:]))
+    # best final tag per sequence (end weights applied at each seq's last
+    # real step: since padding froze alpha, alpha_last IS the last real one)
+    last_tag = jnp.argmax(alpha_last + end[None, :], axis=1)  # [S]
+
+    # backtrack (reverse scan over stored argmax pointers)
+    def back(tag, xs):
+        ptr_t, m_t = xs                                   # [S, D], [S]
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        tag_prev = jnp.where(m_t > 0, prev, tag)
+        return tag_prev, tag_prev
+
+    _, rev_tags = jax.lax.scan(back, last_tag,
+                               (ptrs[::-1], maskf.T[1:][::-1]))
+    tags = jnp.concatenate([rev_tags[::-1], last_tag[None, :]], axis=0) \
+        if T > 1 else last_tag[None, :]
+    tags = jnp.swapaxes(tags, 0, 1)                       # [S, T]
+
+    # scatter back to packed rows (padded slots dropped out-of-bounds)
+    scatter_idx = np.where(mask, idx, emission.shape[0]).reshape(-1)
+    path = jnp.zeros((emission.shape[0],), jnp.int32).at[
+        scatter_idx].set(tags.reshape(-1).astype(jnp.int32), mode="drop")
+    label = one(ins, "Label")
+    if label is not None:
+        lab = data_of(label)
+        if lab.ndim == 2:
+            lab = lab[:, 0]
+        path = (path == lab.astype(jnp.int32)).astype(jnp.int32)
+    return {"ViterbiPath": LoDTensor(path[:, None], ev.lod)}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (host metric op — reference chunk_eval_op.h GetSegments)
+# ---------------------------------------------------------------------------
+
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded):
+    """-> set of (begin, end_exclusive, type) segments in one sequence."""
+    chunks = []
+    if scheme == "plain":
+        cur_type, cur_start = None, None
+        for i, t in enumerate(list(tags) + [-1]):
+            ty = int(t) if 0 <= t < num_chunk_types else None
+            if ty != cur_type:
+                if cur_type is not None:
+                    chunks.append((cur_start, i, cur_type))
+                cur_type, cur_start = ty, i
+        return {c for c in chunks if c[2] not in excluded}
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    begin_tag = {"IOB": 0, "IOE": None, "IOBES": 0}[scheme]
+    cur = None  # (start, type)
+    for i, t in enumerate(tags):
+        t = int(t)
+        inside = 0 <= t < num_chunk_types * n_tag
+        ty = t // n_tag if inside else None
+        tag = t % n_tag if inside else None
+        if scheme == "IOB":
+            starts = inside and (tag == 0)
+            cont = inside and (tag == 1)
+        elif scheme == "IOE":
+            starts = inside and cur is None
+            cont = inside
+        else:  # IOBES: B=0 I=1 E=2 S=3
+            starts = inside and tag in (0, 3)
+            cont = inside and tag in (1, 2)
+        if cur is not None and (not cont or ty != cur[1] or starts):
+            chunks.append((cur[0], i, cur[1]))
+            cur = None
+        if cur is None and starts:
+            cur = (i, ty)
+        elif cur is None and cont and scheme == "IOE":
+            cur = (i, ty)
+        # sequence enders
+        if cur is not None:
+            if scheme == "IOBES" and tag in (2, 3):
+                chunks.append((cur[0], i + 1, cur[1]))
+                cur = None
+            elif scheme == "IOE" and tag == 1:
+                chunks.append((cur[0], i + 1, cur[1]))
+                cur = None
+    if cur is not None and scheme not in ("IOE", "IOBES"):
+        chunks.append((cur[0], len(tags), cur[1]))
+    return {c for c in chunks if c[2] not in excluded}
+
+
+@register_op("chunk_eval", inputs=("Inference", "Label"),
+             outputs=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                      "NumLabelChunks", "NumCorrectChunks"),
+             attrs={"chunk_scheme": "IOB", "num_chunk_types": 1,
+                    "excluded_chunk_types": []},
+             not_differentiable=True, host=True)
+def chunk_eval(ctx, ins, attrs):
+    inf_v = one(ins, "Inference")
+    lab_v = one(ins, "Label")
+    inf = np.asarray(data_of(inf_v)).reshape(-1)
+    lab = np.asarray(data_of(lab_v)).reshape(-1)
+    lod = inf_v.lod if isinstance(inf_v, LoDTensor) and inf_v.lod \
+        else ((0, len(inf)),)
+    offs = lod[0] if isinstance(lod[0], (tuple, list)) else lod
+    scheme = attrs["chunk_scheme"]
+    n_types = int(attrs["num_chunk_types"])
+    excluded = set(attrs.get("excluded_chunk_types") or [])
+    n_inf = n_lab = n_cor = 0
+    for s in range(len(offs) - 1):
+        lo, hi = offs[s], offs[s + 1]
+        ci = _extract_chunks(inf[lo:hi], scheme, n_types, excluded)
+        cl = _extract_chunks(lab[lo:hi], scheme, n_types, excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return {
+        "Precision": np.float32(p), "Recall": np.float32(r),
+        "F1-Score": np.float32(f1),
+        "NumInferChunks": np.int64(n_inf),
+        "NumLabelChunks": np.int64(n_lab),
+        "NumCorrectChunks": np.int64(n_cor),
+    }

@@ -1,0 +1,98 @@
+"""book/07 label_semantic_roles — sequence tagging with a CRF head.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_label_semantic_roles.py — word/predicate embeddings -> LSTM ->
+per-token emission fc -> linear_chain_crf cost; decode with crf_decoding
+sharing the 'crfw' transition parameter; evaluated by chunk_eval.
+Data: synthetic CoNLL-shaped sequences with a learnable word->tag rule
+(no network egress here).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+WORD_N = 30
+# IOB, 2 chunk types: B0=0 I0=1 B1=2 I1=3 O=4
+TAG_N = 5
+
+
+def make_seq(r, t):
+    words = r.randint(0, WORD_N, t)
+    tags = np.full(t, 4, np.int64)
+    i = 0
+    while i < t:
+        w = words[i]
+        if w < 6 and i + 1 < t:        # type-0 chunk of length 2
+            tags[i], tags[i + 1] = 0, 1
+            i += 2
+        elif w >= 24:                  # type-1 chunk of length 1
+            tags[i] = 2
+            i += 1
+        else:
+            i += 1
+    return words, tags
+
+
+FIXED_LENS = np.array([3, 5, 8, 4, 6, 8, 7, 3, 5, 8, 4, 6, 8, 7, 5, 6])
+
+
+def make_batch(r, n=16, max_len=8):
+    # one length bucket for all batches -> a single XLA compilation
+    # (the bucketing discipline from core/lod.py)
+    lens = FIXED_LENS[:n]
+    ws, ts = [], []
+    for t in lens:
+        w, tg = make_seq(r, t)
+        ws.append(w)
+        ts.append(tg)
+    word = np.concatenate(ws)[:, None].astype(np.int64)
+    tag = np.concatenate(ts)[:, None].astype(np.int64)
+    return (fluid.create_lod_tensor(word, [list(lens)]),
+            fluid.create_lod_tensor(tag, [list(lens)]))
+
+
+def test_label_semantic_roles_crf():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = fluid.layers.data(name="word", shape=[1], dtype="int64",
+                                 lod_level=1)
+        target = fluid.layers.data(name="target", shape=[1], dtype="int64",
+                                   lod_level=1)
+        emb = fluid.layers.embedding(input=word, size=[WORD_N, 32])
+        hidden = fluid.layers.fc(input=emb, size=64, act="tanh")
+        lstm, _cell = fluid.layers.dynamic_lstm(
+            input=fluid.layers.fc(input=hidden, size=64 * 4), size=64 * 4)
+        feature_out = fluid.layers.fc(input=[hidden, lstm], size=TAG_N)
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=feature_out, label=target,
+            param_attr={"name": "crfw"})
+        avg_cost = fluid.layers.mean(crf_cost)
+        fluid.SGD(learning_rate=0.05).minimize(avg_cost)
+
+        crf_decode = fluid.layers.crf_decoding(
+            input=feature_out, param_attr={"name": "crfw"})
+        (precision, recall, f1, *_rest) = fluid.layers.chunk_eval(
+            input=crf_decode, label=target, chunk_scheme="IOB",
+            num_chunk_types=2)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    batches = [make_batch(r) for _ in range(6)]
+    first = last = None
+    for epoch in range(25):
+        for w, t in batches:
+            out, = exe.run(main, feed={"word": w, "target": t},
+                           fetch_list=[avg_cost])
+            last = float(np.asarray(out).reshape(()))
+            if first is None:
+                first = last
+    assert last < first * 0.35, f"no convergence: {first} -> {last}"
+
+    # decode + chunk F1 on a fresh batch through the eval path
+    eval_prog = fluid.io.get_inference_program([f1, precision, recall],
+                                               main)
+    w, t = make_batch(r)
+    f1_v, p_v, r_v = exe.run(eval_prog, feed={"word": w, "target": t},
+                             fetch_list=[f1, precision, recall])
+    assert float(f1_v) > 0.6, f"poor chunk F1: {f1_v} (P={p_v}, R={r_v})"

@@ -1,0 +1,137 @@
+"""CRF op tests vs brute-force path enumeration.
+
+Reference analogues: test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_chunk_eval_op.py in the reference suite (which use a python reference
+implementation; here the reference enumerates all tag paths exactly).
+"""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+rng = np.random.RandomState(3)
+
+
+def enumerate_crf(emission, transition, lod):
+    """Exact per-sequence (nll, viterbi path) by enumerating all paths."""
+    D = emission.shape[1]
+    start, end, trans = transition[0], transition[1], transition[2:]
+    offs = lod[0]
+    nlls, paths = [], []
+    for s in range(len(offs) - 1):
+        em = emission[offs[s]:offs[s + 1]]
+        T = len(em)
+        scores = {}
+        for path in itertools.product(range(D), repeat=T):
+            sc = start[path[0]] + end[path[-1]]
+            sc += sum(em[t, path[t]] for t in range(T))
+            sc += sum(trans[path[t - 1], path[t]] for t in range(1, T))
+            scores[path] = sc
+        vals = np.array(list(scores.values()))
+        m = vals.max()
+        logz = m + np.log(np.exp(vals - m).sum())
+        paths.append(max(scores, key=scores.get))
+        nlls.append(logz)  # caller subtracts gold score
+    return np.array(nlls), paths, scores
+
+
+def gold_score(emission, transition, lod, label):
+    start, end, trans = transition[0], transition[1], transition[2:]
+    offs = lod[0]
+    out = []
+    for s in range(len(offs) - 1):
+        em = emission[offs[s]:offs[s + 1]]
+        lab = label[offs[s]:offs[s + 1], 0]
+        sc = start[lab[0]] + end[lab[-1]]
+        sc += sum(em[t, lab[t]] for t in range(len(em)))
+        sc += sum(trans[lab[t - 1], lab[t]] for t in range(1, len(em)))
+        out.append(sc)
+    return np.array(out)
+
+
+class TestLinearChainCRF(OpTest):
+    op_type = "linear_chain_crf"
+
+    def setUp(self):
+        D = 3
+        lod = [(0, 3, 5, 9)]
+        N = lod[0][-1]
+        emission = rng.randn(N, D).astype(np.float64)
+        transition = (rng.randn(D + 2, D) * 0.5).astype(np.float64)
+        label = rng.randint(0, D, (N, 1)).astype(np.int64)
+        logz, _, _ = enumerate_crf(emission, transition, lod)
+        nll = logz - gold_score(emission, transition, lod, label)
+        self.inputs = {
+            "Emission": (emission, lod),
+            "Transition": transition,
+            "Label": (label, lod),
+        }
+        self.outputs = {"LogLikelihood": nll[:, None]}
+
+    def test_output(self):
+        self.check_output(
+            no_check_set=("Alpha", "EmissionExps", "TransitionExps"))
+
+    def test_grad(self):
+        self.check_grad(["Emission", "Transition"],
+                        output_names=["LogLikelihood"])
+
+
+def test_crf_decoding_matches_enumeration():
+    D = 3
+    lod = [(0, 2, 6, 7)]
+    N = lod[0][-1]
+    emission = rng.randn(N, D).astype(np.float32)
+    transition = (rng.randn(D + 2, D).astype(np.float32)) * 0.7
+    expected = []
+    _, paths, _ = enumerate_crf(emission.astype(np.float64),
+                                transition.astype(np.float64), lod)
+    for p in paths:
+        expected.extend(p)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = fluid.layers.data(name="em", shape=[D], dtype="float32",
+                               lod_level=1)
+        block = main.global_block()
+        tr = block.create_var(name="tr", shape=[D + 2, D], dtype="float32")
+        path = fluid.layers.crf_decoding(input=em, param_attr=None)
+    # overwrite the auto-created transition param input by feeding directly
+    op = main.global_block().ops[-1]
+    op.inputs["Transition"] = ["tr"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out, = exe.run(main,
+                   feed={"em": fluid.LoDTensor(emission, lod), "tr": transition},
+                   fetch_list=[path])
+    np.testing.assert_array_equal(np.asarray(out.data).reshape(-1), expected)
+
+
+def test_chunk_eval_iob():
+    # two sequences, IOB with 2 chunk types: B0=0 I0=1 B1=2 I1=3 O=4
+    label = [0, 1, 4, 2, 3,    0, 4, 2]
+    inf = [0, 1, 4, 2, 2,    0, 4, 4]
+    # seq1 label chunks: (0,2,t0) (3,5,t1); inf chunks: (0,2,t0) (3,4,t1)(4,5,t1)
+    # seq2 label chunks: (0,1,t0) (2,3,t1); inf chunks: (0,1,t0)
+    lod = [(0, 5, 8)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.data(name="i", shape=[1], dtype="int64",
+                              lod_level=1)
+        l = fluid.layers.data(name="l", shape=[1], dtype="int64",
+                              lod_level=1)
+        outs = fluid.layers.chunk_eval(input=i, label=l,
+                                       chunk_scheme="IOB",
+                                       num_chunk_types=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed={
+        "i": fluid.LoDTensor(np.array(inf)[:, None].astype(np.int64), lod),
+        "l": fluid.LoDTensor(np.array(label)[:, None].astype(np.int64), lod),
+    }, fetch_list=list(outs))
+    precision, recall, f1, n_inf, n_lab, n_cor = [np.asarray(x) for x in res]
+    assert n_inf == 4 and n_lab == 4 and n_cor == 2
+    np.testing.assert_allclose(precision, 0.5)
+    np.testing.assert_allclose(recall, 0.5)
+    np.testing.assert_allclose(f1, 0.5)
