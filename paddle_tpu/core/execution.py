@@ -86,8 +86,12 @@ class ExecContext:
 
     def pure(self) -> "ExecContext":
         """Context for re-tracing a forward op inside its VJP: same rng
-        stream restarted so forward recomputation matches (XLA CSEs it)."""
+        stream restarted so forward recomputation matches (XLA CSEs it).
+        Carries op/env so sub-block ops (dynamic_rnn) stay resolvable."""
         c = ExecContext(self._rng_key, self.scope, self.executor, self.compiled)
+        c.op = getattr(self, "op", None)
+        c.env = getattr(self, "env", None)
+        c.root = getattr(self, "root", None)
         return c
 
 

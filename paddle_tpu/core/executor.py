@@ -193,25 +193,12 @@ class Executor:
 
     # -- interpreter ---------------------------------------------------------
     def _has_host_ops(self, block) -> bool:
-        for op in block.ops:
-            try:
-                info = registry.get_op_info(op.type)
-            except KeyError:
-                return True
-            if info.host:
-                return True
-            sub = op.sub_block() if "sub_block" in op.attrs else None
-            if sub is not None and self._has_host_ops(sub):
-                return True
-        return False
+        return any(self._op_is_host(op) for op in block.ops)
 
-    def _run_interpreted(self, program, block, scope, feed, fetch_names, key):
-        device = self.place.jax_device()
-        local = scope.new_scope()
-        # route persistable writes to the root scope (executor.cc:88-117)
-        persistable = {
-            v.name for v in program.list_vars() if v.persistable
-        }
+    def _scope_env(self, program, scope, local):
+        """ScopeEnv routing persistable writes to the root scope
+        (executor.cc:88-117); shared by interpreted and segmented modes."""
+        persistable = {v.name for v in program.list_vars() if v.persistable}
         root = scope
         while root.parent is not None:
             root = root.parent
@@ -224,19 +211,28 @@ class Executor:
                     self.scope.set_var(name, value, local=True)
                 self.written.add(name)
 
-        env = _Env(local)
+        return _Env(local)
+
+    @staticmethod
+    def _fetch(env, fetch_names):
+        missing = [n for n in fetch_names if not env.has(n)]
+        if missing:
+            raise KeyError(
+                f"fetch variable(s) {missing} were never produced by "
+                "the program")
+        return [env.get(n) for n in fetch_names]
+
+    def _run_interpreted(self, program, block, scope, feed, fetch_names, key):
+        device = self.place.jax_device()
+        local = scope.new_scope()
+        env = self._scope_env(program, scope, local)
         with jax.default_device(device):
             for name, v in feed.items():
                 env.set(name, _to_device_value(v, device))
             ctx = ExecContext(key, scope=local, executor=self)
             for op in block.ops:
                 run_op(ctx, op, env)
-            missing = [n for n in fetch_names if not env.has(n)]
-            if missing:
-                raise KeyError(
-                    f"fetch variable(s) {missing} were never produced by "
-                    "the program")
-            outs = [env.get(n) for n in fetch_names]
+            outs = self._fetch(env, fetch_names)
         scope.kids.remove(local)
         return outs
 
@@ -270,20 +266,7 @@ class Executor:
         is identical across interpreted/compiled/segmented modes."""
         device = self.place.jax_device()
         local = scope.new_scope()
-        persistable = {v.name for v in program.list_vars() if v.persistable}
-        root = scope
-        while root.parent is not None:
-            root = root.parent
-
-        class _Env(ScopeEnv):
-            def set(self, name, value):
-                if name in persistable:
-                    root.set_var(name, value)
-                else:
-                    self.scope.set_var(name, value, local=True)
-                self.written.add(name)
-
-        env = _Env(local)
+        env = self._scope_env(program, scope, local)
         fp = self._fingerprint(program)
         with jax.default_device(device):
             for name, v in feed.items():
@@ -295,12 +278,7 @@ class Executor:
                         run_op(ctx, op, env)
                     continue
                 self._run_segment_compiled(fp, seg_idx, ops, env, key)
-            missing = [n for n in fetch_names if not env.has(n)]
-            if missing:
-                raise KeyError(
-                    f"fetch variable(s) {missing} were never produced by "
-                    "the program")
-            outs = [env.get(n) for n in fetch_names]
+            outs = self._fetch(env, fetch_names)
         scope.kids.remove(local)
         return outs
 

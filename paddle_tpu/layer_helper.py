@@ -111,10 +111,10 @@ class LayerHelper:
         Reference semantics (param_attr.py ParamAttr.to_attr(None) ->
         default ParamAttr): bias_attr=None means a DEFAULT bias is created;
         only bias_attr=False disables it."""
-        size = list(input_var.shape[dim_start:dim_end])
         bias_attr = self.bias_attr
         if bias_attr is False:
             return input_var
+        size = list(input_var.shape[dim_start:dim_end])
         if bias_attr is None or bias_attr is True:
             bias_attr = {}
         b = self.create_parameter(bias_attr, shape=size,

@@ -1,0 +1,421 @@
+"""Control-flow DSL: While, StaticRNN, DynamicRNN, tensor arrays, LoD rank
+tables, and beam-search wiring.
+
+Reference: /root/reference/python/paddle/v2/fluid/layers/control_flow.py
+(While :various, StaticRNN, DynamicRNN, array ops, lod_rank_table) — same
+public API, but the recurrent constructs compile to the single scan-based
+`dynamic_rnn` op (ops/control_flow.py) instead of while_op + tensor-array
+plumbing, and `While` itself is the host-interpreted escape hatch used by
+dynamic-shape decode loops (beam search).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core.framework import Variable, unique_name
+from ..core.types import VarType, is_float_dtype
+from ..layer_helper import LayerHelper
+from .tensor import fill_constant
+
+__all__ = [
+    "While",
+    "StaticRNN",
+    "DynamicRNN",
+    "less_than",
+    "equal",
+    "increment",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "lod_rank_table",
+    "max_sequence_len",
+    "lod_tensor_to_array",
+    "array_to_lod_tensor",
+    "shrink_memory",
+    "reorder_lod_tensor_by_rank",
+    "beam_search",
+    "beam_search_decode",
+]
+
+
+# ---------------------------------------------------------------------------
+# small wrappers (cond-style outputs)
+# ---------------------------------------------------------------------------
+
+
+def less_than(x, y, cond=None, **ignored):
+    """x < y elementwise; writes into `cond` if given (reference
+    layers.less_than with the in-place cond idiom used by While loops)."""
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_tmp_variable("bool")
+        cond.stop_gradient = True
+    helper.append_op("less_than", {"X": [x.name], "Y": [y.name]},
+                     {"Out": [cond.name]})
+    return cond
+
+
+def equal(x, y, cond=None, **ignored):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_tmp_variable("bool")
+        cond.stop_gradient = True
+    helper.append_op("equal", {"X": [x.name], "Y": [y.name]},
+                     {"Out": [cond.name]})
+    return cond
+
+
+# re-exported so `layers.increment` keeps working after the wildcard import
+# of this module shadows layers.tensor — single definition lives there
+from .tensor import increment  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays
+# ---------------------------------------------------------------------------
+
+
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.block.create_var(
+        name=unique_name("array"), dtype=dtype,
+        type=VarType.LOD_TENSOR_ARRAY)
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    if array.shape is None:
+        array.shape = x.shape  # element shape hint for downstream layers
+    helper.append_op("write_to_array",
+                     {"X": [x.name], "I": [i.name]},
+                     {"Out": [array.name]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable(array.dtype)
+    out.shape = array.shape
+    helper.append_op("read_from_array",
+                     {"X": [array.name], "I": [i.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable("int64")
+    out.stop_gradient = True
+    helper.append_op("lod_array_length", {"X": [array.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LoD rank table machinery
+# ---------------------------------------------------------------------------
+
+
+def lod_rank_table(x, level=0):
+    helper = LayerHelper("lod_rank_table")
+    table = helper.block.create_var(
+        name=unique_name("lod_rank_table"), dtype=None,
+        type=VarType.LOD_RANK_TABLE)
+    helper.append_op("lod_rank_table", {"X": [x.name]},
+                     {"Out": [table.name]}, {"level": level})
+    return table
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper("max_seqence_len")
+    out = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op("max_sequence_len", {"RankTable": [rank_table.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    helper = LayerHelper("lod_tensor_to_array")
+    array = helper.block.create_var(
+        name=unique_name("lod_tensor_to_array"), dtype=x.dtype,
+        type=VarType.LOD_TENSOR_ARRAY)
+    helper.append_op("lod_tensor_to_array",
+                     {"X": [x.name], "RankTable": [table.name]},
+                     {"Out": [array.name]})
+    return array
+
+
+def array_to_lod_tensor(x, table):
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("array_to_lod_tensor",
+                     {"X": [x.name], "RankTable": [table.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def shrink_memory(x, i, table):
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("shrink_rnn_memory",
+                     {"X": [x.name], "I": [i.name],
+                      "RankTable": [table.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     {"X": [x.name], "RankTable": [rank_table.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+
+def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0):
+    """One beam-search step (reference layers.beam_search)."""
+    helper = LayerHelper("beam_search")
+    selected_ids = helper.create_tmp_variable("int64", stop_gradient=True)
+    selected_scores = helper.create_tmp_variable("float32",
+                                                 stop_gradient=True)
+    selected_ids.lod_level = 2
+    selected_scores.lod_level = 2
+    helper.append_op(
+        "beam_search",
+        {"pre_ids": [pre_ids.name], "ids": [ids.name],
+         "scores": [scores.name]},
+        {"selected_ids": [selected_ids.name],
+         "selected_scores": [selected_scores.name]},
+        {"level": int(level), "beam_size": int(beam_size),
+         "end_id": int(end_id)})
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores):
+    helper = LayerHelper("beam_search_decode")
+    sentence_ids = helper.create_tmp_variable("int64", stop_gradient=True)
+    sentence_scores = helper.create_tmp_variable("float32",
+                                                 stop_gradient=True)
+    sentence_ids.lod_level = 2
+    sentence_scores.lod_level = 2
+    helper.append_op(
+        "beam_search_decode",
+        {"Ids": [ids.name], "Scores": [scores.name]},
+        {"SentenceIds": [sentence_ids.name],
+         "SentenceScores": [sentence_scores.name]})
+    return sentence_ids, sentence_scores
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+
+
+class While:
+    """Host-interpreted while loop over a sub-block (reference
+    layers.While / while_op.cc).  The body runs in the surrounding variable
+    environment, so condition updates and array writes persist."""
+
+    def __init__(self, cond, name=None):
+        if cond.dtype not in ("bool",):
+            raise TypeError("While condition must be a bool variable")
+        self.cond = cond
+        self.helper = LayerHelper("while", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent = program.current_block
+        sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        parent.append_op(
+            "while",
+            {"Condition": [self.cond.name], "X": []},
+            {"Out": []},
+            {"sub_block": {"__block__": sub.idx}})
+
+
+# ---------------------------------------------------------------------------
+# shared RNN builder (StaticRNN and DynamicRNN both emit `dynamic_rnn`)
+# ---------------------------------------------------------------------------
+
+
+class _RNNBase:
+    _is_dynamic = True
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper(
+            "dynamic_rnn" if self._is_dynamic else "static_rnn", name=name)
+        self.sub = None
+        self.parent = None
+        self._step_inputs = []     # (parent var, placeholder)
+        self._static_inputs = []   # (parent var, placeholder)
+        self._memories = []        # dicts: placeholder/init/shape/value/dtype
+        self._mem_updates = {}     # placeholder name -> update var name
+        self._outputs = []         # sub-block vars
+        self._result_vars = None
+        self._finalized = False
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        self.parent = program.current_block
+        self.sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        self._finalize()  # only on clean exit — don't mask body errors
+
+    # -- user API ------------------------------------------------------------
+    def step_input(self, x):
+        assert self.sub is not None, "step_input must be called in block()"
+        # dynamic: per-step value is [B, ...feature]; static: axis 0 IS the
+        # time axis, so a step sees exactly x.shape[1:]
+        ph_shape = ((-1,) + tuple(x.shape[1:]) if self._is_dynamic
+                    else tuple(x.shape[1:]))
+        ph = self.sub.create_var(
+            name=unique_name("rnn_step_in"), shape=ph_shape, dtype=x.dtype)
+        self._step_inputs.append((x, ph))
+        return ph
+
+    def static_input(self, x):
+        ph = self.sub.create_var(
+            name=unique_name("rnn_static_in"),
+            shape=x.shape, dtype=x.dtype)
+        self._static_inputs.append((x, ph))
+        return ph
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32",
+               need_reorder=False, batch_ref=None, init_value=None,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is not None:
+            ph = self.sub.create_var(
+                name=unique_name("rnn_mem"),
+                shape=init.shape, dtype=init.dtype)
+            self._memories.append({"ph": ph, "init_var": init, "init": True})
+        else:
+            assert shape is not None, "memory needs init= or shape="
+            if init_value is not None:
+                value = init_value
+            # dynamic: runtime value is [B, ...shape]; static: exactly shape
+            mem_shape = ((-1,) + tuple(shape) if self._is_dynamic
+                         else tuple(shape))
+            ph = self.sub.create_var(
+                name=unique_name("rnn_mem"), shape=mem_shape, dtype=dtype)
+            self._memories.append({
+                "ph": ph, "init_var": None, "init": False,
+                "shape": [int(s) for s in shape], "value": float(value),
+                "dtype": dtype})
+        return ph
+
+    def update_memory(self, mem, new):
+        self._mem_updates[mem.name] = new.name
+
+    def output(self, *outputs):
+        self._outputs.extend(outputs)
+
+    step_output = output
+
+    def __call__(self, *a, **kw):
+        assert self._finalized, "use `with rnn.block():` before rnn()"
+        if len(self._result_vars) == 1:
+            return self._result_vars[0]
+        return list(self._result_vars)
+
+    # -- finalization --------------------------------------------------------
+    def _captured_names(self):
+        local = set(self.sub.vars.keys())
+        captured = []
+        for op in self.sub.ops:
+            for n in op.input_names():
+                if n in ("", "@EMPTY@") or n in local or n in captured:
+                    continue
+                if self.parent.has_var(n):
+                    captured.append(n)
+        return captured
+
+    def _finalize(self):
+        assert self._outputs, "rnn block must declare at least one output"
+        assert self._mem_updates.keys() == {
+            m["ph"].name for m in self._memories
+        }, "every memory needs exactly one update_memory call"
+        cap_f, cap_i = [], []
+        for n in self._captured_names():
+            v = self.parent.var(n)
+            if v.dtype is not None and is_float_dtype(v.dtype):
+                cap_f.append(n)
+            else:
+                cap_i.append(n)
+        mem_specs = []
+        init_vars = []
+        for m in self._memories:
+            if m["init"]:
+                mem_specs.append({"init": True})
+                init_vars.append(m["init_var"].name)
+            else:
+                mem_specs.append({
+                    "init": False, "shape": m["shape"],
+                    "value": m["value"], "dtype": m["dtype"],
+                    "batch_ref": self._is_dynamic})
+        out_vars = []
+        lod_level = (self._step_inputs[0][0].lod_level
+                     if self._is_dynamic else 0)
+        for ov in self._outputs:
+            res = self.parent.create_var(
+                name=unique_name("rnn_out"),
+                shape=ov.shape, dtype=ov.dtype, lod_level=lod_level)
+            out_vars.append(res)
+        self.parent.append_op(
+            "dynamic_rnn",
+            {"StepInputs": [x.name for x, _ in self._step_inputs],
+             "InitMemories": init_vars,
+             "StaticInputs": [x.name for x, _ in self._static_inputs],
+             "Captured": cap_f,
+             "CapturedNoGrad": cap_i},
+            {"Outs": [v.name for v in out_vars]},
+            {"sub_block": {"__block__": self.sub.idx},
+             "is_dynamic": self._is_dynamic,
+             "step_input_names": [p.name for _, p in self._step_inputs],
+             "static_input_names": [p.name for _, p in self._static_inputs],
+             "memory_names": [m["ph"].name for m in self._memories],
+             "memory_update_names": [
+                 self._mem_updates[m["ph"].name] for m in self._memories],
+             "memory_specs": mem_specs,
+             "output_names": [v.name for v in self._outputs]})
+        self._result_vars = out_vars
+        self._finalized = True
+
+
+class DynamicRNN(_RNNBase):
+    """Variable-length RNN over LoD step inputs (reference
+    layers/control_flow.py DynamicRNN).  Lowers to one lax.scan with
+    padding+masking — see ops/control_flow.py dynamic_rnn."""
+
+    _is_dynamic = True
+
+
+class StaticRNN(_RNNBase):
+    """Fixed-length RNN stepping axis 0 of dense inputs (reference
+    recurrent_op.cc / layers StaticRNN)."""
+
+    _is_dynamic = False
+
+    @contextlib.contextmanager
+    def step(self):
+        with self.block():
+            yield

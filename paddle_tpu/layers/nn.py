@@ -100,6 +100,7 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     w = helper.create_parameter(param_attr, size, dtype, suffix="w")
     tmp = helper.create_tmp_variable(dtype)
     tmp.lod_level = input.lod_level
+    tmp.shape = (-1, int(size[1]))
     helper.append_op(
         "lookup_table", {"Ids": [input.name], "W": [w.name]},
         {"Out": [tmp.name]},

@@ -29,16 +29,14 @@ from ..core.registry import register_op
 
 def _pad_layout(lod):
     """Static (numpy) padding layout from LoD offsets:
-    -> (idx [S,T], mask [S,T], lens [S])."""
+    -> (idx [S,T], mask [S,T] bool, lens [S]); shares the builder with
+    ops/sequence.py."""
+    from .sequence import lod_to_padded_index
+
     offs = lod[0]
-    lens = np.diff(np.asarray(offs, np.int64))
-    S, T = len(lens), int(lens.max()) if len(lens) else 0
-    idx = np.zeros((S, T), np.int32)
-    mask = np.zeros((S, T), bool)
-    for s in range(S):
-        idx[s, : lens[s]] = np.arange(offs[s], offs[s + 1], dtype=np.int32)
-        mask[s, : lens[s]] = True
-    return idx, mask, lens.astype(np.int32)
+    idx, maskf = lod_to_padded_index(offs)
+    lens = np.diff(np.asarray(offs, np.int64)).astype(np.int32)
+    return idx, maskf.astype(bool), lens
 
 
 def _split_transition(transition):
@@ -173,52 +171,74 @@ def crf_decoding(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+# (num_tag_types, tag_begin, tag_inside, tag_end, tag_single) per scheme —
+# chunk_eval_op.h Compute's scheme table
+_SCHEMES = {
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_end(prev_tag, prev_type, tag, type_, other, tb, ti, te, ts):
+    """Faithful port of chunk_eval_op.h ChunkEnd."""
+    if prev_type == other:
+        return False
+    if type_ == other:
+        return True
+    if type_ != prev_type:
+        return True
+    if prev_tag == tb:
+        return tag in (tb, ts)
+    if prev_tag == ti:
+        return tag in (tb, ts)
+    if prev_tag in (te, ts):
+        return True
+    return False
+
+
+def _chunk_begin(prev_tag, prev_type, tag, type_, other, tb, ti, te, ts):
+    """Faithful port of chunk_eval_op.h ChunkBegin."""
+    if prev_type == other:
+        return type_ != other
+    if type_ == other:
+        return False
+    if type_ != prev_type:
+        return True
+    if tag == tb:
+        return True
+    if tag in (ti, te):
+        return prev_tag in (te, ts)
+    if tag == ts:
+        return True
+    return False
+
+
 def _extract_chunks(tags, scheme, num_chunk_types, excluded):
-    """-> set of (begin, end_exclusive, type) segments in one sequence."""
+    """-> set of (begin, end_inclusive, type) segments in one sequence
+    (port of chunk_eval_op.h GetSegments)."""
+    n_tag, tb, ti, te, ts = _SCHEMES[scheme]
+    other = num_chunk_types
     chunks = []
-    if scheme == "plain":
-        cur_type, cur_start = None, None
-        for i, t in enumerate(list(tags) + [-1]):
-            ty = int(t) if 0 <= t < num_chunk_types else None
-            if ty != cur_type:
-                if cur_type is not None:
-                    chunks.append((cur_start, i, cur_type))
-                cur_type, cur_start = ty, i
-        return {c for c in chunks if c[2] not in excluded}
-    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
-    begin_tag = {"IOB": 0, "IOE": None, "IOBES": 0}[scheme]
-    cur = None  # (start, type)
+    in_chunk = False
+    chunk_start = 0
+    tag, type_ = -1, other
     for i, t in enumerate(tags):
+        prev_tag, prev_type = tag, type_
         t = int(t)
-        inside = 0 <= t < num_chunk_types * n_tag
-        ty = t // n_tag if inside else None
-        tag = t % n_tag if inside else None
-        if scheme == "IOB":
-            starts = inside and (tag == 0)
-            cont = inside and (tag == 1)
-        elif scheme == "IOE":
-            starts = inside and cur is None
-            cont = inside
-        else:  # IOBES: B=0 I=1 E=2 S=3
-            starts = inside and tag in (0, 3)
-            cont = inside and tag in (1, 2)
-        if cur is not None and (not cont or ty != cur[1] or starts):
-            chunks.append((cur[0], i, cur[1]))
-            cur = None
-        if cur is None and starts:
-            cur = (i, ty)
-        elif cur is None and cont and scheme == "IOE":
-            cur = (i, ty)
-        # sequence enders
-        if cur is not None:
-            if scheme == "IOBES" and tag in (2, 3):
-                chunks.append((cur[0], i + 1, cur[1]))
-                cur = None
-            elif scheme == "IOE" and tag == 1:
-                chunks.append((cur[0], i + 1, cur[1]))
-                cur = None
-    if cur is not None and scheme not in ("IOE", "IOBES"):
-        chunks.append((cur[0], len(tags), cur[1]))
+        tag = t % n_tag
+        type_ = t // n_tag
+        if in_chunk and _chunk_end(prev_tag, prev_type, tag, type_, other,
+                                   tb, ti, te, ts):
+            chunks.append((chunk_start, i - 1, prev_type))
+            in_chunk = False
+        if _chunk_begin(prev_tag, prev_type, tag, type_, other,
+                        tb, ti, te, ts):
+            chunk_start = i
+            in_chunk = True
+    if in_chunk:
+        chunks.append((chunk_start, len(tags) - 1, type_))
     return {c for c in chunks if c[2] not in excluded}
 
 

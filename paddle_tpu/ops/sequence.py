@@ -119,29 +119,24 @@ def sequence_softmax(ctx, ins, attrs):
 @register_op("sequence_expand", inputs=("X", "Y"), outputs=("Out",),
              diff_inputs=("X",))
 def sequence_expand(ctx, ins, attrs):
-    """Expand X's sequences to match Y's outer LoD (reference
-    sequence_expand_op.cc): X item i is repeated len(Y seq i) times."""
+    """Row-wise expansion (exact reference sequence_expand_op.h kernel
+    semantics): X row i is repeated len(Y.lod[-1] sequence i) times —
+    requires len(Y.lod[-1]) - 1 == X rows; Out.lod = Y.lod.  X's own LoD
+    does not influence the expansion (also the beam-search decode idiom:
+    one state row per prefix, Y's inner LoD maps prefixes -> candidates)."""
     xv = one(ins, "X")
     yv = one(ins, "Y")
-    y_lod = yv.lod[0]
-    y_lens = _seq_lens(y_lod)
     x = data_of(xv)
-    if isinstance(xv, LoDTensor) and xv.lod:
-        x_lod = xv.lod[-1]
-        reps, out_lens = [], []
-        for i, yl in enumerate(y_lens):
-            seq_rows = list(range(x_lod[i], x_lod[i + 1]))
-            for _ in range(yl):
-                reps.extend(seq_rows)
-            out_lens.append(yl * len(seq_rows))
-        out_lod = [lod_from_seq_lens(out_lens)]
-    else:
-        reps = []
-        for i, yl in enumerate(y_lens):
-            reps.extend([i] * yl)
-        out_lod = [lod_from_seq_lens(y_lens)]
+    y_lod = yv.lod[-1]
+    y_lens = _seq_lens(y_lod)
+    assert len(y_lens) == x.shape[0], (
+        f"sequence_expand: X has {x.shape[0]} rows but Y's last LoD level "
+        f"has {len(y_lens)} sequences")
+    reps = []
+    for i, yl in enumerate(y_lens):
+        reps.extend([i] * yl)
     out = jnp.take(x, jnp.asarray(np.asarray(reps, np.int32)), axis=0)
-    return {"Out": LoDTensor(out, out_lod)}
+    return {"Out": LoDTensor(out, list(yv.lod))}
 
 
 @register_op("sequence_concat", inputs=("X",), outputs=("Out",),

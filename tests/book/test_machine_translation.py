@@ -1,0 +1,178 @@
+"""book/08 machine_translation — seq2seq encoder-decoder + beam-search decode.
+
+Reference: /root/reference/python/paddle/v2/fluid/tests/book/
+test_machine_translation.py (LSTM encoder, DynamicRNN train decoder,
+While + beam_search/beam_search_decode generation).  Synthetic copy task:
+the target sequence equals the source sequence — the decoder must learn to
+reproduce the source from the encoder context and its own previous outputs.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+DICT = 12          # tokens 0..11; 0 = <s>, 1 = <e>
+START, END = 0, 1
+WORD_DIM = 16
+HIDDEN = 32
+MAX_LEN = 6
+BEAM = 2
+TOPK = 4
+
+
+def encoder():
+    src = fluid.layers.data(name="src_word_id", shape=[1], dtype="int64",
+                            lod_level=1)
+    emb = fluid.layers.embedding(input=src, size=[DICT, WORD_DIM],
+                                 param_attr={"name": "vemb"})
+    fc1 = fluid.layers.fc(input=emb, size=HIDDEN * 4, act="tanh")
+    hidden, _ = fluid.layers.dynamic_lstm(input=fc1, size=HIDDEN * 4,
+                                          use_peepholes=False)
+    return fluid.layers.sequence_last_step(input=hidden)
+
+
+def decoder_train(context):
+    trg = fluid.layers.data(name="target_language_word", shape=[1],
+                            dtype="int64", lod_level=1)
+    trg_emb = fluid.layers.embedding(input=trg, size=[DICT, WORD_DIM],
+                                     param_attr={"name": "vemb"})
+    rnn = fluid.layers.DynamicRNN()
+    with rnn.block():
+        word = rnn.step_input(trg_emb)
+        pre_state = rnn.memory(init=context)
+        state = fluid.layers.fc(input=[word, pre_state], size=HIDDEN,
+                                act="tanh")
+        score = fluid.layers.fc(input=state, size=DICT, act="softmax")
+        rnn.update_memory(pre_state, state)
+        rnn.output(score)
+    return rnn()
+
+
+def decoder_decode(context):
+    """Beam-search generation loop (reference decoder_decode)."""
+    pd = fluid.layers
+    array_len = pd.fill_constant(shape=[1], dtype="int64", value=MAX_LEN)
+    counter = pd.zeros(shape=[1], dtype="int64")
+
+    state_array = pd.create_array("float32")
+    pd.array_write(context, array=state_array, i=counter)
+    ids_array = pd.create_array("int64")
+    scores_array = pd.create_array("float32")
+
+    init_ids = pd.data(name="init_ids", shape=[1], dtype="int64", lod_level=2)
+    init_scores = pd.data(name="init_scores", shape=[1], dtype="float32",
+                          lod_level=2)
+    pd.array_write(init_ids, array=ids_array, i=counter)
+    pd.array_write(init_scores, array=scores_array, i=counter)
+
+    cond = pd.less_than(x=counter, y=array_len)
+    while_op = pd.While(cond=cond)
+    with while_op.block():
+        pre_ids = pd.array_read(array=ids_array, i=counter)
+        pre_state = pd.array_read(array=state_array, i=counter)
+        pre_score = pd.array_read(array=scores_array, i=counter)
+
+        pre_state_expanded = pd.sequence_expand(pre_state, pre_score)
+        pre_ids_emb = pd.embedding(input=pre_ids, size=[DICT, WORD_DIM],
+                                   param_attr={"name": "vemb"})
+        state = pd.fc(input=[pre_ids_emb, pre_state_expanded], size=HIDDEN,
+                      act="tanh")
+        score = pd.fc(input=state, size=DICT, act="softmax")
+        topk_scores, topk_indices = pd.topk(score, k=TOPK)
+        selected_ids, selected_scores = pd.beam_search(
+            pre_ids, topk_indices, topk_scores, BEAM, end_id=END, level=0)
+
+        pd.increment(x=counter, value=1, in_place=True)
+        pd.array_write(state, array=state_array, i=counter)
+        pd.array_write(selected_ids, array=ids_array, i=counter)
+        pd.array_write(selected_scores, array=scores_array, i=counter)
+        pd.less_than(x=counter, y=array_len, cond=cond)
+
+    return pd.beam_search_decode(ids=ids_array, scores=scores_array)
+
+
+def _to_lod(seqs, dtype=np.int64):
+    flat = np.concatenate(seqs).astype(dtype).reshape(-1, 1)
+    lens = [len(s) for s in seqs]
+    lod = [0]
+    for ln in lens:
+        lod.append(lod[-1] + ln)
+    return LoDTensor(flat, [lod])
+
+
+def _make_batch(r, n=8):
+    """Copy task: src = random tokens, trg_in = <s>+src, trg_next = src+<e>."""
+    srcs, trg_in, trg_next = [], [], []
+    for _ in range(n):
+        ln = int(r.randint(2, 5))
+        s = r.randint(2, DICT, (ln,))
+        srcs.append(s)
+        trg_in.append(np.concatenate([[START], s]))
+        trg_next.append(np.concatenate([s, [END]]))
+    return _to_lod(srcs), _to_lod(trg_in), _to_lod(trg_next)
+
+
+def test_machine_translation_train():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        context = encoder()
+        rnn_out = decoder_train(context)
+        label = fluid.layers.data(name="target_language_next_word",
+                                  shape=[1], dtype="int64", lod_level=1)
+        cost = fluid.layers.cross_entropy(input=rnn_out, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    batches = [_make_batch(r) for _ in range(4)]
+    first = last = None
+    for step in range(150):
+        src, trg, nxt = batches[step % len(batches)]
+        c, = exe.run(main,
+                     feed={"src_word_id": src,
+                           "target_language_word": trg,
+                           "target_language_next_word": nxt},
+                     fetch_list=[avg_cost])
+        if first is None:
+            first = float(c[0])
+        last = float(c[0])
+    assert last < 1.0, f"seq2seq train cost did not drop: {first} -> {last}"
+    assert last < first * 0.5
+
+
+def test_machine_translation_decode():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        context = encoder()
+        translation_ids, translation_scores = decoder_decode(context)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(1)
+    src, _, _ = _make_batch(r, n=3)
+    n_src = 3
+    init_ids = LoDTensor(
+        np.full((n_src, 1), START, np.int64),
+        [list(range(n_src + 1)), list(range(n_src + 1))])
+    init_scores = LoDTensor(
+        np.ones((n_src, 1), np.float32),
+        [list(range(n_src + 1)), list(range(n_src + 1))])
+    ids, scores = exe.run(
+        main,
+        feed={"src_word_id": src, "init_ids": init_ids,
+              "init_scores": init_scores},
+        fetch_list=[translation_ids, translation_scores])
+    # structure: one entry per source sentence, >=1 candidate each
+    assert len(ids.lod[0]) - 1 == n_src
+    n_cand = ids.lod[0][-1]
+    assert n_cand >= n_src  # at least one candidate per source
+    assert ids.lod == scores.lod
+    # every candidate sentence is non-empty, max MAX_LEN+1 tokens, in-vocab
+    sent = ids.lod[1]
+    flat = np.asarray(ids.data).reshape(-1)
+    for i in range(len(sent) - 1):
+        words = flat[sent[i]:sent[i + 1]]
+        assert 1 <= len(words) <= MAX_LEN + 1
+        assert ((words >= 0) & (words < DICT)).all()

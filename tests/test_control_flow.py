@@ -1,0 +1,253 @@
+"""Control-flow tests: While + arrays, StaticRNN, DynamicRNN, rank tables,
+beam search.
+
+Modeled on reference tests: test_while_op.py, test_recurrent_op.py,
+test_dyn_rnn.py, test_lod_rank_table.py, test_beam_search_op.py,
+test_beam_search_decode_op.py.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_while_with_arrays():
+    """Sum i=0..9 via a While loop writing to a tensor array
+    (reference test_while_op.py shape)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=10)
+        counter = fluid.layers.zeros(shape=[1], dtype="int64")
+        total = fluid.layers.zeros(shape=[1], dtype="float32")
+        arr = fluid.layers.create_array("float32")
+        cond = fluid.layers.less_than(x=counter, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            val = fluid.layers.cast(counter, "float32")
+            new_total = fluid.layers.elementwise_add(total, val)
+            fluid.layers.assign(new_total, output=total)
+            fluid.layers.array_write(val, i=counter, array=arr)
+            fluid.layers.increment(x=counter, value=1, in_place=True)
+            fluid.layers.less_than(x=counter, y=limit, cond=cond)
+        length = fluid.layers.array_length(arr)
+        last = fluid.layers.array_read(arr, i=fluid.layers.fill_constant(
+            shape=[1], dtype="int64", value=9))
+    exe = _exe()
+    exe.run(startup)
+    t, ln, lv = exe.run(main, fetch_list=[total, length, last])
+    assert float(t[0]) == sum(range(10))
+    assert int(ln[0]) == 10
+    assert float(lv[0]) == 9.0
+
+
+def test_static_rnn_matches_numpy():
+    """StaticRNN accumulator h_t = tanh(x_t W + h_{t-1} U) vs numpy."""
+    T, B, D = 5, 3, 4
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[B, D], dtype="float32")
+        x.shape = (T, B, D)  # static time-major input
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[B, D], value=0.0)
+            nh = fluid.layers.tanh(fluid.layers.elementwise_add(xt, h))
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+        last = fluid.layers.reduce_sum(out)
+    exe = _exe()
+    exe.run(startup)
+    xin = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+    res, = exe.run(main, feed={"x": xin}, fetch_list=[out])
+    h = np.zeros((B, D), np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(xin[t] + h)
+        want.append(h)
+    np.testing.assert_allclose(res, np.stack(want), rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_rnn_grad_flows():
+    """DynamicRNN over a ragged batch: forward matches per-sequence numpy
+    recurrence and grads reach captured fc weights."""
+    D, H = 3, 4
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32",
+                              lod_level=1)
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[H], value=0.0)
+            nh = fluid.layers.fc(input=[xt, h], size=H, act="tanh",
+                                 bias_attr=False)
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+        pooled = fluid.layers.sequence_pool(out, pool_type="last")
+        loss = fluid.layers.mean(pooled)
+        opt = fluid.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    lens = [3, 1, 2]
+    xin = np.random.RandomState(1).randn(sum(lens), D).astype(np.float32)
+    feed = {"x": LoDTensor(xin, [[0, 3, 4, 6]])}
+    l1, o1 = exe.run(main, feed=feed, fetch_list=[loss, out])
+    # check forward against numpy using the trained-before weights is hard
+    # post-update; instead check shape/LoD and that repeated steps change loss
+    assert o1.data.shape == (sum(lens), H)
+    assert o1.lod == ((0, 3, 4, 6),)
+    losses = [float(l1[0])]
+    for _ in range(20):
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(l[0]))
+    assert losses[-1] < losses[0], "SGD on DynamicRNN did not reduce loss"
+
+
+def test_dynamic_rnn_forward_numeric():
+    """Forward-only DynamicRNN h_t = tanh(x_t + h) vs per-sequence numpy."""
+    D = 3
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32",
+                              lod_level=1)
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[D], value=0.0)
+            nh = fluid.layers.tanh(fluid.layers.elementwise_add(xt, h))
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+    exe = _exe()
+    exe.run(startup)
+    lens = [2, 4, 1]
+    lod = [0, 2, 6, 7]
+    xin = np.random.RandomState(2).randn(sum(lens), D).astype(np.float32)
+    o, = exe.run(main, feed={"x": LoDTensor(xin, [lod])}, fetch_list=[out])
+    want = np.zeros_like(xin)
+    for s in range(3):
+        h = np.zeros((D,), np.float32)
+        for r in range(lod[s], lod[s + 1]):
+            h = np.tanh(xin[r] + h)
+            want[r] = h
+    np.testing.assert_allclose(np.asarray(o.data), want, rtol=1e-5,
+                               atol=1e-5)
+    assert o.lod == (tuple(lod),)
+
+
+def test_lod_rank_table_and_reorder():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mx = fluid.layers.max_sequence_len(table)
+        reordered = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+    exe = _exe()
+    exe.run(startup)
+    data = np.arange(6, dtype=np.float32).reshape(6, 1)
+    feed = {"x": LoDTensor(data, [[0, 1, 4, 6]])}  # lens 1, 3, 2
+    m, r = exe.run(main, feed=feed, fetch_list=[mx, reordered])
+    assert int(m[0]) == 3
+    # rank order: seq1 (len3), seq2 (len2), seq0 (len1)
+    np.testing.assert_array_equal(
+        np.asarray(r.data).reshape(-1), [1, 2, 3, 4, 5, 0])
+    assert r.lod == ((0, 3, 5, 6),)
+
+
+def test_beam_search_step():
+    """The documented example from beam_search_op.h:39-92."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.data(name="pre_ids", shape=[1], dtype="int64",
+                                    lod_level=2)
+        ids = fluid.layers.data(name="ids", shape=[3], dtype="int64",
+                                lod_level=2)
+        scores = fluid.layers.data(name="scores", shape=[3], dtype="float32",
+                                   lod_level=2)
+        sel_ids, sel_scores = fluid.layers.beam_search(
+            pre_ids, ids, scores, beam_size=2, end_id=0, level=0)
+    exe = _exe()
+    exe.run(startup)
+    lod = [[0, 1, 3], [0, 1, 2, 3]]  # src0: 1 prefix; src1: 2 prefixes
+    ids_np = np.array([[4, 2, 5], [2, 1, 3], [3, 5, 2]], np.int64)
+    sc_np = np.array([[0.5, 0.3, 0.2], [0.6, 0.3, 0.1], [0.9, 0.5, 0.1]],
+                     np.float32)
+    pre_np = np.array([[1], [2], [3]], np.int64)
+    si, ss = exe.run(
+        main,
+        feed={"pre_ids": LoDTensor(pre_np, lod),
+              "ids": LoDTensor(ids_np, lod),
+              "scores": LoDTensor(sc_np, lod)},
+        fetch_list=[sel_ids, sel_scores])
+    # src0 top2: (4,.5),(2,.3) on prefix row 0; src1 top2 across its two
+    # prefixes: (2,.6) on row 1 and (3,.9) on row 2; rows sorted by
+    # (prefix, id) within each prefix
+    np.testing.assert_array_equal(
+        np.asarray(si.data).reshape(-1), [2, 4, 2, 3])
+    np.testing.assert_allclose(
+        np.asarray(ss.data).reshape(-1), [0.3, 0.5, 0.6, 0.9])
+    assert si.lod == ((0, 1, 3), (0, 2, 3, 4))
+
+
+def test_conditional_block():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+        out = fluid.layers.zeros(shape=[1], dtype="float32")
+        cond = fluid.layers.fill_constant(shape=[1], dtype="bool", value=True)
+        helper = fluid.layers.While  # noqa: F841 (namespace smoke)
+        program = main
+        parent = program.current_block
+        sub = program.create_block()
+        doubled = fluid.layers.scale(x, scale=2.0)
+        fluid.layers.assign(doubled, output=out)
+        program.rollback()
+        parent.append_op("conditional_block",
+                         {"X": [cond.name], "Params": []}, {"Out": []},
+                         {"sub_block": {"__block__": sub.idx},
+                          "is_scalar_condition": True})
+    exe = _exe()
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": np.asarray([3.0], np.float32)},
+                 fetch_list=[out])
+    assert float(o[0]) == 6.0
+
+
+def test_static_rnn_with_fc():
+    """Regression: fc inside StaticRNN must size weights from the feature
+    dim, not batch*feature (placeholder shape bug)."""
+    T, B, D, H = 4, 3, 5, 6
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[B, D], dtype="float32")
+        x.shape = (T, B, D)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[B, H], value=0.0)
+            nh = fluid.layers.fc(input=[xt, h], size=H, act="tanh",
+                                 bias_attr=False)
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+    exe = _exe()
+    exe.run(startup)
+    xin = np.random.RandomState(4).randn(T, B, D).astype(np.float32)
+    o, = exe.run(main, feed={"x": xin}, fetch_list=[out])
+    assert o.shape == (T, B, H)

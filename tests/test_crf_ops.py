@@ -135,3 +135,21 @@ def test_chunk_eval_iob():
     np.testing.assert_allclose(precision, 0.5)
     np.testing.assert_allclose(recall, 0.5)
     np.testing.assert_allclose(f1, 0.5)
+
+
+def test_chunk_extraction_reference_semantics():
+    """Cases pinned to chunk_eval_op.h ChunkBegin/ChunkEnd: I-after-O
+    starts a chunk in IOB; trailing unterminated IOE/IOBES chunks flush."""
+    from paddle_tpu.ops.crf import _extract_chunks
+
+    # IOB, 1 type: labels B0=0, I0=1, O=2
+    assert _extract_chunks([2, 1], "IOB", 1, set()) == {(1, 1, 0)}
+    # IOB, 2 types: B0,I0,B1,I1,O = 0,1,2,3,4 ; [B0, I1] -> two chunks
+    assert _extract_chunks([0, 3], "IOB", 2, set()) == {(0, 0, 0), (1, 1, 1)}
+    # IOE, 1 type: I0=0, E0=1, O=2 ; trailing I without E still flushes
+    assert _extract_chunks([0, 0], "IOE", 1, set()) == {(0, 1, 0)}
+    # IOBES, 1 type: B,I,E,S = 0..3, O=4 ; trailing B-I without E flushes
+    assert _extract_chunks([0, 1], "IOBES", 1, set()) == {(0, 1, 0)}
+    # IOBES full: S O B I E -> two chunks
+    assert _extract_chunks([3, 4, 0, 1, 2], "IOBES", 1, set()) == {
+        (0, 0, 0), (2, 4, 0)}
