@@ -9,7 +9,22 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
   * op corpus lowered to jax/lax; conv/matmul ride the MXU, collectives
     ride ICI via the parallel package
 """
-from . import initializer, io, layers, nets, regularizer  # noqa: F401
+from . import (  # noqa: F401
+    clip,
+    evaluator,
+    initializer,
+    io,
+    layers,
+    learning_rate_decay,
+    nets,
+    regularizer,
+)
+from .clip import (  # noqa: F401
+    ErrorClipByValue,
+    GradientClipByGlobalNorm,
+    GradientClipByNorm,
+    GradientClipByValue,
+)
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
 from .core import (  # noqa: F401
     CPUPlace,

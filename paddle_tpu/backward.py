@@ -130,7 +130,13 @@ def _default_grad_op(op, block, out_grad_names: Dict[str, str],
         g_outputs[slot + GRAD_SUFFIX] = out_names
     if not any_grad:
         return None
-    block.append_op(op.type + "_grad", g_inputs, g_outputs, dict(op.attrs))
+    grad_op = block.append_op(op.type + "_grad", g_inputs, g_outputs,
+                              dict(op.attrs))
+    # per-grad-op error clipping hook (reference backward.py invokes
+    # error_clip_callback for every created grad op)
+    from .clip import error_clip_callback
+
+    error_clip_callback(block, grad_op)
     return True
 
 

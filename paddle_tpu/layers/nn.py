@@ -20,6 +20,11 @@ __all__ = [
     "linear_chain_crf",
     "crf_decoding",
     "accuracy",
+    "auc",
+    "edit_distance",
+    "warpctc",
+    "ctc_align",
+    "nce",
     "chunk_eval",
     "conv2d",
     "conv2d_transpose",
@@ -220,6 +225,108 @@ def accuracy(input, label, k=1, correct=None, total=None):
         {"Accuracy": [acc_out.name], "Correct": [correct.name],
          "Total": [total.name]})
     return acc_out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over LoD sequences (reference layers/nn.py:2659 warpctc;
+    computed natively — see ops/ctc.py)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_tmp_variable("float32")
+    grad = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op(
+        "warpctc",
+        {"Logits": [input.name], "Label": [label.name]},
+        {"Loss": [loss.name], "WarpCTCGrad": [grad.name]},
+        {"blank": int(blank), "norm_by_times": bool(norm_by_times)})
+    return loss
+
+
+def ctc_align(input, blank=0, merge_repeated=True):
+    """Greedy CTC decode (reference ctc_align_op.cc)."""
+    helper = LayerHelper("ctc_align")
+    out = helper.create_tmp_variable("int64", stop_gradient=True)
+    out.lod_level = 1
+    helper.append_op("ctc_align", {"Input": [input.name]},
+                     {"Output": [out.name]},
+                     {"blank": int(blank),
+                      "merge_repeated": bool(merge_repeated)})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None):
+    """Noise-contrastive estimation loss (reference layers/nn.py:2769)."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    dim = int(input.shape[1])
+    w = helper.create_parameter(helper.param_attr,
+                                [num_total_classes, dim], input.dtype,
+                                suffix="w")
+    # bias_attr=False disables the bias (layer_helper convention); the nce
+    # op lowering handles Bias=None
+    b = None
+    if bias_attr is not False:
+        ba = {} if bias_attr in (None, True) else dict(bias_attr)
+        b = helper.create_parameter(ba, [num_total_classes], input.dtype,
+                                    is_bias=True, suffix="b")
+    if num_neg_samples is None:
+        num_neg_samples = 10
+    cost = helper.create_tmp_variable(input.dtype)
+    sample_logits = helper.create_tmp_variable(input.dtype,
+                                               stop_gradient=True)
+    sample_labels = helper.create_tmp_variable("int64", stop_gradient=True)
+    inputs = {"Input": [input.name], "Label": [label.name],
+              "Weight": [w.name]}
+    if b is not None:
+        inputs["Bias"] = [b.name]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight.name]
+    helper.append_op(
+        "nce", inputs,
+        {"Cost": [cost.name], "SampleLogits": [sample_logits.name],
+         "SampleLabels": [sample_labels.name]},
+        {"num_total_classes": int(num_total_classes),
+         "num_neg_samples": int(num_neg_samples)})
+    cost.shape = (-1, 1)
+    return cost
+
+
+def auc(input, label, curve="ROC", num_thresholds=200):
+    """Area-under-curve metric from prediction scores (reference
+    layers auc / auc_op.cc; the kernel reads raw scores, so no top_k
+    pre-pass is emitted)."""
+    helper = LayerHelper("auc")
+    auc_out = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op(
+        "auc",
+        {"Out": [input.name], "Label": [label.name]},
+        {"AUC": [auc_out.name]},
+        {"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out
+
+
+def edit_distance(input, label, normalized=False, ignored_tokens=None):
+    """Levenshtein distance between hypothesis and reference sequences
+    (reference layers edit_distance / edit_distance_op.cc)."""
+    helper = LayerHelper("edit_distance")
+    if ignored_tokens:
+        erased = helper.create_tmp_variable("int64")
+        helper.append_op("sequence_erase", {"X": [input.name]},
+                         {"Out": [erased.name]},
+                         {"tokens": list(ignored_tokens)})
+        input = erased
+        erased_l = helper.create_tmp_variable("int64")
+        helper.append_op("sequence_erase", {"X": [label.name]},
+                         {"Out": [erased_l.name]},
+                         {"tokens": list(ignored_tokens)})
+        label = erased_l
+    out = helper.create_tmp_variable("float32", stop_gradient=True)
+    seq_num = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op(
+        "edit_distance",
+        {"Hyps": [input.name], "Refs": [label.name]},
+        {"Out": [out.name], "SequenceNum": [seq_num.name]},
+        {"normalized": bool(normalized)})
+    return out, seq_num
 
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,

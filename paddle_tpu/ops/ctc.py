@@ -1,0 +1,155 @@
+"""CTC ops: warpctc (native CTC loss), ctc_align (greedy decode collapse).
+
+Reference: /root/reference/paddle/fluid/operators/warpctc_op.{cc,h} (dynload
+wrapper around Baidu warp-ctc + sequence_padding/sequence_scale plumbing) and
+ctc_align_op.{cc,h}.
+
+TPU design: instead of dynloading a CUDA library, CTC is computed natively —
+the standard log-space alpha recursion over the blank-extended label sequence,
+batched as ONE `lax.scan` over padded time (mask from the LoD, built host-side
+per bucket).  It is differentiable by construction through the generic VJP
+grad op (the reference needs warp-ctc's hand-written gradient)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, one
+from ..core.lod import LoDTensor, lod_from_seq_lens
+from ..core.registry import register_op
+from .sequence import lod_to_padded_index
+
+NEG_INF = -1e30
+
+
+def _logsumexp2(a, b):
+    """Numerically-safe log(e^a + e^b) for values that may be NEG_INF.
+    Differences are clipped so no exp(-inf)/log(0) appears even on the
+    untaken `where` branch (whose NaNs would poison the VJP)."""
+    m = jnp.maximum(a, b)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    da = jnp.clip(a - m_safe, -80.0, 0.0)
+    db = jnp.clip(b - m_safe, -80.0, 0.0)
+    out = m_safe + jnp.log(jnp.exp(da) + jnp.exp(db))
+    return jnp.where(m <= NEG_INF / 2, NEG_INF, out)
+
+
+def _logsumexp3(a, b, c):
+    return _logsumexp2(_logsumexp2(a, b), c)
+
+
+@register_op("warpctc", inputs=("Logits", "Label"),
+             outputs=("Loss", "WarpCTCGrad"),
+             attrs={"blank": 0, "norm_by_times": False},
+             diff_inputs=("Logits",), diff_outputs=("Loss",))
+def warpctc(ctx, ins, attrs):
+    """CTC negative log-likelihood per sequence.
+
+    Logits: LoD rows [sum(T_i), C] of UNNORMALIZED activations (the reference
+    applies softmax internally via warp-ctc); Label: LoD rows [sum(L_i), 1]
+    int; blank index = attrs["blank"].  Loss: [num_seqs, 1]."""
+    lv = one(ins, "Logits")
+    labv = one(ins, "Label")
+    blank = int(attrs.get("blank", 0))
+    logits_lod = lv.lod[-1]
+    label_lod = labv.lod[-1]
+
+    idx, mask = lod_to_padded_index(logits_lod)     # [B, Tmax]
+    B, Tmax = idx.shape
+    logp_rows = jax.nn.log_softmax(data_of(lv), axis=-1)
+    logp = logp_rows[idx]                            # [B, Tmax, C]
+    tmask = jnp.asarray(mask)                        # [B, Tmax]
+
+    # label VALUES are traced under jit; only the LoD layout is host-static
+    lab_lens = [label_lod[i + 1] - label_lod[i] for i in range(B)]
+    Lmax = max(lab_lens) if lab_lens else 0
+    S = 2 * Lmax + 1
+    lab_idx, lab_mask = lod_to_padded_index(label_lod)   # [B, Lmax] static
+    labels_flat = data_of(labv).reshape(-1).astype(jnp.int32)
+    lab_pad = jnp.where(jnp.asarray(lab_mask) > 0,
+                        labels_flat[jnp.asarray(lab_idx)], blank)
+    # blank-extended label sequences [B, S]: blank l1 blank l2 ... blank
+    ext_j = jnp.full((B, S), blank, jnp.int32)
+    ext_j = ext_j.at[:, 1::2].set(lab_pad)
+    ext_len = np.asarray([2 * ln + 1 for ln in lab_lens], np.int64)
+    # allow skip transition s-2 -> s when ext[s] != blank and != ext[s-2];
+    # S may be 1 (all-empty labels) -> no skips at all
+    skip_j = jnp.concatenate(
+        [jnp.zeros((B, min(2, S))),
+         ((ext_j[:, 2:] != blank) &
+          (ext_j[:, 2:] != ext_j[:, :-2])).astype(jnp.float32)], axis=1)
+
+    # alpha init: t=0 can start at s=0 (blank) or s=1 (first label)
+    lp0 = jnp.take_along_axis(logp[:, 0, :], ext_j, axis=1)  # [B, S]
+    start_mask = np.full((B, S), NEG_INF, np.float32)
+    start_mask[:, 0] = 0.0
+    for b in range(B):
+        if lab_lens[b] > 0:
+            start_mask[b, 1] = 0.0
+    alpha0 = lp0 + jnp.asarray(start_mask)
+
+    def step(alpha, xs):
+        logp_t, m_t = xs                             # [B, C], [B]
+        lp = jnp.take_along_axis(logp_t, ext_j, axis=1)   # [B, S]
+        # pad-then-slice keeps shapes right even when S < 2
+        a_shift1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                           constant_values=NEG_INF)[:, :S]
+        a_shift2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                           constant_values=NEG_INF)[:, :S]
+        a_skip = jnp.where(skip_j > 0, a_shift2, NEG_INF)
+        nxt = _logsumexp3(alpha, a_shift1, a_skip) + lp
+        return jnp.where(m_t[:, None] > 0, nxt, alpha), None
+
+    if Tmax > 1:
+        alpha_last, _ = jax.lax.scan(
+            step, alpha0,
+            (jnp.swapaxes(logp, 0, 1)[1:], tmask.T[1:]))
+    else:
+        alpha_last = alpha0
+    # p = alpha[ext_len-1] + alpha[ext_len-2]
+    last1 = jnp.take_along_axis(
+        alpha_last, jnp.asarray(ext_len - 1)[:, None], axis=1)[:, 0]
+    idx2 = np.maximum(ext_len - 2, 0)
+    last2_raw = jnp.take_along_axis(
+        alpha_last, jnp.asarray(idx2)[:, None], axis=1)[:, 0]
+    last2 = jnp.where(jnp.asarray(ext_len) >= 2, last2_raw, NEG_INF)
+    loss = -_logsumexp2(last1, last2)                 # [B]
+    if attrs.get("norm_by_times"):
+        lens = jnp.asarray(
+            [logits_lod[i + 1] - logits_lod[i] for i in range(B)],
+            loss.dtype)
+        loss = loss / lens
+    return {"Loss": loss[:, None],
+            "WarpCTCGrad": LoDTensor(jnp.zeros_like(data_of(lv)), lv.lod)}
+
+
+@register_op("ctc_align", inputs=("Input",), outputs=("Output",),
+             attrs={"blank": 0, "merge_repeated": True},
+             not_differentiable=True, host=True)
+def ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode: merge repeats then drop blanks (reference
+    ctc_align_op.h) — dynamic output size, so a host op."""
+    xv = one(ins, "Input")
+    x = np.asarray(data_of(xv)).reshape(-1)
+    lod = xv.lod[-1]
+    blank = int(attrs["blank"])
+    merge = bool(attrs.get("merge_repeated", True))
+    out_rows, out_lens = [], []
+    for i in range(len(lod) - 1):
+        seq = x[lod[i]:lod[i + 1]]
+        prev = None
+        kept = []
+        for t in seq:
+            t = int(t)
+            if merge and prev is not None and t == prev:
+                prev = t
+                continue
+            if t != blank:
+                kept.append(t)
+            prev = t
+        out_rows.extend(kept)
+        out_lens.append(len(kept))
+    data = np.asarray(out_rows, np.int64).reshape(-1, 1) if out_rows \
+        else np.zeros((0, 1), np.int64)
+    return {"Output": LoDTensor(data, [lod_from_seq_lens(out_lens)])}

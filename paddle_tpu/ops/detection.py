@@ -1,0 +1,520 @@
+"""Detection (CV) op family: prior_box, box_coder, iou_similarity,
+bipartite_match, target_assign, mine_hard_examples, multiclass_nms,
+roi_pool, detection_map.
+
+Reference: /root/reference/paddle/fluid/operators/{prior_box_op.h,
+box_coder_op.h, iou_similarity_op.h, bipartite_match_op.cc,
+target_assign_op.h, mine_hard_examples_op.cc, multiclass_nms_op.cc,
+roi_pool_op.h, detection_map_op.h}.
+
+TPU split: dense geometry (prior_box constants, box encode/decode, IoU
+matrices, target gathering, ROI pooling via masked reductions) lowers to
+jax and stays on device; the intrinsically sequential/dynamic-output
+algorithms (greedy bipartite matching, hard-example mining, NMS, mAP) are
+host ops — exactly the ops that are CPU-only kernels in the reference too.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.execution import data_of, one
+from ..core.lod import LoDTensor, lod_from_seq_lens
+from ..core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# prior_box
+# ---------------------------------------------------------------------------
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """prior_box_op.h ExpandAspectRatios: start from 1.0, dedupe, add 1/ar
+    when flip."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+@register_op("prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"),
+             attrs={"min_sizes": [], "max_sizes": [], "aspect_ratios": [],
+                    "variances": [0.1, 0.1, 0.2, 0.2], "flip": True,
+                    "clip": True, "step_w": 0.0, "step_h": 0.0,
+                    "offset": 0.5},
+             not_differentiable=True)
+def prior_box(ctx, ins, attrs):
+    """SSD prior boxes [H, W, num_priors, 4] (prior_box_op.h kernel).  Boxes
+    depend only on static shapes + attrs, so they are computed host-side and
+    enter the graph as constants."""
+    x = data_of(one(ins, "Input"))
+    img = data_of(one(ins, "Image"))
+    fh, fw = int(x.shape[2]), int(x.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs["max_sizes"]]
+    ars = _expand_aspect_ratios(attrs["aspect_ratios"], attrs["flip"])
+    variances = [float(v) for v in attrs["variances"]]
+    offset = float(attrs["offset"])
+    step_w = float(attrs["step_w"]) or iw / fw
+    step_h = float(attrs["step_h"]) or ih / fh
+
+    num_priors = len(ars) * len(min_sizes) + len(max_sizes)
+    boxes = np.zeros((fh, fw, num_priors, 4), np.float32)
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            k = 0
+
+            def put(bw, bh, k):
+                boxes[h, w, k] = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                                  (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+                return k + 1
+
+            for s, ms in enumerate(min_sizes):
+                k = put(ms, ms, k)
+                if max_sizes:
+                    sz = math.sqrt(ms * max_sizes[s])
+                    k = put(sz, sz, k)
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    k = put(ms * math.sqrt(ar), ms / math.sqrt(ar), k)
+    if attrs["clip"]:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32),
+                  (fh, fw, num_priors, 1))
+    return {"Boxes": jnp.asarray(boxes), "Variances": jnp.asarray(var)}
+
+
+# ---------------------------------------------------------------------------
+# box_coder / iou_similarity
+# ---------------------------------------------------------------------------
+
+
+def _center_size(box):
+    """[..., 4] xyxy -> (cx, cy, w, h)"""
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    cx = (box[..., 2] + box[..., 0]) / 2
+    cy = (box[..., 3] + box[..., 1]) / 2
+    return cx, cy, w, h
+
+
+@register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"),
+             outputs=("OutputBox",),
+             attrs={"code_type": "encode_center_size"},
+             diff_inputs=("TargetBox",))
+def box_coder(ctx, ins, attrs):
+    """Encode/decode boxes against priors (box_coder_op.h).  Output
+    [row, col, 4] where row indexes target boxes, col indexes priors."""
+    prior = data_of(one(ins, "PriorBox"))          # [col, 4]
+    pvar = data_of(one(ins, "PriorBoxVar"))        # [col, 4]
+    tb_v = one(ins, "TargetBox")
+    target = data_of(tb_v)                          # [row, 4] / [row, col, 4]
+    pcx, pcy, pw, ph = _center_size(prior)          # [col]
+    if attrs["code_type"] == "encode_center_size":
+        tcx, tcy, tw, th = _center_size(target)     # [row]
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / pvar[None, :, 2]
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :])) / pvar[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [row, col, 4]
+    else:  # decode_center_size: target [row, col, 4] deltas
+        dcx = pvar[None, :, 0] * target[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = pvar[None, :, 1] * target[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(pvar[None, :, 2] * target[..., 2]) * pw[None, :]
+        dh = jnp.exp(pvar[None, :, 3] * target[..., 3]) * ph[None, :]
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2, dcy + dh / 2], axis=-1)
+    return {"OutputBox": out}
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",),
+             diff_inputs=())
+def iou_similarity(ctx, ins, attrs):
+    """Pairwise IoU matrix [N, M] (iou_similarity_op.h)."""
+    xv = one(ins, "X")
+    x = data_of(xv)                                 # [N, 4]
+    y = data_of(one(ins, "Y"))                      # [M, 4]
+    ix1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    ax = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    ay = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    union = ax[:, None] + ay[None, :] - inter
+    out = jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+    if isinstance(xv, LoDTensor) and xv.lod:
+        return {"Out": LoDTensor(out, xv.lod)}
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match (host greedy, bipartite_match_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("bipartite_match", inputs=("DistMat",),
+             outputs=("ColToRowMatchIndices", "ColToRowMatchDist"),
+             not_differentiable=True, host=True)
+def bipartite_match(ctx, ins, attrs):
+    dv = one(ins, "DistMat")
+    dist_all = np.asarray(data_of(dv))
+    if isinstance(dv, LoDTensor) and dv.lod:
+        offs = dv.lod[-1]
+    else:
+        offs = (0, dist_all.shape[0])
+    n = len(offs) - 1
+    col = dist_all.shape[1]
+    match_idx = -np.ones((n, col), np.int32)
+    match_dist = np.zeros((n, col), np.float32)
+    eps = 1e-6
+    for b in range(n):
+        dist = dist_all[offs[b]:offs[b + 1]]
+        row_pool = list(range(dist.shape[0]))
+        while row_pool:
+            best = (-1, -1, -1.0)  # (col, row, dist)
+            for j in range(col):
+                if match_idx[b, j] != -1:
+                    continue
+                for m in row_pool:
+                    d = dist[m, j]
+                    if d < eps:
+                        continue
+                    if d > best[2]:
+                        best = (j, m, float(d))
+            if best[0] == -1:
+                break
+            match_idx[b, best[0]] = best[1]
+            match_dist[b, best[0]] = best[2]
+            row_pool.remove(best[1])
+    return {"ColToRowMatchIndices": match_idx,
+            "ColToRowMatchDist": match_dist}
+
+
+# ---------------------------------------------------------------------------
+# target_assign (device gather, target_assign_op.h)
+# ---------------------------------------------------------------------------
+
+
+@register_op("target_assign",
+             inputs=("X", "MatchIndices", "NegIndices"),
+             outputs=("Out", "OutWeight"),
+             attrs={"mismatch_value": 0}, not_differentiable=True)
+def target_assign(ctx, ins, attrs):
+    """out[n, m] = X[lod[n] + match[n, m], m % P] when matched, else
+    mismatch_value; weight 1/0; negative indices get weight 1."""
+    xv = one(ins, "X")
+    x = data_of(xv)
+    if x.ndim == 2:
+        x = x[:, None, :]
+    lod = xv.lod[-1] if isinstance(xv, LoDTensor) and xv.lod else None
+    match = data_of(one(ins, "MatchIndices")).astype(jnp.int32)  # [N, M]
+    N, M = match.shape
+    P, K = x.shape[1], x.shape[2]
+    if lod is None:
+        lod = tuple(range(N + 1))
+    off = jnp.asarray(np.asarray(lod[:-1], np.int32))[:, None]   # [N, 1]
+    rows = off + jnp.maximum(match, 0)                           # [N, M]
+    cols = jnp.asarray(np.arange(M, dtype=np.int32) % P)[None, :]
+    gathered = x[rows, jnp.broadcast_to(cols, rows.shape)]       # [N, M, K]
+    matched = (match > -1)
+    mismatch = jnp.asarray(float(attrs["mismatch_value"]), x.dtype)
+    out = jnp.where(matched[:, :, None], gathered, mismatch)
+    wt = matched.astype(jnp.float32)
+    neg = one(ins, "NegIndices")
+    if neg is not None:
+        neg_rows = data_of(neg).reshape(-1).astype(jnp.int32)
+        neg_lod = neg.lod[-1] if isinstance(neg, LoDTensor) and neg.lod \
+            else (0, neg_rows.shape[0])
+        img_of_row = np.zeros(neg_lod[-1], np.int32)
+        for i in range(len(neg_lod) - 1):
+            img_of_row[neg_lod[i]:neg_lod[i + 1]] = i
+        flat = jnp.asarray(img_of_row) * M + neg_rows
+        wt = wt.reshape(-1).at[flat].set(1.0).reshape(N, M)
+        out = out.reshape(N * M, K).at[flat].set(mismatch).reshape(N, M, K)
+    return {"Out": out, "OutWeight": wt[:, :, None]}
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples (host, mine_hard_examples_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("mine_hard_examples",
+             inputs=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"),
+             outputs=("NegIndices", "UpdatedMatchIndices"),
+             attrs={"neg_pos_ratio": 3.0, "neg_dist_threshold": 0.5,
+                    "mining_type": "max_negative", "sample_size": 0},
+             not_differentiable=True, host=True)
+def mine_hard_examples(ctx, ins, attrs):
+    cls_loss = np.asarray(data_of(one(ins, "ClsLoss")))
+    loc = one(ins, "LocLoss")
+    loc_loss = np.asarray(data_of(loc)) if loc is not None else None
+    match = np.asarray(data_of(one(ins, "MatchIndices"))).copy()
+    mdist = np.asarray(data_of(one(ins, "MatchDist")))
+    ratio = float(attrs["neg_pos_ratio"])
+    thresh = float(attrs["neg_dist_threshold"])
+    mtype = attrs["mining_type"]
+    sample_size = int(attrs.get("sample_size") or 0)
+    N, M = match.shape
+    neg_rows, neg_lens = [], []
+    for n in range(N):
+        cands = []
+        for m in range(M):
+            if mtype == "max_negative":
+                ok = match[n, m] == -1 and mdist[n, m] < thresh
+            else:
+                ok = True
+            if ok:
+                loss = cls_loss[n, m]
+                if mtype == "hard_example" and loc_loss is not None:
+                    loss = loss + loc_loss[n, m]
+                cands.append((float(loss), m))
+        if mtype == "max_negative":
+            num_pos = int((match[n] != -1).sum())
+            neg_sel = min(int(num_pos * ratio), len(cands))
+        else:
+            neg_sel = min(sample_size, len(cands))
+        cands.sort(key=lambda t: -t[0])
+        sel = sorted(m for _, m in cands[:neg_sel])
+        if mtype == "hard_example":
+            keep = {m for _, m in cands[:neg_sel]}
+            for m in range(M):
+                if match[n, m] > -1 and m not in keep:
+                    match[n, m] = -1
+        neg_rows.extend(sel)
+        neg_lens.append(len(sel))
+    neg = np.asarray(neg_rows, np.int32).reshape(-1, 1) if neg_rows \
+        else np.zeros((0, 1), np.int32)
+    return {"NegIndices": LoDTensor(neg, [lod_from_seq_lens(neg_lens)]),
+            "UpdatedMatchIndices": match}
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms (host, multiclass_nms_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _nms_single(boxes, scores, score_threshold, nms_threshold, eta, top_k):
+    """multiclass_nms_op.cc NMSFast: greedy IoU suppression."""
+    idx = [i for i in range(len(scores)) if scores[i] > score_threshold]
+    idx.sort(key=lambda i: -scores[i])
+    if top_k > -1:
+        idx = idx[:top_k]
+    kept = []
+    adaptive_threshold = nms_threshold
+    for i in idx:
+        keep = True
+        for k in kept:
+            iou = _iou_np(boxes[i], boxes[k])
+            if iou > adaptive_threshold:
+                keep = False
+                break
+        if keep:
+            kept.append(i)
+            if eta < 1 and adaptive_threshold > 0.5:
+                adaptive_threshold *= eta
+    return kept
+
+
+def _iou_np(a, b):
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+@register_op("multiclass_nms", inputs=("BBoxes", "Scores"),
+             outputs=("Out",),
+             attrs={"background_label": 0, "score_threshold": 0.01,
+                    "nms_top_k": 400, "nms_threshold": 0.3, "nms_eta": 1.0,
+                    "keep_top_k": 200},
+             not_differentiable=True, host=True)
+def multiclass_nms(ctx, ins, attrs):
+    """BBoxes [N, M, 4] (shared across classes), Scores [N, C, M] ->
+    LoD output [num_kept, 6]: label, score, xmin, ymin, xmax, ymax."""
+    bboxes = np.asarray(data_of(one(ins, "BBoxes")))
+    scores = np.asarray(data_of(one(ins, "Scores")))
+    if bboxes.ndim == 2:
+        bboxes = bboxes[None]
+        scores = scores[None]
+    N, C, M = scores.shape
+    bg = int(attrs["background_label"])
+    rows, lens = [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == bg:
+                continue
+            kept = _nms_single(bboxes[n], scores[n, c],
+                               attrs["score_threshold"],
+                               attrs["nms_threshold"], attrs["nms_eta"],
+                               attrs["nms_top_k"])
+            for i in kept:
+                dets.append([float(c), float(scores[n, c, i])] +
+                            [float(v) for v in bboxes[n, i]])
+        keep_top_k = int(attrs["keep_top_k"])
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            dets.sort(key=lambda d: -d[1])
+            dets = dets[:keep_top_k]
+        rows.extend(dets)
+        lens.append(len(dets))
+    data = np.asarray(rows, np.float32) if rows \
+        else np.zeros((0, 6), np.float32)
+    return {"Out": LoDTensor(data, [lod_from_seq_lens(lens)])}
+
+
+# ---------------------------------------------------------------------------
+# roi_pool (device: masked max over bins, roi_pool_op.h)
+# ---------------------------------------------------------------------------
+
+
+@register_op("roi_pool", inputs=("X", "ROIs"), outputs=("Out", "Argmax"),
+             attrs={"spatial_scale": 1.0, "pooled_height": 1,
+                    "pooled_width": 1},
+             diff_inputs=("X",), diff_outputs=("Out",))
+def roi_pool(ctx, ins, attrs):
+    """Max-pool each ROI into a pooled_h x pooled_w grid.  The reference
+    loops bins with dynamic extents; here each bin is a masked max over the
+    full feature map (bin membership computed from traced ROI coords), which
+    keeps shapes static for XLA."""
+    x = data_of(one(ins, "X"))                     # [N, C, H, W]
+    roi_v = one(ins, "ROIs")
+    rois = data_of(roi_v)                          # [R, 4]
+    scale = float(attrs["spatial_scale"])
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    if isinstance(roi_v, LoDTensor) and roi_v.lod:
+        lod = roi_v.lod[-1]
+        batch_of_roi = np.zeros(R, np.int32)
+        for i in range(len(lod) - 1):
+            batch_of_roi[lod[i]:lod[i + 1]] = i
+    else:
+        batch_of_roi = np.zeros(R, np.int32)
+    b_idx = jnp.asarray(batch_of_roi)
+
+    r = jnp.round(rois * scale)
+    x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)          # [R]
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    hs = jnp.arange(H, dtype=x.dtype)
+    ws = jnp.arange(W, dtype=x.dtype)
+    # bin start/end per (roi, bin_index): [R, ph]
+    i_idx = jnp.arange(ph, dtype=x.dtype)
+    j_idx = jnp.arange(pw, dtype=x.dtype)
+    hstart = jnp.floor(i_idx[None, :] * bin_h[:, None]) + y1[:, None]
+    hend = jnp.ceil((i_idx[None, :] + 1) * bin_h[:, None]) + y1[:, None]
+    wstart = jnp.floor(j_idx[None, :] * bin_w[:, None]) + x1[:, None]
+    wend = jnp.ceil((j_idx[None, :] + 1) * bin_w[:, None]) + x1[:, None]
+    mask_h = ((hs[None, None, :] >= hstart[:, :, None]) &
+              (hs[None, None, :] < hend[:, :, None]))   # [R, ph, H]
+    mask_w = ((ws[None, None, :] >= wstart[:, :, None]) &
+              (ws[None, None, :] < wend[:, :, None]))   # [R, pw, W]
+    feats = x[b_idx]                                    # [R, C, H, W]
+    masked = jnp.where(
+        mask_h[:, None, :, None, :, None] & mask_w[:, None, None, :, None, :],
+        feats[:, :, None, None, :, :], -jnp.inf)        # [R,C,ph,pw,H,W]
+    out = jnp.max(masked.reshape(R, C, ph, pw, H * W), axis=-1)
+    arg = jnp.argmax(masked.reshape(R, C, ph, pw, H * W), axis=-1)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return {"Out": out, "Argmax": arg.astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# detection_map (host metric, detection_map_op.h)
+# ---------------------------------------------------------------------------
+
+
+@register_op("detection_map", inputs=("DetectRes", "Label"),
+             outputs=("MAP",),
+             attrs={"overlap_threshold": 0.5, "evaluate_difficult": True,
+                    "ap_type": "integral"},
+             not_differentiable=True, host=True)
+def detection_map(ctx, ins, attrs):
+    """mean Average Precision over a batch.  DetectRes: LoD [Nd, 6]
+    (label, score, box); Label: LoD [Ng, 6] (label, xmin, ymin, xmax, ymax,
+    difficult) or [Ng, 5]."""
+    det_v = one(ins, "DetectRes")
+    gt_v = one(ins, "Label")
+    det = np.asarray(data_of(det_v))
+    gt = np.asarray(data_of(gt_v))
+    d_lod = det_v.lod[-1]
+    g_lod = gt_v.lod[-1]
+    thresh = float(attrs["overlap_threshold"])
+    ap_type = attrs["ap_type"]
+    n = len(d_lod) - 1
+
+    # gather per-class (score, tp) pairs and gt counts; matching is greedy
+    # per image in descending score order, but the PR curve must rank ALL
+    # detections of a class globally by score
+    cls_entries = {}  # class -> [(score, tp)]
+    gt_count = {}
+    for b in range(n):
+        dets = det[d_lod[b]:d_lod[b + 1]]
+        gts = gt[g_lod[b]:g_lod[b + 1]]
+        used = np.zeros(len(gts), bool)
+        for c in set(int(g[0]) for g in gts):
+            gt_count[c] = gt_count.get(c, 0) + sum(
+                1 for g in gts if int(g[0]) == c)
+        for d in sorted(dets, key=lambda d: -d[1]):
+            c = int(d[0])
+            best_iou, best_j = 0.0, -1
+            for j, g in enumerate(gts):
+                if int(g[0]) != c or used[j]:
+                    continue
+                iou = _iou_np(d[2:6], g[1:5])
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            tp = best_iou > thresh and best_j >= 0
+            if tp:
+                used[best_j] = True
+            cls_entries.setdefault(c, []).append((float(d[1]),
+                                                  1 if tp else 0))
+
+    aps = []
+    for c, count in gt_count.items():
+        if count == 0:
+            continue
+        entries = sorted(cls_entries.get(c, []), key=lambda e: -e[0])
+        if not entries:
+            aps.append(0.0)
+            continue
+        tps = np.asarray([tp for _, tp in entries], np.float64)
+        cum_tp = np.cumsum(tps)
+        cum_fp = np.cumsum(1 - tps)
+        recall = cum_tp / count
+        precision = cum_tp / np.maximum(cum_tp + cum_fp, 1e-10)
+        if ap_type == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if (recall >= t).any() \
+                    else 0.0
+                ap += p / 11
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for p, rc in zip(precision, recall):
+                ap += p * (rc - prev_r)
+                prev_r = rc
+        aps.append(float(ap))
+    mAP = float(np.mean(aps)) if aps else 0.0
+    return {"MAP": np.asarray([mAP], np.float32)}

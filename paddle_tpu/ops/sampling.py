@@ -1,0 +1,56 @@
+"""Sampled-softmax family: nce (noise-contrastive estimation).
+
+Reference: /root/reference/paddle/fluid/operators/nce_op.{cc,h} —
+SampleLabels = [true labels | uniform negative samples]; per sampled class
+o = sigmoid(x·w_label + b_label); with b = num_neg_samples/num_total_classes:
+cost = Σ_true -log(o/(o+b)) + Σ_neg -log(b/(o+b)).
+
+The VJP grad op re-traces this lowering with the SAME per-op PRNG key
+(core/execution._op_rng_tag), so forward and backward see identical negative
+samples — the reference instead re-reads its materialized SampleLabels
+output in a hand-written grad kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+
+
+@register_op("nce",
+             inputs=("Input", "Label", "Weight", "Bias", "SampleWeight"),
+             outputs=("Cost", "SampleLogits", "SampleLabels"),
+             attrs={"num_total_classes": 2, "num_neg_samples": 10},
+             diff_inputs=("Input", "Weight", "Bias"),
+             diff_outputs=("Cost",), random=True)
+def nce(ctx, ins, attrs):
+    x = data_of(one(ins, "Input"))              # [B, D]
+    label = data_of(one(ins, "Label"))          # [B, num_true] int
+    w = data_of(one(ins, "Weight"))             # [num_total, D]
+    bias = one(ins, "Bias")                     # [num_total] or None
+    sw = one(ins, "SampleWeight")
+    num_total = int(attrs["num_total_classes"])
+    num_neg = int(attrs["num_neg_samples"])
+    B = x.shape[0]
+    num_true = label.shape[1] if label.ndim == 2 else 1
+    label = label.reshape(B, num_true)
+
+    negs = jax.random.randint(ctx.rng(), (B, num_neg), 0, num_total)
+    sample_labels = jnp.concatenate([label.astype(jnp.int32),
+                                     negs.astype(jnp.int32)], axis=1)
+
+    w_s = w[sample_labels]                      # [B, T+N, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w_s)
+    if bias is not None:
+        logits = logits + data_of(bias).reshape(-1)[sample_labels]
+    o = jax.nn.sigmoid(logits)
+    b = float(num_neg) / float(num_total)
+    cost_true = -jnp.log(o[:, :num_true] / (o[:, :num_true] + b))
+    cost_neg = -jnp.log(b / (o[:, num_true:] + b))
+    cost = jnp.sum(cost_true, axis=1) + jnp.sum(cost_neg, axis=1)
+    if sw is not None:
+        cost = cost * data_of(sw).reshape(-1)
+    return {"Cost": cost[:, None], "SampleLogits": o,
+            "SampleLabels": sample_labels.astype(jnp.int64)}

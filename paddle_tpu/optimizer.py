@@ -125,6 +125,11 @@ class Optimizer:
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         params_grads = append_backward(loss, parameter_list, no_grad_set)
+        # gradient clipping between backward and regularization, matching
+        # reference optimizer.py minimize ordering (clip.py:236)
+        from .clip import append_gradient_clip_ops
+
+        params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         optimize_ops = self.create_optimization_pass(params_grads, loss,

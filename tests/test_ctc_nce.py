@@ -1,0 +1,177 @@
+"""CTC (warpctc / ctc_align) and NCE tests.
+
+Reference tests: test_warpctc_op.py (vs CTC forward), test_ctc_align_op.py,
+test_nce.py (numpy reference of the NCE cost).
+"""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def _brute_force_ctc(logits, labels, blank):
+    """-log p(labels) by enumerating ALL alignment paths (tiny T/C only)."""
+    T, C = logits.shape
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: merge repeats then remove blanks
+        collapsed, prev = [], None
+        for t in path:
+            if t != prev and t != blank:
+                collapsed.append(t)
+            prev = t
+        if collapsed == list(labels):
+            p = 1.0
+            for t, c in enumerate(path):
+                p *= probs[t, c]
+            total += p
+    return -np.log(total)
+
+
+def test_warpctc_matches_brute_force():
+    T1, T2, C = 4, 3, 3  # blank=0, labels from {1,2}
+    r = np.random.RandomState(0)
+    logits1 = r.randn(T1, C).astype(np.float32)
+    logits2 = r.randn(T2, C).astype(np.float32)
+    lab1, lab2 = [1, 2], [2]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        logits = fluid.layers.data(name="logits", shape=[C],
+                                   dtype="float32", lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64",
+                                  lod_level=1)
+        loss = fluid.layers.warpctc(input=logits, label=label, blank=0)
+    exe = _exe()
+    exe.run(startup)
+    feed = {
+        "logits": LoDTensor(np.concatenate([logits1, logits2]),
+                            [[0, T1, T1 + T2]]),
+        "label": LoDTensor(
+            np.asarray(lab1 + lab2, np.int64).reshape(-1, 1),
+            [[0, len(lab1), len(lab1) + len(lab2)]]),
+    }
+    out, = exe.run(main, feed=feed, fetch_list=[loss])
+    want = [_brute_force_ctc(logits1, lab1, 0),
+            _brute_force_ctc(logits2, lab2, 0)]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), want,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_trains():
+    """CTC loss decreases under SGD on a fixed tiny task."""
+    T, C = 6, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                              lod_level=1)
+        logits = fluid.layers.fc(input=x, size=C)
+        loss = fluid.layers.mean(
+            fluid.layers.warpctc(input=logits, label=fluid.layers.data(
+                name="label", shape=[1], dtype="int64", lod_level=1)))
+        fluid.Adam(learning_rate=0.05).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    r = np.random.RandomState(1)
+    feed = {
+        "x": LoDTensor(r.randn(2 * T, 8).astype(np.float32), [[0, T, 2 * T]]),
+        "label": LoDTensor(np.asarray([1, 2, 3, 2], np.int64).reshape(-1, 1),
+                           [[0, 2, 4]]),
+    }
+    losses = []
+    for _ in range(40):
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(l[0]))
+    assert losses[-1] < losses[0] * 0.5, losses[:1] + losses[-1:]
+
+
+def test_ctc_align():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="int64",
+                              lod_level=1)
+        out = fluid.layers.ctc_align(x, blank=0)
+    exe = _exe()
+    exe.run(startup)
+    # seq1: 0 1 1 0 2 -> 1 2 ; seq2: 2 2 0 3 3 -> 2 3
+    data = np.asarray([0, 1, 1, 0, 2, 2, 2, 0, 3, 3], np.int64).reshape(-1, 1)
+    o, = exe.run(main, feed={"x": LoDTensor(data, [[0, 5, 10]])},
+                 fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(o.data).reshape(-1),
+                                  [1, 2, 2, 3])
+    assert o.lod == ((0, 2, 4),)
+
+
+def test_nce_cost_formula_and_training():
+    B, D, V, NEG = 8, 6, 20, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(input=x, label=y, num_total_classes=V,
+                                num_neg_samples=NEG)
+        avg = fluid.layers.mean(cost)
+        fluid.SGD(learning_rate=0.1).minimize(avg)
+    exe = _exe()
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    xs = r.randn(B, D).astype(np.float32)
+    ys = r.randint(0, V, (B, 1)).astype(np.int64)
+    losses = []
+    for _ in range(30):
+        l, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[avg])
+        losses.append(float(l[0]))
+    assert losses[-1] < losses[0], "NCE loss did not decrease"
+
+    # cost formula check against fetched sample outputs
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y2 = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost2 = fluid.layers.nce(input=x2, label=y2, num_total_classes=V,
+                                 num_neg_samples=NEG)
+        block = main2.current_block
+        nce_op = next(op for op in block.ops if op.type == "nce")
+        logits_name = nce_op.output("SampleLogits")[0]
+    exe2 = _exe()
+    exe2.run(startup2)
+    c, sl = exe2.run(main2, feed={"x": xs, "y": ys},
+                     fetch_list=[cost2, logits_name])
+    b = NEG / V
+    o = np.asarray(sl)
+    want = (-np.log(o[:, :1] / (o[:, :1] + b)).sum(1)
+            - np.log(b / (o[:, 1:] + b)).sum(1))
+    np.testing.assert_allclose(np.asarray(c).reshape(-1), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_warpctc_all_empty_labels():
+    """Regression: empty label batch (S=1) must yield -sum log p(blank)."""
+    T, C = 3, 4
+    r = np.random.RandomState(5)
+    logits = r.randn(T, C).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = fluid.layers.data(name="lg", shape=[C], dtype="float32",
+                               lod_level=1)
+        lb = fluid.layers.data(name="lb", shape=[1], dtype="int64",
+                               lod_level=1)
+        loss = fluid.layers.warpctc(input=lg, label=lb, blank=0)
+    exe = _exe()
+    exe.run(startup)
+    out, = exe.run(main, feed={
+        "lg": LoDTensor(logits, [[0, T]]),
+        "lb": LoDTensor(np.zeros((0, 1), np.int64), [[0, 0]]),
+    }, fetch_list=[loss])
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    want = -logp[:, 0].sum()
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [want],
+                               rtol=1e-5)

@@ -1,0 +1,259 @@
+"""Detection op tests.
+
+Reference tests: test_prior_box_op.py, test_box_coder_op.py,
+test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_target_assign_op.py, test_mine_hard_examples_op.py,
+test_multiclass_nms_op.py, test_roi_pool_op.py, test_detection_map_op.py,
+test_detection_output.py-era layer tests.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def _run(build, feeds, _unused=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = _exe()
+    exe.run(startup)
+    return exe.run(main, feed=feeds, fetch_list=fetches)
+
+
+def test_iou_similarity():
+    x = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        return [fluid.layers.iou_similarity(xv, yv)]
+
+    out, = _run(build, {"x": x, "y": y})
+    iou = np.asarray(out)
+    assert abs(iou[0, 0] - 1.0) < 1e-6
+    assert abs(iou[0, 1] - 0.0) < 1e-6
+    # boxes [1,1,3,3] vs [2,2,4,4]: inter 1, union 7
+    assert abs(iou[1, 1] - 1 / 7) < 1e-6
+
+
+def test_box_coder_roundtrip():
+    prior = np.asarray([[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.8, 0.9]],
+                       np.float32)
+    pvar = np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], np.float32), (2, 1))
+    gt = np.asarray([[0.15, 0.2, 0.55, 0.7]], np.float32)
+
+    def build():
+        pb = fluid.layers.data(name="pb", shape=[4], dtype="float32")
+        pv = fluid.layers.data(name="pv", shape=[4], dtype="float32")
+        tb = fluid.layers.data(name="tb", shape=[4], dtype="float32")
+        enc = fluid.layers.box_coder(pb, pv, tb,
+                                     code_type="encode_center_size")
+        dec = fluid.layers.box_coder(pb, pv, enc,
+                                     code_type="decode_center_size")
+        return [enc, dec]
+
+    enc, dec = _run(build, {"pb": prior, "pv": pvar, "tb": gt}, None)
+    assert np.asarray(enc).shape == (1, 2, 4)
+    # decode(encode(gt)) == gt against each prior
+    np.testing.assert_allclose(np.asarray(dec)[0, 0], gt[0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dec)[0, 1], gt[0], atol=1e-5)
+
+
+def test_prior_box():
+    def build():
+        x = fluid.layers.data(name="x", shape=[8, 4, 4], dtype="float32")
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        b, v = fluid.layers.prior_box_single(
+            x, img, min_sizes=[4.0], max_sizes=[9.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        return [b, v]
+
+    b, v = _run(build, {"x": np.zeros((1, 8, 4, 4), np.float32),
+                        "img": np.zeros((1, 3, 32, 32), np.float32)}, None)
+    b, v = np.asarray(b), np.asarray(v)
+    # priors per position: 1 (min) + 1 (max) + 2 (ar 2 & 1/2) = 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    # first prior at (0,0): min_size 4 centered at (4, 4) of 32x32 image
+    np.testing.assert_allclose(
+        b[0, 0, 0], [2 / 32, 2 / 32, 6 / 32, 6 / 32], atol=1e-6)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+
+
+def test_bipartite_match():
+    # 2 images: first has 2 gt rows, second 1
+    dist = np.asarray([
+        [0.9, 0.2, 0.1],
+        [0.5, 0.8, 0.3],
+        [0.1, 0.9, 0.6],
+    ], np.float32)
+
+    def build():
+        d = fluid.layers.data(name="d", shape=[3], dtype="float32",
+                              lod_level=1)
+        idx, dval = fluid.layers.bipartite_match(d)
+        return [idx, dval]
+
+    idx, dval = _run(build, {"d": LoDTensor(dist, [[0, 2, 3]])}, None)
+    idx = np.asarray(idx)
+    # image 0: greedy: col1<-row1 (0.8)? max overall is 0.9 col0<-row0;
+    # then col1<-row1 0.8; col2 left unmatched (rows exhausted)
+    assert idx.shape == (2, 3)
+    assert idx[0, 0] == 0 and idx[0, 1] == 1 and idx[0, 2] == -1
+    # image 1: single row 0 -> best col 1 (0.9)
+    assert idx[1, 1] == 0 and idx[1, 0] == -1 and idx[1, 2] == -1
+
+
+def test_target_assign_with_negatives():
+    # 1 image, 2 gt rows with K=1 labels, 4 priors
+    x = np.asarray([[5.0], [7.0]], np.float32)
+    match = np.asarray([[0, -1, 1, -1]], np.int32)
+    neg = np.asarray([[1]], np.int32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                               lod_level=1)
+        mv = fluid.layers.data(name="m", shape=[4], dtype="int32")
+        nv = fluid.layers.data(name="n", shape=[1], dtype="int32",
+                               lod_level=1)
+        out, wt = fluid.layers.target_assign(
+            xv, mv, negative_indices=nv, mismatch_value=0)
+        return [out, wt]
+
+    out, wt = _run(build, {"x": LoDTensor(x, [[0, 2]]),
+                           "m": match,
+                           "n": LoDTensor(neg, [[0, 1]])}, None)
+    out, wt = np.asarray(out), np.asarray(wt)
+    np.testing.assert_allclose(out.reshape(-1), [5.0, 0.0, 7.0, 0.0])
+    np.testing.assert_allclose(wt.reshape(-1), [1.0, 1.0, 1.0, 0.0])
+
+
+def test_multiclass_nms():
+    boxes = np.asarray([[
+        [0.0, 0.0, 1.0, 1.0],
+        [0.01, 0.01, 1.01, 1.01],   # near-duplicate of box 0
+        [0.5, 0.5, 0.9, 0.9],
+    ]], np.float32)
+    scores = np.asarray([[
+        [0.1, 0.2, 0.3],            # class 0 (background)
+        [0.9, 0.85, 0.2],           # class 1
+    ]], np.float32)
+
+    def build():
+        b = fluid.layers.data(name="b", shape=[3, 4], dtype="float32")
+        s = fluid.layers.data(name="s", shape=[2, 3], dtype="float32")
+        return [fluid.layers.multiclass_nms(b, s, background_label=0,
+                                            score_threshold=0.15,
+                                            nms_threshold=0.5)]
+
+    out, = _run(build, {"b": boxes, "s": scores}, None)
+    dets = np.asarray(out.data)
+    # duplicate suppressed; kept: box0 (0.9) and box2 (0.2)
+    assert dets.shape == (2, 6)
+    assert dets[0][0] == 1.0 and abs(dets[0][1] - 0.9) < 1e-6
+    assert abs(dets[1][1] - 0.2) < 1e-6
+    assert out.lod == ((0, 2),)
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.asarray([[0, 0, 3, 3]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[1, 4, 4], dtype="float32")
+        rv = fluid.layers.data(name="r", shape=[4], dtype="float32",
+                               lod_level=1)
+        return [fluid.layers.roi_pool(xv, rv, pooled_height=2,
+                                      pooled_width=2, spatial_scale=1.0)]
+
+    out, = _run(build, {"x": x, "r": LoDTensor(rois, [[0, 1]])}, None)
+    out = np.asarray(out)
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_detection_map_perfect_and_miss():
+    # one image; det matches gt exactly -> mAP 1
+    det = np.asarray([[1, 0.9, 0.1, 0.1, 0.5, 0.5]], np.float32)
+    gt = np.asarray([[1, 0.1, 0.1, 0.5, 0.5]], np.float32)
+
+    def build():
+        d = fluid.layers.data(name="d", shape=[6], dtype="float32",
+                              lod_level=1)
+        g = fluid.layers.data(name="g", shape=[5], dtype="float32",
+                              lod_level=1)
+        return [fluid.layers.detection_map(d, g)]
+
+    m, = _run(build, {"d": LoDTensor(det, [[0, 1]]),
+                      "g": LoDTensor(gt, [[0, 1]])}, None)
+    assert abs(float(np.asarray(m)[0]) - 1.0) < 1e-6
+
+
+def test_ssd_loss_runs_and_trains():
+    N, NP, C = 2, 8, 3
+    r = np.random.RandomState(0)
+    prior = np.sort(r.rand(NP, 4).astype(np.float32), axis=1)
+    pvar = np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], np.float32), (NP, 1))
+    gt_boxes = np.sort(r.rand(3, 4).astype(np.float32), axis=1)
+    gt_labels = r.randint(1, C, (3, 1)).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="feat", shape=[16], dtype="float32")
+        loc_flat = fluid.layers.fc(input=feat, size=NP * 4)
+        conf_flat = fluid.layers.fc(input=feat, size=NP * C)
+        loc = fluid.layers.reshape(loc_flat, shape=(-1, NP, 4))
+        conf = fluid.layers.reshape(conf_flat, shape=(-1, NP, C))
+        pb = fluid.layers.data(name="pb", shape=[NP, 4], dtype="float32",
+                               append_batch_size=False)
+        pbv = fluid.layers.data(name="pbv", shape=[NP, 4], dtype="float32",
+                                append_batch_size=False)
+        gtb = fluid.layers.data(name="gtb", shape=[4], dtype="float32",
+                                lod_level=1)
+        gtl = fluid.layers.data(name="gtl", shape=[1], dtype="int64",
+                                lod_level=1)
+        loss = fluid.layers.ssd_loss(loc, conf, gtb, gtl, pb, pbv)
+        avg = fluid.layers.mean(loss)
+        fluid.SGD(learning_rate=0.01).minimize(avg)
+    exe = _exe()
+    exe.run(startup)
+    feed = {
+        "feat": r.randn(N, 16).astype(np.float32),
+        "pb": prior, "pbv": pvar,
+        "gtb": LoDTensor(gt_boxes, [[0, 2, 3]]),
+        "gtl": LoDTensor(gt_labels, [[0, 2, 3]]),
+    }
+    losses = []
+    for _ in range(15):
+        l, = exe.run(main, feed=feed, fetch_list=[avg])
+        losses.append(float(l[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], "ssd_loss did not decrease"
+
+
+def test_detection_map_global_score_ranking():
+    """Regression: PR curve must rank detections globally by score across
+    images, not in image order (FP@0.2 in image 0, TP@0.9 in image 1)."""
+    det = np.asarray([[1, 0.2, 0.6, 0.6, 0.9, 0.9],
+                      [1, 0.9, 0.1, 0.1, 0.5, 0.5]], np.float32)
+    gt = np.asarray([[1, 0.1, 0.1, 0.5, 0.5],
+                     [1, 0.1, 0.1, 0.5, 0.5]], np.float32)
+
+    def build():
+        d = fluid.layers.data(name="d", shape=[6], dtype="float32",
+                              lod_level=1)
+        g = fluid.layers.data(name="g", shape=[5], dtype="float32",
+                              lod_level=1)
+        return [fluid.layers.detection_map(d, g)]
+
+    m, = _run(build, {"d": LoDTensor(det, [[0, 1, 2]]),
+                      "g": LoDTensor(gt, [[0, 1, 2]])})
+    # TP first (score .9): precision 1 at recall .5; then FP. AP = 0.5
+    assert abs(float(np.asarray(m)[0]) - 0.5) < 1e-6

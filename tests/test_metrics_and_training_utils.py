@@ -1,0 +1,274 @@
+"""Tests: evaluators, learning-rate decay schedules, gradient clipping,
+auc / edit_distance layers.
+
+Modeled on reference tests: test_evaluator-ish usage in book tests,
+test_learning_rate_decay.py, test_clip*.py (gradient clip),
+test_edit_distance_op.py, test_auc_op.py.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_accuracy_evaluator_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        pred = fluid.layers.softmax(x)
+        acc_ev = fluid.evaluator.Accuracy(input=pred, label=label)
+    exe = _exe()
+    exe.run(startup)
+    acc_ev.reset(exe)
+    # batch 1: 2/2 correct; batch 2: 0/2 correct -> accumulated 0.5
+    logits1 = np.eye(4, dtype=np.float32)[[1, 3]] * 5
+    logits2 = np.eye(4, dtype=np.float32)[[0, 0]] * 5
+    exe.run(main, feed={"x": logits1,
+                        "y": np.asarray([[1], [3]], np.int64)})
+    exe.run(main, feed={"x": logits2,
+                        "y": np.asarray([[1], [3]], np.int64)})
+    acc = acc_ev.eval(exe)
+    assert abs(float(acc[0]) - 0.5) < 1e-6
+    # reset clears the accumulators
+    acc_ev.reset(exe)
+    exe.run(main, feed={"x": logits1,
+                        "y": np.asarray([[1], [3]], np.int64)})
+    acc = acc_ev.eval(exe)
+    assert abs(float(acc[0]) - 1.0) < 1e-6
+
+
+def test_chunk_evaluator_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.layers.data(name="inf", shape=[1], dtype="int64",
+                                lod_level=1)
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                lod_level=1)
+        ev = fluid.evaluator.ChunkEvaluator(input=inf, label=lab,
+                                            chunk_scheme="IOB",
+                                            num_chunk_types=1)
+    exe = _exe()
+    exe.run(startup)
+    ev.reset(exe)
+    # IOB 1 type: B=0 I=1 O=2. label has 2 chunks, infer hits 1 of them.
+    lab_np = np.asarray([[0], [1], [2], [0]], np.int64)
+    inf_np = np.asarray([[0], [1], [2], [2]], np.int64)
+    feed = {"inf": LoDTensor(inf_np, [[0, 4]]),
+            "lab": LoDTensor(lab_np, [[0, 4]])}
+    exe.run(main, feed=feed)
+    p, r, f1 = ev.eval(exe)
+    assert abs(p - 1.0) < 1e-5      # 1 inferred, 1 correct
+    assert abs(r - 0.5) < 1e-5      # 2 labeled, 1 correct
+    assert abs(f1 - 2 / 3) < 1e-4
+
+
+def test_learning_rate_decay_schedules():
+    cases = {
+        "exponential": (fluid.learning_rate_decay.exponential_decay,
+                        lambda s: 0.1 * 0.5 ** (s / 10)),
+        "natural_exp": (fluid.learning_rate_decay.natural_exp_decay,
+                        lambda s: 0.1 * np.exp(-0.5 * s / 10)),
+        "inverse_time": (fluid.learning_rate_decay.inverse_time_decay,
+                         lambda s: 0.1 / (1 + 0.5 * s / 10)),
+    }
+    for name, (fn, want_fn) in cases.items():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            step = fluid.layers.data(name="step", shape=[1], dtype="int64")
+            lr = fn(learning_rate=0.1, global_step=step, decay_steps=10,
+                    decay_rate=0.5)
+        exe = _exe()
+        exe.run(startup)
+        for s in (0, 5, 10, 25):
+            out, = exe.run(main, feed={"step": np.asarray([s], np.int64)},
+                           fetch_list=[lr])
+            assert abs(float(out[0]) - want_fn(s)) < 1e-6, (name, s)
+
+
+def test_polynomial_and_piecewise_decay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = fluid.layers.data(name="step", shape=[1], dtype="int64")
+        poly = fluid.learning_rate_decay.polynomial_decay(
+            learning_rate=0.1, global_step=step, decay_steps=10,
+            end_learning_rate=0.01, power=2.0)
+        pw = fluid.learning_rate_decay.piecewise_decay(
+            global_step=step, boundaries=[5, 10], values=[0.1, 0.05, 0.01])
+    exe = _exe()
+    exe.run(startup)
+    for s, want_poly, want_pw in [(0, 0.1, 0.1), (5, 0.0325, 0.05),
+                                  (10, 0.01, 0.01), (20, 0.01, 0.01)]:
+        o1, o2 = exe.run(main, feed={"step": np.asarray([s], np.int64)},
+                         fetch_list=[poly, pw])
+        assert abs(float(o1[0]) - want_poly) < 1e-6, s
+        assert abs(float(o2[0]) - want_pw) < 1e-6, s
+
+
+def test_lr_decay_drives_optimizer():
+    """An optimizer fed a decayed-LR variable trains with shrinking steps."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        gstep = fluid.layers.autoincreased_step_counter()
+        lr = fluid.learning_rate_decay.exponential_decay(
+            learning_rate=0.1, global_step=gstep, decay_steps=5,
+            decay_rate=0.5)
+        fluid.SGD(learning_rate=lr).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    xs = r.randn(16, 2).astype(np.float32)
+    ys = (xs @ np.array([[1.0], [-2.0]], np.float32) + 0.5).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        l, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(l[0]))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_gradient_clip_by_global_norm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.clip.set_gradient_clip(
+            fluid.GradientClipByGlobalNorm(clip_norm=0.01))
+        opt = fluid.SGD(learning_rate=1.0)
+        _, params_grads = opt.minimize(loss)
+        grad_var = params_grads[0][1]
+    exe = _exe()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(8, 4).astype(np.float32) * 10
+    ys = np.full((8, 1), 100.0, np.float32)  # huge error -> huge raw grads
+    g, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[grad_var])
+    assert np.linalg.norm(np.asarray(g)) <= 0.0101, \
+        "global-norm clip not applied"
+
+
+def test_gradient_clip_by_value():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.clip.set_gradient_clip(fluid.GradientClipByValue(max=0.001))
+        _, params_grads = fluid.SGD(learning_rate=1.0).minimize(loss)
+        grad_var = params_grads[0][1]
+    exe = _exe()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(8, 4).astype(np.float32) * 10
+    ys = np.full((8, 1), 100.0, np.float32)
+    g, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[grad_var])
+    assert np.abs(np.asarray(g)).max() <= 0.001 + 1e-8
+
+
+def test_auc_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        score = fluid.layers.data(name="s", shape=[2], dtype="float32")
+        label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        auc_out = fluid.layers.auc(input=score, label=label)
+    exe = _exe()
+    exe.run(startup)
+    # perfectly separable scores -> AUC == 1
+    s = np.asarray([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]],
+                   np.float32)
+    y = np.asarray([[0], [0], [1], [1]], np.int64)
+    a, = exe.run(main, feed={"s": s, "y": y}, fetch_list=[auc_out])
+    assert float(a[0]) > 0.99
+
+
+def test_edit_distance_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data(name="h", shape=[1], dtype="int64",
+                                lod_level=1)
+        ref = fluid.layers.data(name="r", shape=[1], dtype="int64",
+                                lod_level=1)
+        dist, seq_num = fluid.layers.edit_distance(hyp, ref)
+    exe = _exe()
+    exe.run(startup)
+    h = LoDTensor(np.asarray([[1], [2], [3], [5], [6]], np.int64),
+                  [[0, 3, 5]])
+    r = LoDTensor(np.asarray([[1], [2], [4], [5], [6], [7]], np.int64),
+                  [[0, 3, 6]])
+    d, n = exe.run(main, feed={"h": h, "r": r}, fetch_list=[dist, seq_num])
+    np.testing.assert_allclose(np.asarray(d).reshape(-1), [1.0, 1.0])
+    assert int(n[0]) == 2
+
+
+def test_global_norm_clip_distinct_instances_share_group():
+    """Regression: distinct GradientClipByGlobalNorm instances with the
+    same group_name must share one scale var, not crash."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=3, bias_attr=False)
+        pred = fluid.layers.fc(input=h, size=1, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        for p in main.global_block().all_parameters():
+            p.gradient_clip_attr = fluid.GradientClipByGlobalNorm(0.01)
+        _, pgs = fluid.SGD(learning_rate=1.0).minimize(loss)
+        grads = [g for _, g in pgs]
+    exe = _exe()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(8, 4).astype(np.float32) * 10
+    ys = np.full((8, 1), 50.0, np.float32)
+    gs = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=grads)
+    total = np.sqrt(sum(float((np.asarray(g) ** 2).sum()) for g in gs))
+    assert total <= 0.0101
+
+
+def test_error_clip_by_value_applied_in_backward():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=2, bias_attr=False)
+        h.error_clip = fluid.ErrorClipByValue(max=1e-4)
+        pred = fluid.layers.fc(input=h, size=1, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.SGD(learning_rate=0.0).minimize(loss)
+        hgrad = main.global_block().var(h.name + "@GRAD")
+    exe = _exe()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(4, 2).astype(np.float32) * 100
+    ys = np.full((4, 1), 1000.0, np.float32)
+    g, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[hgrad])
+    assert np.abs(np.asarray(g)).max() <= 1e-4 + 1e-10
+
+
+def test_nce_bias_attr_false():
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        cost = pt.layers.nce(input=x, label=y, num_total_classes=6,
+                             num_neg_samples=3, bias_attr=False)
+    nce_op = next(op for op in main.global_block().ops if op.type == "nce")
+    assert "Bias" not in nce_op.inputs
+    exe = _exe()
+    exe.run(startup)
+    c, = exe.run(main, feed={"x": np.zeros((2, 4), np.float32),
+                             "y": np.zeros((2, 1), np.int64)},
+                 fetch_list=[cost])
+    assert np.isfinite(np.asarray(c)).all()
