@@ -30,9 +30,40 @@ import numpy as np
 
 from . import registry
 from .execution import DictEnv, ExecContext, ScopeEnv, run_op
+from .flags import get_flag
 from .framework import Program, Variable, default_main_program
 from .lod import LoDTensor
 from .scope import Scope
+
+
+def _run_op_instrumented(ctx, op, env):
+    """run_op + optional profiling (reference executor.cc:124 RecordEvent)
+    and nan/inf scanning (executor.cc:132-140 FLAGS_check_nan_inf)."""
+    from ... import profiler as _noprofiler  # pragma: no cover
+    raise RuntimeError  # replaced below
+
+
+def _op_sync(env, op):
+    for n in op.output_names():
+        v = env.get(n)
+        if v is not None:
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready()
+                if hasattr(x, "block_until_ready") else x, v)
+
+
+def _check_nan_inf(env, op):
+    for n in op.output_names():
+        v = env.get(n)
+        if v is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(v):
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.floating) and \
+                    not np.isfinite(arr).all():
+                raise RuntimeError(
+                    f"Operator {op.type!r} output {n!r} contains "
+                    "NaN/Inf (check_nan_inf)")
 
 __all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "Executor", "global_scope"]
 

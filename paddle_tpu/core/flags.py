@@ -1,0 +1,55 @@
+"""Runtime flag registry — the gflags analogue.
+
+Reference: the reference scatters `DEFINE_bool/int32/double` through the
+C++ (utils/Flags.cpp:18-85 legacy; executor.cc:29-32 FLAGS_benchmark /
+FLAGS_check_nan_inf) and plumbs python argv via `core.init_gflags`
+(framework/init.cc).  Here flags are a simple process-global registry,
+settable from code (`set_flags`) or `PADDLE_TPU_<NAME>` environment
+variables at import.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFS: Dict[str, Any] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    _DEFS[name] = (default, help_str)
+    env = os.environ.get("PADDLE_TPU_" + name.upper())
+    if env is not None:
+        if isinstance(default, bool):
+            _VALUES[name] = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            _VALUES[name] = int(env)
+        elif isinstance(default, float):
+            _VALUES[name] = float(env)
+        else:
+            _VALUES[name] = env
+    else:
+        _VALUES[name] = default
+
+
+def get_flag(name: str):
+    return _VALUES[name]
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _DEFS:
+            raise KeyError(f"unknown flag {k!r}; defined: {sorted(_DEFS)}")
+        _VALUES[k] = v
+
+
+def flag_defaults():
+    return {k: d for k, (d, _) in _DEFS.items()}
+
+
+# -- the reference's executor/debug flags -----------------------------------
+define_flag("check_nan_inf", False,
+            "scan every op output for nan/inf in interpreter mode "
+            "(executor.cc FLAGS_check_nan_inf)")
+define_flag("benchmark", False,
+            "per-op sync + timing logs (executor.cc FLAGS_benchmark)")

@@ -1,0 +1,33 @@
+"""Dataset package with the reference's `paddle.v2.dataset` surface.
+
+Reference: /root/reference/python/paddle/v2/dataset/ (uci_housing, mnist,
+cifar, imdb, imikolov, movielens, conll05, wmt14, sentiment, ...).
+
+This environment has no network egress, so each module serves DETERMINISTIC
+SYNTHETIC data with the same schema (shapes/dtypes/vocab accessors) as the
+reference downloads — models and book tests exercise identical code paths;
+swap in real data by pointing the loaders at files with the same layout.
+"""
+from . import (  # noqa: F401
+    cifar,
+    conll05,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    sentiment,
+    uci_housing,
+    wmt14,
+)
+
+__all__ = [
+    "uci_housing",
+    "mnist",
+    "cifar",
+    "imdb",
+    "imikolov",
+    "movielens",
+    "conll05",
+    "wmt14",
+    "sentiment",
+]

@@ -1,0 +1,47 @@
+"""cifar: 3072 floats (3x32x32) -> int label; cifar10 + cifar100 surfaces.
+
+Reference: /root/reference/python/paddle/v2/dataset/cifar.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached, fixed_rng
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+_N_TRAIN, _N_TEST = 1024, 256
+
+
+@cached
+def _templates():
+    r = fixed_rng("cifar")
+    return r.randn(100, 3072).astype(np.float32)
+
+
+def _reader(tag, n, num_classes):
+    def reader():
+        t = _templates()
+        r = fixed_rng(f"cifar/{tag}/{num_classes}")
+        for _ in range(n):
+            label = int(r.randint(0, num_classes))
+            img = t[label] + 0.5 * r.randn(3072).astype(np.float32)
+            yield np.clip(img, -1.0, 1.0).astype(np.float32), label
+
+    return reader
+
+
+def train10():
+    return _reader("train", _N_TRAIN, 10)
+
+
+def test10():
+    return _reader("test", _N_TEST, 10)
+
+
+def train100():
+    return _reader("train", _N_TRAIN, 100)
+
+
+def test100():
+    return _reader("test", _N_TEST, 100)

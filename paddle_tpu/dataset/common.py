@@ -1,0 +1,25 @@
+"""Shared synthetic-data helpers for the dataset package."""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+__all__ = ["fixed_rng", "cached"]
+
+
+def fixed_rng(tag: str) -> np.random.RandomState:
+    """Deterministic per-dataset RNG (stable across processes/runs)."""
+    return np.random.RandomState(zlib.crc32(tag.encode()) & 0x7FFFFFFF)
+
+
+def cached(fn):
+    """Memoize a zero-arg dataset builder."""
+    store = {}
+
+    def wrapper():
+        if "v" not in store:
+            store["v"] = fn()
+        return store["v"]
+
+    return wrapper

@@ -1,0 +1,48 @@
+"""conll05: semantic-role-labeling tuples (word, predicate contexts, mark,
+IOB label sequence).
+
+Reference: /root/reference/python/paddle/v2/dataset/conll05.py
+(get_dict -> word/verb/label dicts, test reader yielding 9 slots:
+word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark, labels).
+"""
+from __future__ import annotations
+
+from .common import cached, fixed_rng
+
+__all__ = ["get_dict", "test", "train"]
+
+_WORDS, _VERBS, _LABELS = 4000, 300, 59  # label dict ~ 2*roles+1 IOB tags
+
+
+@cached
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(_WORDS)}
+    verb_dict = {f"v{i}": i for i in range(_VERBS)}
+    label_dict = {f"l{i}": i for i in range(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def _reader(tag, n):
+    def reader():
+        r = fixed_rng("conll05/" + tag)
+        for _ in range(n):
+            ln = int(r.randint(4, 12))
+            words = [int(w) for w in r.randint(0, _WORDS, ln)]
+            verb_pos = int(r.randint(0, ln))
+            verb = int(r.randint(0, _VERBS))
+            ctx = [words[max(0, min(ln - 1, verb_pos + d))]
+                   for d in (-2, -1, 0, 1, 2)]
+            mark = [1 if i == verb_pos else 0 for i in range(ln)]
+            labels = [int(l) for l in r.randint(0, _LABELS, ln)]
+            yield (words, [ctx[0]] * ln, [ctx[1]] * ln, [ctx[2]] * ln,
+                   [ctx[3]] * ln, [ctx[4]] * ln, [verb] * ln, mark, labels)
+
+    return reader
+
+
+def test():
+    return _reader("test", 256)
+
+
+def train():
+    return _reader("train", 1024)

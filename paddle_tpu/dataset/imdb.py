@@ -1,0 +1,41 @@
+"""imdb: variable-length word-id sequence -> 0/1 sentiment.
+
+Reference: /root/reference/python/paddle/v2/dataset/imdb.py (word_dict,
+train/test readers).  Synthetic: class decided by which vocabulary half
+dominates the sequence.
+"""
+from __future__ import annotations
+
+from .common import cached, fixed_rng
+
+__all__ = ["word_dict", "train", "test"]
+
+_VOCAB = 5148  # reference word_dict size ballpark; any fixed value works
+
+
+@cached
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(tag, n, vocab_size):
+    def reader():
+        r = fixed_rng("imdb/" + tag)
+        v = vocab_size or _VOCAB
+        half = v // 2
+        for _ in range(n):
+            label = int(r.randint(0, 2))
+            ln = int(r.randint(8, 64))
+            lo, hi = (0, half) if label == 0 else (half, v)
+            seq = [int(t) for t in r.randint(lo, hi, ln)]
+            yield seq, label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train", 1024, len(word_idx) if word_idx else None)
+
+
+def test(word_idx=None):
+    return _reader("test", 256, len(word_idx) if word_idx else None)

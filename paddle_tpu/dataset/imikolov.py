@@ -1,0 +1,43 @@
+"""imikolov: n-gram language-model tuples of word ids.
+
+Reference: /root/reference/python/paddle/v2/dataset/imikolov.py
+(build_dict, train/test readers yielding N-gram tuples).  Synthetic: word
+sequences from a sticky markov chain so n-gram models learn structure.
+"""
+from __future__ import annotations
+
+from .common import cached, fixed_rng
+
+__all__ = ["build_dict", "train", "test"]
+
+_VOCAB = 2073  # reference dict ~2073 for min_word_freq=50
+
+
+@cached
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(tag, n_samples, word_idx, n):
+    v = len(word_idx)
+
+    def reader():
+        r = fixed_rng("imikolov/" + tag)
+        for _ in range(n_samples):
+            # sticky chain: next word near the previous one
+            w = int(r.randint(0, v))
+            gram = [w]
+            for _ in range(n - 1):
+                w = (w + int(r.randint(0, 5))) % v
+                gram.append(w)
+            yield tuple(gram)
+
+    return reader
+
+
+def train(word_idx, n):
+    return _reader("train", 2048, word_idx, n)
+
+
+def test(word_idx, n):
+    return _reader("test", 512, word_idx, n)

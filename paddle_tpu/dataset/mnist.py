@@ -1,0 +1,42 @@
+"""mnist: 784 floats in [-1, 1] -> int label 0..9.
+
+Reference: /root/reference/python/paddle/v2/dataset/mnist.py.  Synthetic:
+each class is a gaussian blob around a class-specific template so simple
+models reach high accuracy.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached, fixed_rng
+
+__all__ = ["train", "test"]
+
+_N_TRAIN, _N_TEST = 2048, 512
+
+
+@cached
+def _templates():
+    r = fixed_rng("mnist")
+    return r.randn(10, 784).astype(np.float32)
+
+
+def _reader(tag, n):
+    def reader():
+        t = _templates()
+        r = fixed_rng("mnist/" + tag)
+        for _ in range(n):
+            label = int(r.randint(0, 10))
+            img = t[label] + 0.5 * r.randn(784).astype(np.float32)
+            img = np.clip(img, -1.0, 1.0).astype(np.float32)
+            yield img, label
+
+    return reader
+
+
+def train():
+    return _reader("train", _N_TRAIN)
+
+
+def test():
+    return _reader("test", _N_TEST)

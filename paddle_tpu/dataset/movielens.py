@@ -1,0 +1,97 @@
+"""movielens: (user_id, gender, age, job, movie_id, categories, title) ->
+rating.
+
+Reference: /root/reference/python/paddle/v2/dataset/movielens.py
+(MovieInfo/UserInfo metadata + train/test readers).
+"""
+from __future__ import annotations
+
+from .common import cached, fixed_rng
+
+__all__ = [
+    "train", "test", "max_user_id", "max_movie_id", "max_job_id",
+    "age_table", "movie_categories", "user_info", "movie_info",
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_USERS, _N_MOVIES, _N_CATS, _N_JOBS = 943, 1682, 18, 20
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {f"cat{i}": i for i in range(_N_CATS)}
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = index
+        self.categories = categories
+        self.title = title
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = index
+        self.is_male = gender == "M"
+        self.age = age
+        self.job_id = job_id
+
+
+@cached
+def movie_info():
+    r = fixed_rng("movielens/movies")
+    out = {}
+    for i in range(1, _N_MOVIES + 1):
+        cats = [f"cat{c}" for c in r.choice(_N_CATS, size=2, replace=False)]
+        out[i] = MovieInfo(i, cats, [f"t{int(w)}" for w in
+                                     r.randint(0, 100, 3)])
+    return out
+
+
+@cached
+def user_info():
+    r = fixed_rng("movielens/users")
+    out = {}
+    for i in range(1, _N_USERS + 1):
+        out[i] = UserInfo(i, "M" if r.rand() < 0.5 else "F",
+                          int(age_table[r.randint(0, len(age_table))]),
+                          int(r.randint(0, _N_JOBS)))
+    return out
+
+
+def _reader(tag, n):
+    def reader():
+        r = fixed_rng("movielens/" + tag)
+        for _ in range(n):
+            uid = int(r.randint(1, _N_USERS + 1))
+            mid = int(r.randint(1, _N_MOVIES + 1))
+            gender = int(r.randint(0, 2))
+            age_idx = int(r.randint(0, len(age_table)))
+            job = int(r.randint(0, _N_JOBS))
+            cat = int(r.randint(0, _N_CATS))
+            title = [int(t) for t in r.randint(0, 100, 3)]
+            # rating correlates with (uid + mid) parity-ish signal
+            rating = float((uid * 7 + mid * 13) % 5 + 1)
+            yield [uid, gender, age_idx, job, mid, [cat], title, [rating]]
+
+    return reader
+
+
+def train():
+    return _reader("train", 2048)
+
+
+def test():
+    return _reader("test", 512)

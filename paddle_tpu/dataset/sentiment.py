@@ -1,0 +1,38 @@
+"""sentiment (movie reviews): word-id sequence -> 0/1 polarity.
+
+Reference: /root/reference/python/paddle/v2/dataset/sentiment.py
+(NLTK movie_reviews based).
+"""
+from __future__ import annotations
+
+from .common import cached, fixed_rng
+
+__all__ = ["get_word_dict", "train", "test"]
+
+_VOCAB = 3000
+
+
+@cached
+def get_word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(tag, n):
+    def reader():
+        r = fixed_rng("sentiment/" + tag)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(r.randint(0, 2))
+            ln = int(r.randint(10, 50))
+            lo, hi = (0, half) if label == 0 else (half, _VOCAB)
+            yield [int(t) for t in r.randint(lo, hi, ln)], label
+
+    return reader
+
+
+def train():
+    return _reader("train", 1024)
+
+
+def test():
+    return _reader("test", 256)

@@ -1,0 +1,44 @@
+"""uci_housing: 13 normalized float features -> 1 float target.
+
+Reference: /root/reference/python/paddle/v2/dataset/uci_housing.py
+(506 rows, feature-normalized).  Synthetic: linear ground truth + noise.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached, fixed_rng
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+
+@cached
+def _data():
+    r = fixed_rng("uci_housing")
+    n = 506
+    x = r.randn(n, 13).astype(np.float32)
+    w = r.randn(13, 1).astype(np.float32)
+    y = (x @ w + 0.1 * r.randn(n, 1)).astype(np.float32)
+    return x, y
+
+
+def _reader(lo, hi):
+    def reader():
+        x, y = _data()
+        for i in range(lo, hi):
+            yield x[i], y[i]
+
+    return reader
+
+
+def train():
+    return _reader(0, 406)
+
+
+def test():
+    return _reader(406, 506)

@@ -1,0 +1,33 @@
+"""wmt14: (src ids, trg ids, trg_next ids) translation triples.
+
+Reference: /root/reference/python/paddle/v2/dataset/wmt14.py (train/test
+readers over a bpe-ish dict with <s>=0, <e>=1, <unk>=2).  Synthetic copy
+task: target = source shifted into the target id space.
+"""
+from __future__ import annotations
+
+from .common import fixed_rng
+
+__all__ = ["train", "test", "start_id", "end_id", "unk_id"]
+
+start_id, end_id, unk_id = 0, 1, 2
+
+
+def _reader(tag, n, dict_size):
+    def reader():
+        r = fixed_rng("wmt14/" + tag)
+        for _ in range(n):
+            ln = int(r.randint(3, 10))
+            src = [int(w) for w in r.randint(3, dict_size, ln)]
+            trg = src  # copy task keeps convergence measurable
+            yield src, [start_id] + trg, trg + [end_id]
+
+    return reader
+
+
+def train(dict_size):
+    return _reader("train", 1024, dict_size)
+
+
+def test(dict_size):
+    return _reader("test", 256, dict_size)

@@ -1,0 +1,134 @@
+"""Profiler: per-op/segment range events with an aggregated summary table,
+plus XLA trace capture.
+
+Reference: /root/reference/paddle/fluid/platform/profiler.{h,cc}
+(thread-local EventList, RecordEvent RAII around every op in
+Executor::Run, EnableProfiler/DisableProfiler -> sorted table of
+calls/total/min/max/ave) and python/paddle/v2/fluid/profiler.py
+(`profiler` and `cuda_profiler` context managers).
+
+TPU mapping: interpreter/segmented modes time each op (or compiled
+segment) with `block_until_ready` fencing — the analogue of the
+reference's cudaEvent timing on the op stream.  Whole-block compiled mode
+is one fused XLA executable, so per-op attribution comes from
+`xla_profiler` (jax.profiler trace, viewable in TensorBoard/Perfetto)
+instead — the TPU answer to `cuda_profiler`'s nvprof output.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "enable_profiler",
+    "disable_profiler",
+    "reset_profiler",
+    "profiler",
+    "cuda_profiler",
+    "xla_profiler",
+    "record_event",
+    "profiler_summary",
+]
+
+_enabled = False
+_events: Dict[str, List[float]] = {}
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def record_event(name: str, sync=None):
+    """RAII range event (reference platform::RecordEvent).  `sync` is
+    called before reading the clock (device fence, e.g. block_until_ready)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync is not None:
+            sync()
+        _events.setdefault(name, []).append(time.perf_counter() - t0)
+
+
+def enable_profiler(state: str = "All"):
+    global _enabled
+    assert state in ("CPU", "GPU", "TPU", "All"), state
+    _enabled = True
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def disable_profiler(sorted_key: Optional[str] = None, print_table=True):
+    """Stop profiling; print/return the aggregated table
+    (reference DisableProfiler + PrintProfiler)."""
+    global _enabled
+    _enabled = False
+    table = profiler_summary(sorted_key)
+    if print_table:
+        print(format_summary(table))
+    return table
+
+
+def profiler_summary(sorted_key: Optional[str] = None):
+    rows = []
+    for name, ts in _events.items():
+        rows.append({
+            "name": name, "calls": len(ts), "total": sum(ts),
+            "min": min(ts), "max": max(ts), "ave": sum(ts) / len(ts),
+        })
+    key = sorted_key or "default"
+    if key in ("calls", "total", "min", "max", "ave"):
+        rows.sort(key=lambda r: -r[key])
+    return rows
+
+
+def format_summary(rows) -> str:
+    out = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+           f"{'Max(ms)':>10}{'Ave(ms)':>10}"]
+    for r in rows:
+        out.append(
+            f"{r['name']:<40}{r['calls']:>8}{r['total'] * 1e3:>12.3f}"
+            f"{r['min'] * 1e3:>10.3f}{r['max'] * 1e3:>10.3f}"
+            f"{r['ave'] * 1e3:>10.3f}")
+    return "\n".join(out)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "CPU", sorted_key: Optional[str] = None,
+             print_table=True):
+    """`with profiler.profiler('All', 'total'):` (reference
+    fluid/profiler.py:76)."""
+    enable_profiler(state)
+    reset_profiler()
+    try:
+        yield
+    finally:
+        disable_profiler(sorted_key, print_table=print_table)
+
+
+@contextlib.contextmanager
+def xla_profiler(log_dir: str = "/tmp/paddle_tpu_trace"):
+    """Capture an XLA device trace via jax.profiler (TensorBoard/Perfetto
+    viewable) — the TPU replacement for nvprof capture."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+# API-compat alias: reference scripts say cuda_profiler; on this stack the
+# device tracer is the XLA profiler.
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    with xla_profiler() as d:
+        yield d

@@ -1,0 +1,146 @@
+"""Event-driven trainer conveniences atop the fluid-style API.
+
+Reference: /root/reference/python/paddle/v2/trainer.py (SGD.train :137-216,
+test :218) and v2/event.py (BeginPass/EndPass/BeginIteration/EndIteration
+callbacks).  The v2 gserver machinery is not rebuilt (SURVEY.md §7 hard
+part 7); these are the same user-facing conveniences expressed over
+Program + Executor.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import CPUPlace, Executor
+from .core.framework import (
+    Program,
+    default_main_program,
+    default_startup_program,
+)
+from .data_feeder import DataFeeder
+
+__all__ = [
+    "BeginPass",
+    "EndPass",
+    "BeginIteration",
+    "EndIteration",
+    "Trainer",
+]
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass:
+    def __init__(self, pass_id, metrics=None):
+        self.pass_id = pass_id
+        self.metrics = metrics
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration:
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.metrics = metrics
+
+
+class Trainer:
+    """Pass/batch loop with event callbacks (reference v2 SGD.train shape,
+    fluid executor underneath)."""
+
+    def __init__(self, loss, optimizer=None, place=None, feed_list=None,
+                 main_program: Optional[Program] = None,
+                 startup_program: Optional[Program] = None,
+                 fetch_list: Optional[Sequence] = None):
+        self.loss = loss
+        self.place = place or CPUPlace()
+        self.main_program = main_program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.feed_list = feed_list
+        self.fetch_list = list(fetch_list or [])
+        if optimizer is not None and not self._has_optimize_ops():
+            optimizer.minimize(loss)
+        self.exe = Executor(self.place)
+        self._started = False
+
+    def _has_optimize_ops(self):
+        opt_types = {"sgd", "momentum", "adam", "adamax", "adagrad",
+                     "adadelta", "decayed_adagrad", "ftrl", "rmsprop"}
+        return any(op.type in opt_types
+                   for op in self.main_program.global_block().ops)
+
+    def _feeder(self):
+        if self.feed_list is None:
+            raise ValueError("Trainer needs feed_list to build a DataFeeder")
+        return DataFeeder(feed_list=self.feed_list, place=self.place)
+
+    def start(self):
+        if not self._started:
+            self.exe.run(self.startup_program)
+            self._started = True
+
+    def train(self, num_passes: int, reader: Callable,
+              event_handler: Optional[Callable] = None,
+              feeder: Optional[DataFeeder] = None):
+        """reader: batch reader (yields lists of samples per batch)."""
+        self.start()
+        event_handler = event_handler or (lambda e: None)
+        feeder = feeder or self._feeder()
+        fetches = [self.loss] + self.fetch_list
+        for pass_id in range(num_passes):
+            event_handler(BeginPass(pass_id))
+            pass_costs = []
+            for batch_id, batch in enumerate(reader()):
+                event_handler(BeginIteration(pass_id, batch_id))
+                outs = self.exe.run(self.main_program,
+                                    feed=feeder.feed(batch),
+                                    fetch_list=fetches)
+                cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                pass_costs.append(cost)
+                event_handler(EndIteration(pass_id, batch_id, cost,
+                                           metrics=outs[1:]))
+            event_handler(EndPass(pass_id, metrics={
+                "avg_cost": float(np.mean(pass_costs)) if pass_costs
+                else float("nan")}))
+
+    def test(self, reader: Callable, feeder: Optional[DataFeeder] = None,
+             fetch_list: Optional[Sequence] = None):
+        """Average fetched values over a reader using the inference clone
+        of the program (is_test behavior for dropout/batch_norm)."""
+        self.start()
+        feeder = feeder or self._feeder()
+        fetches = list(fetch_list or [self.loss] + self.fetch_list)
+        test_prog = self.main_program.clone(for_test=True)
+        totals, n = None, 0
+        for batch in reader():
+            outs = self.exe.run(test_prog, feed=feeder.feed(batch),
+                                fetch_list=fetches)
+            vals = [float(np.asarray(o).reshape(-1)[0]) for o in outs]
+            totals = vals if totals is None else [
+                a + b for a, b in zip(totals, vals)]
+            n += 1
+        return [t / max(n, 1) for t in (totals or [])]
+
+    def save_params(self, dirname):
+        from . import io
+
+        self.start()
+        io.save_persistables(self.exe, dirname,
+                             main_program=self.main_program)
+
+    def save_inference_model(self, dirname, feeded_var_names, target_vars):
+        from . import io
+
+        self.start()
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                self.exe, main_program=self.main_program)
