@@ -38,9 +38,22 @@ from .scope import Scope
 
 def _run_op_instrumented(ctx, op, env):
     """run_op + optional profiling (reference executor.cc:124 RecordEvent)
-    and nan/inf scanning (executor.cc:132-140 FLAGS_check_nan_inf)."""
-    from ... import profiler as _noprofiler  # pragma: no cover
-    raise RuntimeError  # replaced below
+    and nan/inf scanning (executor.cc:132-140 FLAGS_check_nan_inf).
+    Only eager (interpreter / host-segment) op execution goes through here —
+    ops inside a jit trace are compile-time and get no per-op events; compiled
+    executions are timed as whole-segment/block events by their callers."""
+    from paddle_tpu import profiler
+
+    sync = (lambda: _op_sync(env, op)) if get_flag("benchmark") else None
+    if profiler.is_enabled():
+        with profiler.record_event(op.type, sync=sync):
+            run_op(ctx, op, env)
+    else:
+        run_op(ctx, op, env)
+        if sync is not None:
+            sync()
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(env, op)
 
 
 def _op_sync(env, op):
@@ -262,7 +275,7 @@ class Executor:
                 env.set(name, _to_device_value(v, device))
             ctx = ExecContext(key, scope=local, executor=self)
             for op in block.ops:
-                run_op(ctx, op, env)
+                _run_op_instrumented(ctx, op, env)
             outs = self._fetch(env, fetch_names)
         scope.kids.remove(local)
         return outs
@@ -306,7 +319,7 @@ class Executor:
             for seg_idx, (is_host, ops) in enumerate(self._segments(block)):
                 if is_host:
                     for op in ops:
-                        run_op(ctx, op, env)
+                        _run_op_instrumented(ctx, op, env)
                     continue
                 self._run_segment_compiled(fp, seg_idx, ops, env, key)
             outs = self._fetch(env, fetch_names)
@@ -337,7 +350,14 @@ class Executor:
                         if n in seg_env.d}
             fn = jax.jit(fn)
             self._cache[cache_key] = fn
-        out = fn(in_vals, key)
+        from paddle_tpu import profiler
+
+        if profiler.is_enabled():
+            with profiler.record_event(f"xla_segment_{seg_idx}"):
+                out = fn(in_vals, key)
+                jax.block_until_ready(out)
+        else:
+            out = fn(in_vals, key)
         for n, v in out.items():
             env.set(n, v)
 
@@ -406,7 +426,14 @@ class Executor:
                 block, fetch_names, state_out_names
             )
             self._cache[cache_key] = fn
-        fetches, state_out = fn(feed_vals, ro, rw, key)
+        from paddle_tpu import profiler
+
+        if profiler.is_enabled():
+            with profiler.record_event("xla_block"):
+                fetches, state_out = fn(feed_vals, ro, rw, key)
+                jax.block_until_ready((fetches, state_out))
+        else:
+            fetches, state_out = fn(feed_vals, ro, rw, key)
         for n, v in state_out.items():
             scope.set_var(n, v)
         return [fetches[n] for n in fetch_names]

@@ -14,12 +14,13 @@ def fixed_rng(tag: str) -> np.random.RandomState:
 
 
 def cached(fn):
-    """Memoize a zero-arg dataset builder."""
+    """Memoize a dataset builder on its (hashable) arguments."""
     store = {}
 
-    def wrapper():
-        if "v" not in store:
-            store["v"] = fn()
-        return store["v"]
+    def wrapper(*args, **kwargs):
+        k = (args, tuple(sorted(kwargs.items())))
+        if k not in store:
+            store[k] = fn(*args, **kwargs)
+        return store[k]
 
     return wrapper

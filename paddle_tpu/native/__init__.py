@@ -1,0 +1,224 @@
+"""Native runtime library: C++ host-side services behind a ctypes C ABI.
+
+The reference's runtime layer is native C++ (channels framework/channel.h,
+thread pool framework/threadpool.h, buddy allocator
+memory/detail/buddy_allocator.h, reader pipeline framework/reader.h, cloud
+master go/master/service.go).  This package is the TPU rebuild's native
+equivalent, compiled on first use with the local toolchain (g++) into
+``_native.so`` and loaded via ctypes.  JAX/XLA owns the device; this layer
+owns host concurrency, staging memory, data loading and cluster services.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_native.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    src = os.path.join(_DIR, "src")
+    return any(
+        os.path.getmtime(os.path.join(src, f)) > so_mtime
+        for f in os.listdir(src)
+        if f.endswith((".cc", ".h"))
+    )
+
+
+def _build():
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_DIR, check=True, capture_output=True
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        out = getattr(e, "stderr", b"") or b""
+        raise RuntimeError(
+            "failed to build paddle_tpu native library: %s" % out.decode()
+        ) from e
+
+
+def lib() -> ctypes.CDLL:
+    """Build (if stale) and load the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build():
+            _build()
+        l = ctypes.CDLL(_SO)
+        _declare(l)
+        _lib = l
+    return _lib
+
+
+def _declare(l: ctypes.CDLL):
+    p, sz, i, u64 = (
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+    )
+    l.pt_channel_create.restype = p
+    l.pt_channel_create.argtypes = [sz, sz]
+    l.pt_channel_send.restype = i
+    l.pt_channel_send.argtypes = [p, ctypes.c_void_p]
+    l.pt_channel_recv.restype = i
+    l.pt_channel_recv.argtypes = [p, ctypes.c_void_p]
+    l.pt_channel_close.argtypes = [p]
+    l.pt_channel_size.restype = sz
+    l.pt_channel_size.argtypes = [p]
+    l.pt_channel_is_closed.restype = i
+    l.pt_channel_is_closed.argtypes = [p]
+    l.pt_channel_destroy.argtypes = [p]
+
+    l.pt_threadpool_create.restype = p
+    l.pt_threadpool_create.argtypes = [sz]
+    l.pt_threadpool_num_threads.restype = sz
+    l.pt_threadpool_num_threads.argtypes = [p]
+    l.pt_threadpool_submit.argtypes = [p, ctypes.c_void_p, ctypes.c_void_p]
+    l.pt_threadpool_wait.argtypes = [p]
+    l.pt_threadpool_destroy.argtypes = [p]
+
+    l.pt_buddy_create.restype = p
+    l.pt_buddy_create.argtypes = [sz, sz]
+    l.pt_buddy_alloc.restype = p
+    l.pt_buddy_alloc.argtypes = [p, sz]
+    l.pt_buddy_free.argtypes = [p, ctypes.c_void_p]
+    l.pt_buddy_stats.argtypes = [p, u64]
+    l.pt_buddy_destroy.argtypes = [p]
+
+
+TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class Channel:
+    """CSP channel of fixed-size byte elements (capacity 0 = unbuffered).
+
+    Mirrors the reference's Channel semantics (framework/channel.h): blocking
+    send/recv, close() wakes waiters, recv drains a closed channel.
+    """
+
+    def __init__(self, elem_size: int, capacity: int = 0):
+        self._l = lib()
+        self.elem_size = elem_size
+        self._h = self._l.pt_channel_create(elem_size, capacity)
+
+    def send(self, data: bytes) -> bool:
+        if len(data) != self.elem_size:
+            raise ValueError(
+                f"element must be {self.elem_size} bytes, got {len(data)}"
+            )
+        buf = ctypes.create_string_buffer(data, self.elem_size)
+        return bool(self._l.pt_channel_send(self._h, ctypes.cast(buf, ctypes.c_void_p)))
+
+    def recv(self):
+        buf = ctypes.create_string_buffer(self.elem_size)
+        ok = self._l.pt_channel_recv(self._h, ctypes.cast(buf, ctypes.c_void_p))
+        return buf.raw if ok else None
+
+    def close(self):
+        self._l.pt_channel_close(self._h)
+
+    def __len__(self):
+        return self._l.pt_channel_size(self._h)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._l.pt_channel_is_closed(self._h))
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._l.pt_channel_destroy(self._h)
+            self._h = None
+
+
+class ThreadPool:
+    """Native worker pool (reference framework/threadpool.h)."""
+
+    def __init__(self, num_threads: int = 0):
+        self._l = lib()
+        self._h = self._l.pt_threadpool_create(num_threads)
+        self._keepalive = []
+
+    @property
+    def num_threads(self) -> int:
+        return self._l.pt_threadpool_num_threads(self._h)
+
+    def submit(self, fn):
+        """Run zero-arg python callable on a pool thread."""
+        cb_holder = []
+
+        def trampoline(_):
+            try:
+                fn()
+            finally:
+                self._keepalive.remove(cb_holder[0])
+
+        cb = TASK_FN(trampoline)
+        cb_holder.append(cb)
+        self._keepalive.append(cb)
+        self._l.pt_threadpool_submit(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None
+        )
+
+    def wait(self):
+        self._l.pt_threadpool_wait(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._l.pt_threadpool_destroy(self._h)
+            self._h = None
+
+
+class BuddyAllocator:
+    """Buddy-system host allocator (reference memory/detail/buddy_allocator.h).
+
+    alloc() returns raw addresses (ints) inside native arena chunks; use
+    with `view()` to get zero-copy numpy arrays over allocator memory.
+    """
+
+    def __init__(self, min_block_log2: int = 6, chunk_log2: int = 26):
+        self._l = lib()
+        self._h = self._l.pt_buddy_create(min_block_log2, chunk_log2)
+
+    def alloc(self, nbytes: int) -> int:
+        p = self._l.pt_buddy_alloc(self._h, nbytes)
+        if not p:
+            raise MemoryError(f"buddy allocator failed for {nbytes} bytes")
+        return p
+
+    def free(self, addr: int):
+        self._l.pt_buddy_free(self._h, addr)
+
+    def view(self, addr: int, shape, dtype):
+        import numpy as np
+
+        dtype = np.dtype(dtype)
+        n = int(np.prod(shape)) * dtype.itemsize
+        buf = (ctypes.c_char * n).from_address(addr)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        self._l.pt_buddy_stats(self._h, out)
+        return {
+            "arena_bytes": out[0],
+            "in_use": out[1],
+            "peak_in_use": out[2],
+            "num_chunks": out[3],
+        }
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._l.pt_buddy_destroy(self._h)
+            self._h = None

@@ -1,0 +1,14 @@
+// Shared declarations for the native runtime library.
+//
+// The reference implements its runtime layer (channels, thread pool, memory
+// allocator, reader pipeline, cloud master) in native code
+// (/root/reference/paddle/fluid/framework/channel.h, threadpool.h,
+// memory/detail/buddy_allocator.h, framework/reader.h, go/master/service.go).
+// This library is the TPU rebuild's native equivalent: host-side runtime
+// services around the JAX/XLA compute path, exposed to Python over a flat
+// C ABI consumed via ctypes.
+#pragma once
+#include <cstddef>
+#include <cstdint>
+
+#define PT_API extern "C" __attribute__((visibility("default")))

@@ -30,6 +30,14 @@ class ComposeNotAligned(ValueError):
     pass
 
 
+class _Error:
+    """Exception carrier for worker->consumer queues: background reader
+    failures re-raise in the consumer instead of truncating the stream."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def map_readers(func, *readers):
     """Reader applying `func` across the outputs of several readers
     (decorator.py map_readers)."""
@@ -109,8 +117,9 @@ def buffered(reader, size):
             try:
                 for d in reader():
                     q.put(d)
-            finally:
                 q.put(_End)
+            except BaseException as e:  # propagate, don't truncate the stream
+                q.put(_Error(e))
 
         t = threading.Thread(target=feed, daemon=True)
         t.start()
@@ -118,6 +127,8 @@ def buffered(reader, size):
             e = q.get()
             if e is _End:
                 break
+            if isinstance(e, _Error):
+                raise e.exc
             yield e
 
     return data_reader
@@ -145,19 +156,30 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = queue.Queue(buffer_size)
 
         def feed():
-            for i, d in enumerate(reader()):
-                in_q.put((i, d))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+                for _ in range(process_num):
+                    in_q.put(_End)
+            except BaseException as e:
+                # wake every worker with the error so each forwards one
+                # _Error/_End downstream and the consumer can't deadlock
+                for _ in range(process_num):
+                    in_q.put(_Error(e))
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is _End:
-                    out_q.put(_End)
-                    break
-                i, d = item
-                out_q.put((i, mapper(d)))
+            item = in_q.get()
+            try:
+                while item is not _End:
+                    if isinstance(item, _Error):
+                        out_q.put(item)
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+                    item = in_q.get()
+                out_q.put(_End)
+            except BaseException as e:  # mapper raised
+                out_q.put(_Error(e))
 
         threading.Thread(target=feed, daemon=True).start()
         workers = [threading.Thread(target=work, daemon=True)
@@ -173,6 +195,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is _End:
                     finished += 1
                     continue
+                if isinstance(item, _Error):
+                    raise item.exc
                 i, d = item
                 pending[i] = d
                 while want in pending:
@@ -186,6 +210,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is _End:
                     finished += 1
                     continue
+                if isinstance(item, _Error):
+                    raise item.exc
                 yield item[1]
 
     return data_reader

@@ -1,0 +1,130 @@
+"""Reader decorators, profiler wiring, runtime flags.
+
+Reference test models: /root/reference/python/paddle/v2/reader/tests/
+decorator_test.py (map/shuffle/chain/compose/buffered/xmap semantics) and
+python/paddle/v2/fluid/tests/test_profiler.py (profiler context manager
+produces a populated table).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.reader as reader
+from paddle_tpu import profiler
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.dataset.common import cached
+
+
+def _range_reader(n):
+    def r():
+        yield from range(n)
+
+    return r
+
+
+class TestReaderDecorators:
+    def test_buffered_preserves_order(self):
+        assert list(reader.buffered(_range_reader(100), 10)()) == list(
+            range(100)
+        )
+
+    def test_buffered_propagates_reader_error(self):
+        def bad():
+            yield 1
+            raise IOError("disk gone")
+
+        with pytest.raises(IOError, match="disk gone"):
+            list(reader.buffered(bad, 4)())
+
+    def test_xmap_maps_all(self):
+        out = sorted(
+            reader.xmap_readers(
+                lambda x: x * 2, _range_reader(50), 4, 8
+            )()
+        )
+        assert out == [2 * i for i in range(50)]
+
+    def test_xmap_ordered(self):
+        out = list(
+            reader.xmap_readers(
+                lambda x: x * 2, _range_reader(50), 4, 8, order=True
+            )()
+        )
+        assert out == [2 * i for i in range(50)]
+
+    def test_xmap_propagates_mapper_error(self):
+        def mapper(x):
+            if x == 13:
+                raise ValueError("bad sample")
+            return x
+
+        with pytest.raises(ValueError, match="bad sample"):
+            list(reader.xmap_readers(mapper, _range_reader(50), 4, 8)())
+
+    def test_xmap_propagates_reader_error(self):
+        def bad():
+            yield 1
+            raise IOError("reader died")
+
+        with pytest.raises(IOError, match="reader died"):
+            list(reader.xmap_readers(lambda x: x, bad, 3, 4)())
+
+    def test_cached_with_args(self):
+        calls = []
+
+        @cached
+        def build(k=10):
+            calls.append(k)
+            return list(range(k))
+
+        assert build(3) == [0, 1, 2]
+        assert build(3) == [0, 1, 2]
+        assert build(k=5) == list(range(5))
+        assert calls == [3, 5]
+
+
+def _tiny_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=3, act="relu")
+        loss = fluid.layers.mean(h)
+    return main, startup, loss
+
+
+class TestProfilerWiring:
+    def test_interpreter_records_per_op_events(self):
+        main, startup, loss = _tiny_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with profiler.profiler("CPU", print_table=False):
+            exe.run(main, feed=feed, fetch_list=[loss], compiled=False)
+            rows = profiler.profiler_summary()
+        names = {r["name"] for r in rows}
+        assert "mul" in names and "mean" in names  # per-op events recorded
+
+    def test_compiled_records_block_event(self):
+        main, startup, loss = _tiny_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with profiler.profiler("All", print_table=False):
+            exe.run(main, feed=feed, fetch_list=[loss])
+            rows = profiler.profiler_summary()
+        assert any(r["name"] == "xla_block" for r in rows)
+
+    def test_check_nan_inf_flag(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+            y = fluid.layers.log(x)  # log(-1) -> nan
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bad = {"x": -np.ones((1, 2), np.float32)}
+        set_flags({"check_nan_inf": True})
+        try:
+            with pytest.raises(RuntimeError, match="NaN/Inf"):
+                exe.run(main, feed=bad, fetch_list=[y], compiled=False)
+        finally:
+            set_flags({"check_nan_inf": False})
