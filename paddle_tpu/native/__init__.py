@@ -97,6 +97,22 @@ def _declare(l: ctypes.CDLL):
     l.pt_buddy_stats.argtypes = [p, u64]
     l.pt_buddy_destroy.argtypes = [p]
 
+    l.pt_loader_create.restype = p
+    l.pt_loader_create.argtypes = [
+        sz, ctypes.POINTER(ctypes.c_size_t), sz, sz, ctypes.c_uint64, sz, i,
+    ]
+    l.pt_loader_push.restype = i
+    l.pt_loader_push.argtypes = [p, ctypes.POINTER(ctypes.c_void_p)]
+    l.pt_loader_finish_epoch.argtypes = [p]
+    l.pt_loader_next.restype = p
+    l.pt_loader_next.argtypes = [p]
+    l.pt_batch_n.restype = sz
+    l.pt_batch_n.argtypes = [p]
+    l.pt_batch_slot.restype = p
+    l.pt_batch_slot.argtypes = [p, sz]
+    l.pt_batch_release.argtypes = [p, p]
+    l.pt_loader_destroy.argtypes = [p]
+
 
 TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
@@ -177,6 +193,109 @@ class ThreadPool:
     def __del__(self):
         if getattr(self, "_h", None):
             self._l.pt_threadpool_destroy(self._h)
+            self._h = None
+
+
+class NativeLoader:
+    """Shuffle/batch/prefetch input pipeline running on native threads.
+
+    The TPU-native double_buffer reader (reference framework/reader.h
+    decorators, PyDataProvider2's async pool): Python pushes fixed-shape
+    samples (the ctypes call releases the GIL for the copy), a native worker
+    shuffles with a seeded RNG, stacks samples into contiguous per-slot batch
+    buffers from a buddy-allocated arena, and double-buffers ready batches
+    (`prefetch_depth`) so host assembly overlaps device compute.
+
+    slots: list of (shape, dtype) per sample component, e.g.
+           [((3, 32, 32), np.float32), ((1,), np.int32)].
+    """
+
+    def __init__(self, slots, batch_size, shuffle_buf=0, seed=0,
+                 prefetch_depth=2, drop_last=False):
+        import numpy as np
+
+        self._l = lib()
+        self.slots = [
+            (tuple(shape), np.dtype(dt)) for shape, dt in slots
+        ]
+        self.batch_size = batch_size
+        nbytes = [
+            int(np.prod(shape)) * dt.itemsize for shape, dt in self.slots
+        ]
+        arr = (ctypes.c_size_t * len(nbytes))(*nbytes)
+        self._h = self._l.pt_loader_create(
+            len(nbytes), arr, batch_size, shuffle_buf, seed, prefetch_depth,
+            1 if drop_last else 0,
+        )
+
+    def push(self, *arrays) -> bool:
+        """Push one sample (one contiguous array per slot)."""
+        import numpy as np
+
+        if len(arrays) != len(self.slots):
+            raise ValueError(
+                f"expected {len(self.slots)} slots, got {len(arrays)}"
+            )
+        ptrs = (ctypes.c_void_p * len(arrays))()
+        keep = []
+        for i, (a, (shape, dt)) in enumerate(zip(arrays, self.slots)):
+            a = np.ascontiguousarray(a, dtype=dt)
+            if a.shape != shape:
+                raise ValueError(
+                    f"slot {i}: expected shape {shape}, got {a.shape}"
+                )
+            keep.append(a)
+            ptrs[i] = a.ctypes.data
+        return bool(self._l.pt_loader_push(self._h, ptrs))
+
+    def finish_epoch(self):
+        self._l.pt_loader_finish_epoch(self._h)
+
+    def next_batch(self):
+        """Blocking: next batch as a tuple of numpy arrays, or None at epoch
+        end.  The arrays are copies owned by Python (safe to hold)."""
+        import numpy as np
+
+        b = self._l.pt_loader_next(self._h)
+        if not b:
+            return None
+        n = self._l.pt_batch_n(b)
+        out = []
+        for i, (shape, dt) in enumerate(self.slots):
+            addr = self._l.pt_batch_slot(b, i)
+            nbytes = n * int(np.prod(shape)) * dt.itemsize
+            buf = (ctypes.c_char * nbytes).from_address(addr)
+            out.append(
+                np.frombuffer(buf, dtype=dt).reshape((n,) + shape).copy()
+            )
+        self._l.pt_batch_release(self._h, b)
+        return tuple(out)
+
+    def run(self, sample_reader):
+        """Feed `sample_reader` (yields per-slot tuples) on a background
+        Python thread; yield assembled batches until the epoch drains."""
+        import threading
+
+        def feed():
+            for sample in sample_reader():
+                if not isinstance(sample, (tuple, list)):
+                    sample = (sample,)
+                if not self.push(*sample):
+                    return  # loader shut down
+            self.finish_epoch()
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            b = self.next_batch()
+            if b is None:
+                break
+            yield b
+        t.join()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._l.pt_loader_destroy(self._h)
             self._h = None
 
 

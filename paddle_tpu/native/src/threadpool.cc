@@ -76,18 +76,6 @@ struct ThreadPool {
 
 }  // namespace
 
-// Internal C++ access for sibling translation units (loader.cc).
-void* pt_internal_threadpool_create(size_t n) { return new ThreadPool(n); }
-void pt_internal_threadpool_submit(void* h, std::function<void()> fn) {
-  static_cast<ThreadPool*>(h)->Submit(std::move(fn));
-}
-void pt_internal_threadpool_wait(void* h) {
-  static_cast<ThreadPool*>(h)->Wait();
-}
-void pt_internal_threadpool_destroy(void* h) {
-  delete static_cast<ThreadPool*>(h);
-}
-
 PT_API void* pt_threadpool_create(size_t num_threads) {
   if (num_threads == 0) {
     num_threads = std::max(1u, std::thread::hardware_concurrency());

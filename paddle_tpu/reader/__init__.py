@@ -22,6 +22,7 @@ __all__ = [
     "xmap_readers",
     "cache",
     "batch",
+    "native_pipeline",
     "ComposeNotAligned",
 ]
 
@@ -244,4 +245,31 @@ def batch(reader, batch_size, drop_last=False):
         if b and not drop_last:
             yield b
 
+    return batch_reader
+
+
+def native_pipeline(reader, slots, batch_size, shuffle_buf=0, seed=0,
+                    prefetch_depth=2, drop_last=False):
+    """Fused shuffle+batch+double_buffer on native threads: yields tuples of
+    stacked numpy arrays, one per slot.
+
+    The native replacement for `shuffle(...) |> batch(...) |> buffered(...)`
+    when samples are fixed-shape: shuffling, the stacking memcpy and prefetch
+    all run off the GIL in C++ (paddle_tpu/native/src/loader.cc), overlapping
+    the input pipeline with device compute — the role the reference's
+    double_buffer reader (framework/reader.h) and PyDataProvider2's async
+    pool play.
+
+    slots: [(shape, dtype), ...] of one sample's components.
+    """
+    from paddle_tpu.native import NativeLoader
+
+    loader = NativeLoader(slots, batch_size, shuffle_buf=shuffle_buf,
+                          seed=seed, prefetch_depth=prefetch_depth,
+                          drop_last=drop_last)
+
+    def batch_reader():
+        return loader.run(reader)
+
+    batch_reader.loader = loader
     return batch_reader
