@@ -1,0 +1,12 @@
+"""Cloud/elastic training services: task-dispatch master + elastic readers.
+
+The TPU rebuild of the reference's Go cloud layer (/root/reference/go/):
+fault-tolerant dataset dispatch (go/master/service.go) with stateless,
+elastic trainers (doc/design/cluster_train/README.md).  The master itself is
+native C++ (paddle_tpu/native/src/master.cc); this package provides the
+Python client surface that python/paddle/v2/master/client.py provided over
+cgo there.
+"""
+from .master import Master, MasterClient, task_record_reader
+
+__all__ = ["Master", "MasterClient", "task_record_reader"]

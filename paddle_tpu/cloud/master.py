@@ -1,0 +1,244 @@
+"""Task-dispatch master: Python surface over the native implementation.
+
+Reference: /root/reference/go/master/service.go (task queues, timeout
+re-dispatch, failureMax discard, snapshot/recover) and
+python/paddle/v2/master/client.py:29-117 (the trainer-side client:
+set_dataset / next record paradigm).
+"""
+from __future__ import annotations
+
+import ctypes
+import socket
+import time
+from typing import List, Optional, Sequence
+
+from paddle_tpu import native
+
+
+def _declare(l):
+    if getattr(l, "_master_declared", False):
+        return l
+    p, sz, i = ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int
+    i64 = ctypes.c_int64
+    l.pt_master_create.restype = p
+    l.pt_master_create.argtypes = [i, ctypes.c_double, ctypes.c_char_p]
+    l.pt_master_set_dataset.restype = i
+    l.pt_master_set_dataset.argtypes = [
+        p, ctypes.POINTER(ctypes.c_char_p), sz, sz,
+    ]
+    l.pt_master_has_dataset.restype = i
+    l.pt_master_has_dataset.argtypes = [p]
+    l.pt_master_get_task.restype = i
+    l.pt_master_get_task.argtypes = [
+        p, ctypes.POINTER(i64), ctypes.c_char_p, sz,
+    ]
+    l.pt_master_task_finished.restype = i
+    l.pt_master_task_finished.argtypes = [p, i64]
+    l.pt_master_task_failed.restype = i
+    l.pt_master_task_failed.argtypes = [p, i64]
+    l.pt_master_counts.argtypes = [p, ctypes.POINTER(i64)]
+    l.pt_master_serve.restype = i
+    l.pt_master_serve.argtypes = [p, i]
+    l.pt_master_stop.argtypes = [p]
+    l.pt_master_destroy.argtypes = [p]
+    l._master_declared = True
+    return l
+
+
+class Master:
+    """In-process master; optionally served over TCP for remote trainers.
+
+    failure_max / timeout_s mirror the reference's task re-dispatch policy
+    (service.go checkTimeoutFunc/processFailedTask); snapshot_path enables
+    crash recovery (service.go snapshot/recover — a file here, etcd there).
+    """
+
+    def __init__(self, failure_max: int = 3, timeout_s: float = 60.0,
+                 snapshot_path: Optional[str] = None):
+        self._l = _declare(native.lib())
+        self._h = self._l.pt_master_create(
+            failure_max, timeout_s,
+            snapshot_path.encode() if snapshot_path else None,
+        )
+        self.port = None
+
+    def set_dataset(self, chunks: Sequence[str], chunks_per_task: int = 1):
+        arr = (ctypes.c_char_p * len(chunks))(
+            *[c.encode() for c in chunks]
+        )
+        self._l.pt_master_set_dataset(
+            self._h, arr, len(chunks), chunks_per_task
+        )
+
+    @property
+    def has_dataset(self) -> bool:
+        return bool(self._l.pt_master_has_dataset(self._h))
+
+    def get_task(self):
+        """-> (task_id, [chunks]) or None if nothing available right now."""
+        tid = ctypes.c_int64()
+        buf = ctypes.create_string_buffer(1 << 20)
+        st = self._l.pt_master_get_task(
+            self._h, ctypes.byref(tid), buf, len(buf)
+        )
+        if st == 0:
+            return None
+        chunks = buf.value.decode().split("\n") if buf.value else []
+        return tid.value, chunks
+
+    def task_finished(self, task_id: int) -> bool:
+        return bool(self._l.pt_master_task_finished(self._h, task_id))
+
+    def task_failed(self, task_id: int) -> bool:
+        return bool(self._l.pt_master_task_failed(self._h, task_id))
+
+    def counts(self) -> dict:
+        out = (ctypes.c_int64 * 5)()
+        self._l.pt_master_counts(self._h, out)
+        return {
+            "todo": out[0], "pending": out[1], "done": out[2],
+            "discarded": out[3], "pass": out[4],
+        }
+
+    # same surface as MasterClient so readers work against either
+    info = counts
+
+    def serve(self, port: int = 0) -> int:
+        """Start the TCP server; returns the bound port."""
+        self.port = self._l.pt_master_serve(self._h, port)
+        if self.port < 0:
+            raise OSError("master: failed to bind server socket")
+        return self.port
+
+    def stop(self):
+        self._l.pt_master_stop(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._l.pt_master_destroy(self._h)
+            self._h = None
+
+
+class MasterClient:
+    """TCP client for a remote Master (the cgo client.py analogue).
+
+    Reconnects on socket failure — a trainer may outlive a restarted master
+    (whose state comes back from its snapshot)."""
+
+    def __init__(self, addr: str, retry_interval: float = 0.2):
+        self.host, port = addr.rsplit(":", 1)
+        self.port = int(port)
+        self.retry_interval = retry_interval
+        self._sock = None
+        self._f = None
+
+    def _connect(self):
+        if self._sock is not None:
+            return
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=30
+        )
+        self._f = self._sock.makefile("rw", newline="\n")
+
+    def _reset(self):
+        try:
+            if self._sock:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._f = None
+
+    def _roundtrip(self, req: str, read_payload=False):
+        for _ in range(50):
+            try:
+                self._connect()
+                self._f.write(req)
+                self._f.flush()
+                line = self._f.readline()
+                if not line:
+                    raise OSError("master connection closed")
+                payload = None
+                if read_payload and line.startswith("OK"):
+                    payload = []
+                    while True:
+                        ln = self._f.readline()
+                        if not ln:
+                            raise OSError("master connection closed")
+                        if ln.rstrip("\n") == ".":
+                            break
+                        payload.append(ln.rstrip("\n"))
+                return line.rstrip("\n"), payload
+            except OSError:
+                self._reset()
+                time.sleep(self.retry_interval)
+        raise OSError(f"master at {self.host}:{self.port} unreachable")
+
+    def set_dataset(self, chunks: Sequence[str], chunks_per_task: int = 1):
+        req = f"SET {chunks_per_task} {len(chunks)}\n" + "".join(
+            c + "\n" for c in chunks
+        )
+        line, _ = self._roundtrip(req)
+        return line == "OK"
+
+    def get_task(self):
+        line, payload = self._roundtrip("GET\n", read_payload=True)
+        if line == "NONE":
+            return None
+        _, _st, tid = line.split()
+        return int(tid), payload
+
+    def task_finished(self, task_id: int) -> bool:
+        return self._roundtrip(f"FIN {task_id}\n")[0] == "OK"
+
+    def task_failed(self, task_id: int) -> bool:
+        return self._roundtrip(f"FAIL {task_id}\n")[0] == "OK"
+
+    def info(self) -> dict:
+        line, _ = self._roundtrip("INFO\n")
+        parts = line.split()
+        return dict(
+            zip(
+                ("todo", "pending", "done", "discarded", "pass"),
+                map(int, parts[1:]),
+            )
+        )
+
+    def close(self):
+        self._reset()
+
+
+def task_record_reader(client, chunk_reader, poll_interval: float = 0.05,
+                       stop_after_pass: bool = True):
+    """Elastic reader: pull tasks from the master, yield records from each
+    chunk via `chunk_reader(chunk) -> iterable`, ack on success, nack on
+    error (reference v2/reader/creator.py:60-117 cloud_reader +
+    master client NextRecord).
+
+    One call iterates one dataset pass: it stops when the master rolls over
+    to a new pass (status 2 on a later get_task) — so a fresh call starts
+    the next pass, matching the epoch-per-call reader convention.
+    """
+
+    def reader():
+        while True:
+            got = client.get_task()
+            if got is None:
+                info = client.info()
+                if info["todo"] == 0 and info["pending"] == 0:
+                    return  # nothing left this pass
+                time.sleep(poll_interval)  # others hold pending tasks
+                continue
+            tid, chunks = got
+            try:
+                for chunk in chunks:
+                    yield from chunk_reader(chunk)
+            except Exception:
+                client.task_failed(tid)
+                raise
+            client.task_finished(tid)
+            if stop_after_pass:
+                info = client.info()
+                if info["todo"] == 0 and info["pending"] == 0:
+                    return
+
+    return reader
