@@ -33,6 +33,9 @@ __all__ = [
     "load_persistables",
     "save_inference_model",
     "load_inference_model",
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_checkpoint",
     "prune",
     "get_inference_program",
 ]
@@ -221,3 +224,133 @@ def load_inference_model(dirname, executor, model_filename=None,
                       filename=params_filename, scope=scope)
     return (program, payload["feed_var_names"],
             payload["fetch_var_names"])
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume with {uuid, md5, timestamp} metadata
+# ---------------------------------------------------------------------------
+#
+# Reference: the Go pserver's checkpoint protocol
+# (/root/reference/go/pserver/service.go:120-203,346 — periodic snapshot of
+# parameter + optimizer state to disk plus a {uuid, md5, timestamp} record in
+# etcd; restore-on-restart) and
+# doc/design/cluster_train/checkpointing.md (atomic publish, stale-file GC).
+# Here the meta record is a JSON file next to the snapshot and the "latest"
+# pointer is an atomically renamed file; on shared storage this serves
+# multi-host resume the way etcd served the Go pservers.
+
+CHECKPOINT_PREFIX = "checkpoint"
+LATEST_FILENAME = "__latest__"
+META_FILENAME = "__meta__"
+
+
+def _md5_of_dir(path: str) -> str:
+    import hashlib
+
+    h = hashlib.md5()
+    for name in sorted(os.listdir(path)):
+        if name.startswith("__"):
+            continue
+        h.update(name.encode())
+        with open(os.path.join(path, name), "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
+
+
+def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
+                    scope=None, max_keep: int = 3) -> str:
+    """Snapshot persistables (params + optimizer accumulators + LR vars)
+    into `dirname/checkpoint_<uuid>/` with a {uuid, md5, timestamp,
+    trainer_args} meta record, atomically publish it as latest, and GC old
+    snapshots beyond `max_keep`.  Returns the checkpoint uuid."""
+    import time
+    import uuid as uuid_mod
+
+    cp_uuid = uuid_mod.uuid4().hex
+    cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
+    os.makedirs(cp_dir, exist_ok=True)
+    save_persistables(executor, cp_dir, main_program, scope=scope)
+    meta = {
+        "uuid": cp_uuid,
+        "md5": _md5_of_dir(cp_dir),
+        "timestamp": time.time(),
+        "trainer_args": trainer_args or {},
+    }
+    with open(os.path.join(cp_dir, META_FILENAME), "w") as f:
+        json.dump(meta, f)
+    # atomic publish (checkpointing.md: write tmp then rename)
+    latest_tmp = os.path.join(dirname, LATEST_FILENAME + ".tmp")
+    with open(latest_tmp, "w") as f:
+        f.write(cp_uuid)
+    os.replace(latest_tmp, os.path.join(dirname, LATEST_FILENAME))
+    _gc_checkpoints(dirname, keep=max_keep)
+    return cp_uuid
+
+
+def _checkpoints_by_time(dirname):
+    out = []
+    for name in os.listdir(dirname):
+        if not name.startswith(CHECKPOINT_PREFIX + "_"):
+            continue
+        meta_path = os.path.join(dirname, name, META_FILENAME)
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            out.append((meta["timestamp"], name, meta))
+        except (OSError, ValueError, KeyError):
+            continue  # partially written snapshot: GC candidate
+    out.sort()
+    return out
+
+
+def _gc_checkpoints(dirname, keep: int):
+    """Remove all but the newest `keep` complete snapshots, plus any
+    incomplete ones (stale-file GC, checkpointing.md)."""
+    import shutil
+
+    complete = _checkpoints_by_time(dirname)
+    keep_names = {name for _, name, _ in complete[-keep:]}
+    for name in os.listdir(dirname):
+        if not name.startswith(CHECKPOINT_PREFIX + "_"):
+            continue
+        if name not in keep_names:
+            shutil.rmtree(os.path.join(dirname, name), ignore_errors=True)
+
+
+def latest_checkpoint(dirname):
+    """-> (checkpoint_dir, meta dict) of the latest valid snapshot, or
+    (None, None)."""
+    if not os.path.isdir(dirname):
+        return None, None
+    latest = os.path.join(dirname, LATEST_FILENAME)
+    candidates = []
+    if os.path.exists(latest):
+        with open(latest) as f:
+            candidates.append(f.read().strip())
+    # fall back to newest-by-timestamp if the pointer is missing/corrupt
+    candidates.extend(
+        meta["uuid"] for _, _, meta in reversed(_checkpoints_by_time(dirname))
+    )
+    for cp_uuid in candidates:
+        cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
+        meta_path = os.path.join(cp_dir, META_FILENAME)
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if _md5_of_dir(cp_dir) == meta.get("md5"):
+            return cp_dir, meta
+    return None, None
+
+
+def load_checkpoint(executor, dirname, main_program=None, scope=None):
+    """Restore persistables from the latest valid snapshot under `dirname`
+    (md5-verified; falls back to older snapshots if the newest is corrupt).
+    Returns the snapshot's meta dict, or None if no usable snapshot."""
+    cp_dir, meta = latest_checkpoint(dirname)
+    if cp_dir is None:
+        return None
+    load_persistables(executor, cp_dir, main_program, scope=scope)
+    return meta

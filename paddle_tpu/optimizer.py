@@ -49,6 +49,7 @@ class Optimizer:
         self._global_step = global_step
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var = None
+        self._startup_program = None  # set by create_optimization_pass
 
     # -- learning rate -------------------------------------------------------
     def _create_lr_var(self, program):
@@ -60,7 +61,8 @@ class Optimizer:
         self._lr_var = gb.create_var(
             name=name, shape=(1,), dtype="float32", persistable=True,
             stop_gradient=True)
-        sb = default_startup_program().global_block()
+        sb = (self._startup_program or
+              default_startup_program()).global_block()
         sb.create_var(name=name, shape=(1,), dtype="float32",
                       persistable=True)
         sb.append_op("fill_constant", {}, {"Out": [name]},
@@ -79,7 +81,8 @@ class Optimizer:
         gb = param.block.program.global_block()
         acc = gb.create_var(name=acc_name, shape=shape, dtype=dtype,
                             persistable=True, stop_gradient=True)
-        sb = default_startup_program().global_block()
+        sb = (self._startup_program or
+              default_startup_program()).global_block()
         sb.create_var(name=acc_name, shape=tuple(shape), dtype=dtype,
                       persistable=True)
         sb.append_op("fill_constant", {}, {"Out": [acc_name]},
@@ -108,6 +111,10 @@ class Optimizer:
             return []
         block = loss.block
         program = block.program
+        # init ops (LR, accumulators) go into the caller's startup program —
+        # falling back to the ambient default only when none was given
+        # (reference optimizer.py threads startup_program the same way)
+        self._startup_program = startup_program
         self._create_lr_var(program)
         self._create_accumulators(block, [p for p, _ in params_grads])
         for p, g in params_grads:

@@ -69,7 +69,7 @@ class Trainer:
         self.feed_list = feed_list
         self.fetch_list = list(fetch_list or [])
         if optimizer is not None and not self._has_optimize_ops():
-            optimizer.minimize(loss)
+            optimizer.minimize(loss, startup_program=self.startup_program)
         self.exe = Executor(self.place)
         self._started = False
 
@@ -91,13 +91,32 @@ class Trainer:
 
     def train(self, num_passes: int, reader: Callable,
               event_handler: Optional[Callable] = None,
-              feeder: Optional[DataFeeder] = None):
-        """reader: batch reader (yields lists of samples per batch)."""
+              feeder: Optional[DataFeeder] = None,
+              checkpoint_dir: Optional[str] = None,
+              checkpoint_every_n_passes: int = 1,
+              checkpoint_max_keep: int = 3):
+        """reader: batch reader (yields lists of samples per batch).
+
+        With `checkpoint_dir`, resumes from the newest valid snapshot there
+        (params + optimizer state + the pass counter travel in the snapshot
+        meta) and saves a snapshot every `checkpoint_every_n_passes` —
+        the trainer-side analogue of the Go pserver's periodic checkpoint
+        (go/pserver/service.go:120-203) and the book_distribute scripts'
+        per-pass save."""
+        from . import io
+
         self.start()
         event_handler = event_handler or (lambda e: None)
         feeder = feeder or self._feeder()
         fetches = [self.loss] + self.fetch_list
-        for pass_id in range(num_passes):
+        first_pass = 0
+        if checkpoint_dir is not None:
+            meta = io.load_checkpoint(self.exe, checkpoint_dir,
+                                      main_program=self.main_program)
+            if meta is not None:
+                first_pass = int(
+                    meta["trainer_args"].get("next_pass_id", 0))
+        for pass_id in range(first_pass, num_passes):
             event_handler(BeginPass(pass_id))
             pass_costs = []
             for batch_id, batch in enumerate(reader()):
@@ -112,6 +131,13 @@ class Trainer:
             event_handler(EndPass(pass_id, metrics={
                 "avg_cost": float(np.mean(pass_costs)) if pass_costs
                 else float("nan")}))
+            if checkpoint_dir is not None and \
+                    (pass_id + 1) % checkpoint_every_n_passes == 0:
+                io.save_checkpoint(
+                    self.exe, checkpoint_dir,
+                    main_program=self.main_program,
+                    trainer_args={"next_pass_id": pass_id + 1},
+                    max_keep=checkpoint_max_keep)
 
     def test(self, reader: Callable, feeder: Optional[DataFeeder] = None,
              fetch_list: Optional[Sequence] = None):

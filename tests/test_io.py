@@ -4,6 +4,7 @@ Reference analogues: save_load_op_test.cc, save_load_combine_op_test.cc,
 and the save/load_inference_model round-trip every book test performs
 (tests/book/test_fit_a_line.py:64-102 in the reference).
 """
+import os
 import numpy as np
 
 import paddle_tpu as fluid
@@ -96,3 +97,130 @@ def test_inference_model_roundtrip(tmp_path):
     assert feeds == ["x"]
     out, = exe.run(prog, feed={"x": x}, fetch_list=fetches, scope=scope2)
     np.testing.assert_allclose(ref, out, rtol=1e-6, atol=1e-7)
+
+
+class TestCheckpoint:
+    """Reference: go/pserver/service.go:120-203 checkpoint {uuid,md5,ts}
+    protocol; doc/design/cluster_train/checkpointing.md GC + atomic
+    publish."""
+
+    def _build(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    def test_save_load_roundtrip_with_meta(self, tmp_path):
+        import paddle_tpu.io as pio
+
+        main, startup, loss = self._build()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        feed = {"x": np.ones((8, 4), np.float32),
+                "y": np.zeros((8, 1), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        w_before = {
+            p.name: np.asarray(scope.find_var(p.name)).copy()
+            for p in main.global_block().all_parameters()
+        }
+        uuid = pio.save_checkpoint(
+            exe, str(tmp_path), main_program=main,
+            trainer_args={"next_pass_id": 5}, scope=scope)
+        assert uuid
+
+        scope2 = fluid.Scope()
+        exe.run(startup, scope=scope2)  # different random init
+        meta = pio.load_checkpoint(exe, str(tmp_path), main_program=main,
+                                   scope=scope2)
+        assert meta["trainer_args"]["next_pass_id"] == 5
+        assert meta["uuid"] == uuid
+        for name, w in w_before.items():
+            np.testing.assert_allclose(
+                np.asarray(scope2.find_var(name)), w)
+
+    def test_gc_keeps_max(self, tmp_path):
+        import paddle_tpu.io as pio
+
+        main, startup, loss = self._build()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        for i in range(5):
+            pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                trainer_args={"next_pass_id": i},
+                                scope=scope, max_keep=2)
+        dirs = [d for d in os.listdir(tmp_path)
+                if d.startswith(pio.CHECKPOINT_PREFIX)]
+        assert len(dirs) == 2
+        meta = pio.load_checkpoint(exe, str(tmp_path), main_program=main,
+                                   scope=scope)
+        assert meta["trainer_args"]["next_pass_id"] == 4  # newest wins
+
+    def test_corrupt_latest_falls_back(self, tmp_path):
+        import paddle_tpu.io as pio
+
+        main, startup, loss = self._build()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        u1 = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                 trainer_args={"next_pass_id": 1},
+                                 scope=scope)
+        u2 = pio.save_checkpoint(exe, str(tmp_path), main_program=main,
+                                 trainer_args={"next_pass_id": 2},
+                                 scope=scope)
+        # corrupt the newest snapshot's payload -> md5 mismatch
+        cp2 = os.path.join(tmp_path, f"{pio.CHECKPOINT_PREFIX}_{u2}")
+        victim = [f for f in os.listdir(cp2) if not f.startswith("__")][0]
+        with open(os.path.join(cp2, victim), "ab") as f:
+            f.write(b"garbage")
+        meta = pio.load_checkpoint(exe, str(tmp_path), main_program=main,
+                                   scope=scope)
+        assert meta["uuid"] == u1  # fell back to the older valid snapshot
+
+    def test_trainer_resume(self, tmp_path):
+        import paddle_tpu as fluid_mod
+        from paddle_tpu import trainer as trainer_mod
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+        opt = fluid_mod.SGD(learning_rate=0.1)
+        t = trainer_mod.Trainer(
+            loss, optimizer=opt, feed_list=[x, y],
+            main_program=main, startup_program=startup)
+        r = np.random.RandomState(0)
+        data = [(r.rand(4).astype(np.float32),
+                 r.rand(1).astype(np.float32)) for _ in range(16)]
+        passes_seen = []
+
+        def handler(e):
+            if isinstance(e, trainer_mod.BeginPass):
+                passes_seen.append(e.pass_id)
+
+        def reader():
+            yield data[:8]
+            yield data[8:]
+
+        t.train(3, reader, event_handler=handler,
+                checkpoint_dir=str(tmp_path))
+        assert passes_seen == [0, 1, 2]
+
+        # a "restarted" trainer resumes after the last completed pass
+        passes_seen.clear()
+        t2 = trainer_mod.Trainer(
+            loss, feed_list=[x, y],
+            main_program=main, startup_program=startup)
+        t2.train(5, reader, event_handler=handler,
+                 checkpoint_dir=str(tmp_path))
+        assert passes_seen == [3, 4]
