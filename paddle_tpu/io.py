@@ -284,7 +284,7 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
     with open(latest_tmp, "w") as f:
         f.write(cp_uuid)
     os.replace(latest_tmp, os.path.join(dirname, LATEST_FILENAME))
-    _gc_checkpoints(dirname, keep=max_keep)
+    _gc_checkpoints(dirname, keep=max_keep, always_keep={cp_uuid})
     return cp_uuid
 
 
@@ -304,18 +304,41 @@ def _checkpoints_by_time(dirname):
     return out
 
 
-def _gc_checkpoints(dirname, keep: int):
-    """Remove all but the newest `keep` complete snapshots, plus any
-    incomplete ones (stale-file GC, checkpointing.md)."""
-    import shutil
+# incomplete (meta-less) snapshots younger than this are assumed to be
+# another writer mid-save on shared storage and are left alone
+_GC_INCOMPLETE_GRACE_S = 30 * 60
 
+
+def _gc_checkpoints(dirname, keep: int, always_keep=()):
+    """Remove all but the newest `keep` complete snapshots, plus any *stale*
+    incomplete ones (stale-file GC, checkpointing.md).  Incomplete dirs with
+    recent mtime get a grace period — another host may be mid-save.
+    `always_keep` uuids survive regardless of timestamp ordering (guards
+    against wall-clock steps sorting the just-published snapshot oldest)."""
+    import shutil
+    import time
+
+    if keep < 0:
+        raise ValueError(f"max_keep must be >= 0, got {keep}")
     complete = _checkpoints_by_time(dirname)
-    keep_names = {name for _, name, _ in complete[-keep:]}
+    keep_names = ({name for _, name, _ in complete[-keep:]} if keep else
+                  set())
+    keep_names |= {f"{CHECKPOINT_PREFIX}_{u}" for u in always_keep}
+    complete_names = {name for _, name, _ in complete}
+    now = time.time()
     for name in os.listdir(dirname):
         if not name.startswith(CHECKPOINT_PREFIX + "_"):
             continue
-        if name not in keep_names:
-            shutil.rmtree(os.path.join(dirname, name), ignore_errors=True)
+        if name in keep_names:
+            continue
+        path = os.path.join(dirname, name)
+        if name not in complete_names:
+            try:
+                if now - os.path.getmtime(path) < _GC_INCOMPLETE_GRACE_S:
+                    continue  # possibly being written by another host
+            except OSError:
+                continue
+        shutil.rmtree(path, ignore_errors=True)
 
 
 def latest_checkpoint(dirname):

@@ -1,0 +1,9 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+The reference hand-writes CUDA for its hot paths (paddle/cuda/src/hl_*.cu,
+operators/math/*.cu); here XLA fusion covers most of that ground, and Pallas
+covers what fusion cannot: the attention inner loop (flash attention — the
+reference has no attention kernel at all, SURVEY.md §5.7) where materializing
+the [q, k] score matrix in HBM is the bandwidth bottleneck.
+"""
+from .flash_attention import flash_attention, flash_attention_reference  # noqa: F401

@@ -1,0 +1,356 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd, custom_vjp).
+
+The reference composes attention from primitive ops
+(/root/reference/python/paddle/v2/fluid/nets.py:162-219
+scaled_dot_product_attention: matmul -> softmax -> matmul), which
+materializes the [seq_q, seq_k] score matrix in main memory.  On TPU that
+matrix is the HBM-bandwidth bottleneck; this kernel keeps score tiles in
+VMEM and streams K/V blocks through the MXU with an online softmax, so HBM
+traffic is O(seq·d) instead of O(seq²).
+
+Layout: [batch, seq, heads, head_dim] (matches parallel/ring_attention.py).
+Internally folded to [batch·heads, seq, head_dim]; grid = (bh, q_blocks,
+k_blocks) with the k dimension innermost so the VMEM accumulator scratch
+persists across K/V blocks of one query tile.
+
+Backward is the standard flash recomputation: forward saves only the
+per-row logsumexp; dq / dk / dv are three more streaming kernels.
+
+Falls back to a plain XLA composition when shapes don't tile (seq not a
+multiple of the block) or no TPU is present and interpret mode is off.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["flash_attention", "flash_attention_reference"]
+
+NEG_INF = -1e30  # finite mask value: keeps exp()/max() NaN-free in-kernel
+DEFAULT_BLOCK = 128
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip K/V blocks strictly above the diagonal of this query tile
+        @pl.when(j * block_k <= i * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l_safe))[:, 0]
+
+
+def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k, nk=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, block_q, block_k, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(j * block_k <= i * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k, nq):
+    # grid = (bh, k_blocks, q_blocks): q innermost so dk/dv scratch persists
+    i, j = pl.program_id(1), pl.program_id(2)   # i: k block, j: q block
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][None, :]
+        delta = delta_ref[0][None, :]
+        # transposed tile: rows = k positions, cols = q positions
+        st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if causal:
+            krows = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            qcols = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(qcols >= krows, st, NEG_INF)
+        pt = jnp.exp(st - lse)
+        dv_acc[:] += jax.lax.dot_general(pt, do, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dst = pt * (dpt - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(dst, q, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        # a k block gets gradient only from q blocks at/below its diagonal
+        @pl.when(j * block_q + (block_q - 1) >= i * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+                interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]
+        if pltpu is not None else [],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper over [bh, seq, d]
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_pallas(q, k, v, o, lse, do, scale, causal,
+                       block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def flash_attention_reference(q, k, v, causal=False, scale=None):
+    """XLA-composed fallback / test oracle; layout [b, s, h, d]."""
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        ql, kl = q.shape[1], k.shape[1]
+        mask = jnp.arange(ql)[:, None] >= jnp.arange(kl)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
+                    interpret=None):
+    """Flash attention over [batch, seq, heads, head_dim] tensors.
+
+    Streams K/V through VMEM with online softmax (fwd) and recomputation
+    (bwd).  Falls back to the XLA composition when not on a TPU backend
+    (unless `interpret=True` asks for the pallas interpreter, e.g. tests)
+    or when the sequence doesn't tile onto MXU-aligned blocks.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    scale_v = float(d ** -0.5 if scale is None else scale)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            # emulating the grid loop on CPU/GPU is far slower than one
+            # fused XLA attention — only tests opt into interpret mode
+            return flash_attention_reference(q, k, v, causal, scale_v)
+        interp = False
+    else:
+        interp = interpret
+    tiles_ok = sq % block_q == 0 and sk % block_k == 0
+    if not interp:
+        # Mosaic lowering wants MXU-aligned tiles; route small/ragged
+        # shapes to the XLA composition instead of failing at jit time
+        tiles_ok = (tiles_ok and block_q % 128 == 0 and block_k % 128 == 0
+                    and d % 8 == 0)
+    if (pltpu is None or not tiles_ok
+            or k.shape != (b, sk, h, d) or v.shape != (b, sk, h, d)):
+        return flash_attention_reference(q, k, v, causal, scale_v)
+    fold = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, -1, d)
+    o = _flash(fold(q), fold(k), fold(v), scale_v, bool(causal),
+               block_q, block_k, interp)
+    return jnp.transpose(o.reshape(b, h, sq, d), (0, 2, 1, 3))

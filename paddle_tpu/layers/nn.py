@@ -14,6 +14,7 @@ __all__ = [
     "fc",
     "embedding",
     "dropout",
+    "flash_attention",
     "cross_entropy",
     "square_error_cost",
     "cos_sim",
@@ -123,6 +124,23 @@ def dropout(x, dropout_prob, is_test=False, seed=0, name=None):
                      {"dropout_prob": float(dropout_prob),
                       "is_test": is_test, "seed": seed,
                       "fix_seed": seed != 0})
+    return out
+
+
+def flash_attention(q, k, v, causal=False, scale=None, name=None):
+    """Fused attention over [batch, seq, heads, head_dim] tensors, lowered
+    to the Pallas flash-attention kernel (kernels/flash_attention.py).
+    No reference analogue — the reference composes attention from matmuls
+    (nets.py:162-219); this is the TPU-native hot path."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype)
+    out.shape = q.shape
+    helper.append_op("flash_attention",
+                     {"Q": [q.name], "K": [k.name], "V": [v.name]},
+                     {"Out": [out.name]},
+                     {"causal": bool(causal),
+                      "scale": 1.0 if scale is None else float(scale),
+                      "default_scale": scale is None})
     return out
 
 

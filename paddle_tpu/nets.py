@@ -65,17 +65,41 @@ def glu(input, dim=-1):
 
 def scaled_dot_product_attention(queries, keys, values,
                                  num_heads=1, dropout_rate=0.0):
-    """Composed attention (reference nets.py:162-219): matmul(Q,K^T)/sqrt(d)
-    -> softmax -> matmul with V.  Single-head, batch-major 3-D tensors."""
+    """Multi-head attention on [batch, seq, dim] tensors (reference
+    nets.py:162-219).  With no attention-weight dropout the hot path lowers
+    to the Pallas flash-attention kernel; with dropout it falls back to the
+    reference's matmul -> softmax -> dropout -> matmul composition."""
     import math
 
-    scaled_q = layers.scale(queries,
-                            scale=1.0 / math.sqrt(queries.shape[-1]))
-    product = layers.matmul(scaled_q, keys, transpose_y=True)
+    d_model = int(queries.shape[-1])
+    if num_heads < 1:
+        raise ValueError("num_heads must be >= 1")
+    if d_model % num_heads:
+        raise ValueError(
+            f"hidden size {d_model} not divisible by num_heads {num_heads}")
+    d_head = d_model // num_heads
+
+    def split_heads(x):
+        # [b, s, d] -> [b, s, h, d/h]
+        return layers.reshape(x, shape=[0, 0, num_heads, d_head])
+
+    if not dropout_rate:
+        out = layers.flash_attention(split_heads(queries),
+                                     split_heads(keys),
+                                     split_heads(values))
+        return layers.reshape(out, shape=[0, 0, d_model])
+
+    # composed fallback (weight dropout needs the materialized weights)
+    q = layers.transpose(split_heads(queries), axis=[0, 2, 1, 3])
+    k = layers.transpose(split_heads(keys), axis=[0, 2, 1, 3])
+    v = layers.transpose(split_heads(values), axis=[0, 2, 1, 3])
+    scaled_q = layers.scale(q, scale=1.0 / math.sqrt(d_head))
+    product = layers.matmul(scaled_q, k, transpose_y=True)
     weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    return layers.matmul(weights, values)
+    weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)                  # [b, h, s, d/h]
+    ctx = layers.transpose(ctx, axis=[0, 2, 1, 3])   # [b, s, h, d/h]
+    return layers.reshape(ctx, shape=[0, 0, d_model])
 
 
 def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,

@@ -1,0 +1,28 @@
+"""Attention op backed by the Pallas flash-attention kernel.
+
+The reference has no attention operator — attention is composed from
+matmul/softmax ops (/root/reference/python/paddle/v2/fluid/nets.py:162-219).
+The rebuild promotes it to a first-class op so the hot path runs the
+Pallas kernel (kernels/flash_attention.py) instead of materializing the
+score matrix; the generic-VJP grad machinery picks up the kernel's
+custom_vjp automatically.
+"""
+from __future__ import annotations
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+from ..kernels import flash_attention as _flash
+
+
+@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
+             attrs={"causal": False, "scale": 1.0, "default_scale": True})
+def flash_attention_op(ctx, ins, attrs):
+    """Q/K/V: [batch, seq, heads, head_dim].  default_scale=True ->
+    1/sqrt(head_dim); otherwise the explicit `scale` attr (0.0 included)."""
+    q = data_of(one(ins, "Q"))
+    k = data_of(one(ins, "K"))
+    v = data_of(one(ins, "V"))
+    scale = None if attrs.get("default_scale", True) else attrs["scale"]
+    out = _flash(q, k, v, causal=bool(attrs.get("causal", False)),
+                 scale=scale)
+    return {"Out": out}

@@ -99,7 +99,8 @@ class Trainer:
 
         With `checkpoint_dir`, resumes from the newest valid snapshot there
         (params + optimizer state + the pass counter travel in the snapshot
-        meta) and saves a snapshot every `checkpoint_every_n_passes` —
+        meta) and saves a snapshot every `checkpoint_every_n_passes`
+        (<= 0 disables saving) —
         the trainer-side analogue of the Go pserver's periodic checkpoint
         (go/pserver/service.go:120-203) and the book_distribute scripts'
         per-pass save."""
@@ -131,8 +132,8 @@ class Trainer:
             event_handler(EndPass(pass_id, metrics={
                 "avg_cost": float(np.mean(pass_costs)) if pass_costs
                 else float("nan")}))
-            if checkpoint_dir is not None and \
-                    (pass_id + 1) % checkpoint_every_n_passes == 0:
+            if checkpoint_dir is not None and checkpoint_every_n_passes > 0 \
+                    and (pass_id + 1) % checkpoint_every_n_passes == 0:
                 io.save_checkpoint(
                     self.exe, checkpoint_dir,
                     main_program=self.main_program,

@@ -1,0 +1,100 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU) + the
+framework op / layer / nets integration.
+
+Mirrors the reference's testing discipline for hand-written kernels: the
+composed XLA attention (flash_attention_reference) is the oracle, like
+Compare2Function CPU/GPU pairs (/root/reference/paddle/function/FunctionTest.h).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.kernels import flash_attention, flash_attention_reference
+
+
+def _rand_qkv(b=2, s=256, h=2, d=64, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = _rand_qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = flash_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    q, k, v = _rand_qkv(s=128)
+    w = jnp.cos(jnp.arange(q.shape[-1], dtype=jnp.float32))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * w)
+
+    fa = lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                         interpret=True)
+    g = jax.grad(loss(fa), (0, 1, 2))(q, k, v)
+    r = jax.grad(loss(lambda q, k, v: flash_attention_reference(
+        q, k, v, causal=causal)), (0, 1, 2))(q, k, v)
+    for got, want in zip(g, r):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_uneven_shapes_fall_back():
+    q, k, v = _rand_qkv(s=100)  # 100 % 128 != 0 -> XLA fallback
+    out = flash_attention(q, k, v)
+    ref = flash_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_layer_trains():
+    """End-to-end: the flash_attention op inside a Program, with backward."""
+    b, s, h, d = 2, 8, 2, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data(name="q", shape=[s, h, d], dtype="float32")
+        proj = fluid.layers.fc(input=fluid.layers.reshape(
+            q, shape=[0, s * h * d]), size=s * h * d)
+        qkv = fluid.layers.reshape(proj, shape=[0, s, h, d])
+        out = fluid.layers.flash_attention(qkv, qkv, qkv, causal=True)
+        loss = fluid.layers.mean(fluid.layers.square(out))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"q": np.random.RandomState(0).randn(b, s, h, d).astype("float32")}
+    losses = [float(np.asarray(
+        exe.run(main, feed=feed, fetch_list=[loss.name])[0]).ravel()[0])
+        for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_nets_multihead_attention():
+    """nets.scaled_dot_product_attention with heads == reference softmax
+    composition computed in numpy."""
+    b, s, dm, heads = 2, 8, 16, 4
+    x = np.random.RandomState(1).randn(b, s, dm).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data(name="x", shape=[s, dm], dtype="float32")
+        ctx = fluid.nets.scaled_dot_product_attention(inp, inp, inp,
+                                                      num_heads=heads)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run(main, feed={"x": x}, fetch_list=[ctx.name])[0]
+
+    xh = x.reshape(b, s, heads, dm // heads)
+    sc = np.einsum("bqhd,bkhd->bhqk", xh, xh) / np.sqrt(dm // heads)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, xh).reshape(b, s, dm)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
