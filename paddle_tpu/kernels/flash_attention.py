@@ -90,7 +90,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, :1] + jnp.log(l_safe))[:, 0]
+        # (block_q, 1) tile: trailing unit dim keeps the layout TPU-legal
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l_safe)
+
+
+def _kv_index_map(causal, block_q, block_k):
+    """K/V block index for grid step (b, i, j).
+
+    Causal: clamp j to the diagonal block of query tile i.  Steps above the
+    diagonal (compute skipped by pl.when) then repeat the previous block
+    index, and the Pallas pipeline skips the HBM->VMEM copy for a repeated
+    index — masked K/V tiles cost no bandwidth.
+    """
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    return lambda b, i, j: (
+        b, jnp.minimum(j, (i * block_q + (block_q - 1)) // block_k), 0)
 
 
 def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -99,21 +114,22 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
     nq, nk = sq // block_q, sk // block_k
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=block_q, block_k=block_k, nk=nk)
+    kv_map = _kv_index_map(causal, block_q, block_k)
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -142,8 +158,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]        # (block_q, 1)
+        delta = delta_ref[0]    # (block_q, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -187,8 +203,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][None, :]
-        delta = delta_ref[0][None, :]
+        lse = lse_ref[0]        # (1, block_q) — transposed layout
+        delta = delta_ref[0]    # (1, block_q)
         # transposed tile: rows = k positions, cols = q positions
         st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
@@ -226,19 +242,25 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lse arrives as (bh, sq, 1); delta gets the same trailing-unit layout,
+    # plus (bh, 1, sq) transposed copies for the dkv kernel's k-major tiles
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    lse_t = jnp.transpose(lse, (0, 2, 1))
+    delta_t = jnp.transpose(delta, (0, 2, 1))
 
+    kv_map = _kv_index_map(causal, block_q, block_k)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -247,17 +269,41 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    if causal:
+        # q blocks strictly below a k block's diagonal are masked; clamping
+        # their index repeats the previous block -> the pipeline skips the
+        # copy (mirror of _kv_index_map for the transposed iteration)
+        def _clamped(i, j):
+            # min() keeps the index in range when sk > sq (the last k
+            # blocks' diagonals lie past the final q block); out-of-range
+            # block indices are undefined behavior on Mosaic even for
+            # compute-masked steps
+            return jnp.minimum(jnp.maximum(j, (i * block_k) // block_q),
+                               nq - 1)
+
+        def q_map(b, i, j):
+            return (b, _clamped(i, j), 0)
+
+        def q_vec_map(b, i, j):
+            return (b, 0, _clamped(i, j))
+    else:
+        def q_map(b, i, j):
+            return (b, j, 0)
+
+        def q_vec_map(b, i, j):
+            return (b, 0, j)
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q), q_vec_map),
+            pl.BlockSpec((1, 1, block_q), q_vec_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -272,7 +318,7 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ] if pltpu is not None else [],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_t, delta_t)
     return dq, dk, dv
 
 
@@ -333,14 +379,12 @@ def flash_attention(q, k, v, causal=False, scale=None,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     scale_v = float(d ** -0.5 if scale is None else scale)
-    if interpret is None:
-        if jax.default_backend() != "tpu":
-            # emulating the grid loop on CPU/GPU is far slower than one
-            # fused XLA attention — only tests opt into interpret mode
-            return flash_attention_reference(q, k, v, causal, scale_v)
-        interp = False
-    else:
-        interp = interpret
+    interp = bool(interpret)
+    if not interp and jax.default_backend() != "tpu":
+        # Mosaic only lowers on TPU, and emulating the grid loop on CPU/GPU
+        # is far slower than one fused XLA attention — fall back unless the
+        # caller opted into the pallas interpreter (interpret=True, tests)
+        return flash_attention_reference(q, k, v, causal, scale_v)
     tiles_ok = sq % block_q == 0 and sk % block_k == 0
     if not interp:
         # Mosaic lowering wants MXU-aligned tiles; route small/ragged

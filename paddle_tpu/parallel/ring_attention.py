@@ -133,12 +133,8 @@ def all_to_all_attention(q, k, v, mesh: Mesh, axis: str = "sp",
 
 
 def attention_reference(q, k, v, causal=False, scale=None):
-    """Single-device reference for tests."""
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    if causal:
-        ql, kl = q.shape[1], k.shape[1]
-        mask = jnp.arange(ql)[:, None] >= jnp.arange(kl)[None, :]
-        s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    """Single-device reference for tests (one oracle for the whole tree:
+    delegates to kernels.flash_attention_reference)."""
+    from ..kernels.flash_attention import flash_attention_reference
+
+    return flash_attention_reference(q, k, v, causal=causal, scale=scale)

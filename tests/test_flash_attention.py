@@ -31,7 +31,9 @@ def test_forward_matches_reference(causal):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_gradients_match_reference(causal):
-    q, k, v = _rand_qkv(s=128)
+    # s=256 with block 128 -> 2x2 block grids: exercises cross-step scratch
+    # accumulation and the causal diagonal-skip paths in dq/dkv
+    q, k, v = _rand_qkv(s=256)
     w = jnp.cos(jnp.arange(q.shape[-1], dtype=jnp.float32))
 
     def loss(fn):
@@ -42,6 +44,26 @@ def test_gradients_match_reference(causal):
     g = jax.grad(loss(fa), (0, 1, 2))(q, k, v)
     r = jax.grad(loss(lambda q, k, v: flash_attention_reference(
         q, k, v, causal=causal)), (0, 1, 2))(q, k, v)
+    for got, want in zip(g, r):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_causal_cross_length_gradients():
+    """sk > sq with causal: the dkv q-block index clamp must stay in range
+    and gradients must match the reference."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 128, 2, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 256, 2, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 256, 2, 64).astype(np.float32))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=True)), (0, 1, 2))(q, k, v)
+    r = jax.grad(loss(lambda q, k, v: flash_attention_reference(
+        q, k, v, causal=True)), (0, 1, 2))(q, k, v)
     for got, want in zip(g, r):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
