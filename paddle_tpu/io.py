@@ -267,6 +267,8 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
     import time
     import uuid as uuid_mod
 
+    if max_keep < 0:
+        raise ValueError(f"max_keep must be >= 0, got {max_keep}")
     cp_uuid = uuid_mod.uuid4().hex
     cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
     os.makedirs(cp_dir, exist_ok=True)

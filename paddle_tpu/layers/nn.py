@@ -93,7 +93,9 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_bias = helper.create_tmp_variable(dtype)
         helper.append_op("sum", {"X": [v.name for v in mul_results]},
                          {"Out": [pre_bias.name]})
-    pre_act = helper.append_bias_op(pre_bias)
+    # bias covers only the projected dims (reference layers/nn.py:74 passes
+    # dim_start=num_flatten_dims) — a [size] bias, not [*batch_dims, size]
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
     return helper.append_activation(pre_act)
 
 

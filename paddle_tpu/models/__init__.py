@@ -1,3 +1,9 @@
 """Model zoo built on the layers DSL (reference book + benchmark models)."""
 from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
+from .transformer import (  # noqa: F401
+    transformer_decoder,
+    transformer_encoder,
+    transformer_lm,
+    transformer_translate,
+)
 from .vgg import vgg, vgg16_bn_drop  # noqa: F401

@@ -1,0 +1,171 @@
+"""Transformer model family (decoder-only LM + encoder-decoder translator).
+
+The reference predates the transformer as a packaged model: attention is
+composed from primitive ops (/root/reference/python/paddle/v2/fluid/nets.py:162-219
+scaled_dot_product_attention) and its NMT book model is a plain seq2seq
+without attention (/root/reference/python/paddle/v2/fluid/tests/book/
+test_machine_translation.py:54-121).  The rebuild promotes the transformer
+to a first-class model family because it is the TPU-native long-sequence
+answer to the reference's LoD/DynamicRNN machinery (SURVEY.md section 5.7):
+static shapes + masking, flash-attention Pallas kernel on the hot path
+(kernels/flash_attention.py), and ring/Ulysses sequence parallelism
+(parallel/ring_attention.py) for contexts that exceed one chip.
+
+All blocks are pre-LN (LN -> sublayer -> residual add), which keeps
+activations bounded for bf16 training on the MXU.
+"""
+from __future__ import annotations
+
+from .. import layers, nets
+from ..initializer import NormalInitializer
+
+__all__ = [
+    "multi_head_attention",
+    "positionwise_ffn",
+    "transformer_encoder",
+    "transformer_decoder",
+    "transformer_lm",
+    "transformer_translate",
+]
+
+
+def _proj(x, size, name=None):
+    """Linear projection over the feature axis of a [b, s, d] tensor."""
+    return layers.fc(input=x, size=size, num_flatten_dims=2,
+                     bias_attr=True, act=None, name=name)
+
+
+def multi_head_attention(queries, keys, values, d_model, n_heads,
+                         causal=False, dropout_rate=0.0, is_test=False):
+    """Projected multi-head attention on [b, s, d] tensors.
+
+    Projections + nets.scaled_dot_product_attention (which lowers to the
+    Pallas flash-attention kernel whenever there is no attention-weight
+    dropout); queries and keys/values may have different sequence lengths
+    (cross attention).
+    """
+    q = _proj(queries, d_model)
+    k = _proj(keys, d_model)
+    v = _proj(values, d_model)
+    ctx = nets.scaled_dot_product_attention(
+        q, k, v, num_heads=n_heads, dropout_rate=dropout_rate,
+        causal=causal, is_test=is_test)
+    return _proj(ctx, d_model)
+
+
+def positionwise_ffn(x, d_model, d_inner, dropout_rate=0.0, is_test=False):
+    hidden = layers.fc(input=x, size=d_inner, num_flatten_dims=2,
+                       act="relu")
+    if dropout_rate:
+        hidden = layers.dropout(hidden, dropout_prob=dropout_rate,
+                                is_test=is_test)
+    return layers.fc(input=hidden, size=d_model, num_flatten_dims=2)
+
+
+def _pre_ln(x):
+    return layers.layer_norm(x, begin_norm_axis=2)
+
+
+def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test):
+    """Token embedding + learned positional embedding.
+
+    ids: [b, s] int64.  Positions use a learned table sized to the static
+    sequence length (static shapes are the TPU answer to the reference's
+    LoD offsets — SURVEY.md section 5.7).
+    """
+    seq = int(ids.shape[1])
+    if seq > max_len:
+        raise ValueError(f"sequence length {seq} exceeds max_len {max_len}")
+    # no fixed param names: two models in one program must not silently
+    # share tables (Block.create_parameter overwrites same-named vars)
+    emb = layers.embedding(
+        ids, size=[vocab_size, d_model],
+        param_attr={"initializer": NormalInitializer(0.0, 0.02)})
+    # position table sized to max_len so checkpoints restore across
+    # sequence lengths; the current static length slices into it
+    pos_table = layers.create_parameter(
+        shape=[max_len, d_model], dtype=emb.dtype,
+        default_initializer=NormalInitializer(0.0, 0.02))
+    pos = layers.slice(pos_table, axes=[0], starts=[0], ends=[seq])
+    x = layers.elementwise_add(emb, pos, axis=1)
+    if dropout_rate:
+        x = layers.dropout(x, dropout_prob=dropout_rate, is_test=is_test)
+    return x
+
+
+def _encoder_block(x, d_model, n_heads, d_inner, dropout_rate, is_test):
+    ln_x = _pre_ln(x)
+    a = multi_head_attention(ln_x, ln_x, ln_x, d_model, n_heads,
+                             causal=False,
+                             dropout_rate=dropout_rate, is_test=is_test)
+    x = layers.elementwise_add(x, a)
+    f = positionwise_ffn(_pre_ln(x), d_model, d_inner, dropout_rate, is_test)
+    return layers.elementwise_add(x, f)
+
+
+def _decoder_block(x, enc_out, d_model, n_heads, d_inner, dropout_rate,
+                   is_test):
+    ln_x = _pre_ln(x)
+    a = multi_head_attention(ln_x, ln_x, ln_x, d_model, n_heads,
+                             causal=True, dropout_rate=dropout_rate,
+                             is_test=is_test)
+    x = layers.elementwise_add(x, a)
+    if enc_out is not None:
+        c = multi_head_attention(_pre_ln(x), enc_out, enc_out, d_model,
+                                 n_heads, causal=False,
+                                 dropout_rate=dropout_rate, is_test=is_test)
+        x = layers.elementwise_add(x, c)
+    f = positionwise_ffn(_pre_ln(x), d_model, d_inner, dropout_rate, is_test)
+    return layers.elementwise_add(x, f)
+
+
+def transformer_encoder(src_ids, vocab_size, d_model=256, n_heads=4,
+                        n_layers=2, d_inner=None, max_len=2048,
+                        dropout_rate=0.0, is_test=False):
+    """Bidirectional encoder over [b, s] token ids -> [b, s, d_model]."""
+    d_inner = d_inner or 4 * d_model
+    x = _embed(src_ids, vocab_size, d_model, max_len, dropout_rate,
+               is_test)
+    for _ in range(n_layers):
+        x = _encoder_block(x, d_model, n_heads, d_inner, dropout_rate,
+                           is_test)
+    return _pre_ln(x)
+
+
+def transformer_decoder(tgt_ids, enc_out, vocab_size, d_model=256,
+                        n_heads=4, n_layers=2, d_inner=None, max_len=2048,
+                        dropout_rate=0.0, is_test=False):
+    """Causal decoder ([b, t] ids, optional [b, s, d] memory) -> [b, t, d]."""
+    d_inner = d_inner or 4 * d_model
+    x = _embed(tgt_ids, vocab_size, d_model, max_len, dropout_rate,
+               is_test)
+    for _ in range(n_layers):
+        x = _decoder_block(x, enc_out, d_model, n_heads, d_inner,
+                           dropout_rate, is_test)
+    return _pre_ln(x)
+
+
+def transformer_lm(ids, vocab_size, d_model=256, n_heads=4, n_layers=2,
+                   d_inner=None, max_len=2048, dropout_rate=0.0,
+                   is_test=False):
+    """Decoder-only causal language model: [b, s] ids -> [b, s, vocab]
+    next-token softmax probabilities."""
+    h = transformer_decoder(ids, None, vocab_size, d_model, n_heads,
+                            n_layers, d_inner, max_len, dropout_rate,
+                            is_test)
+    logits = layers.fc(input=h, size=vocab_size, num_flatten_dims=2)
+    return layers.softmax(logits)
+
+
+def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
+                          d_model=256, n_heads=4, n_layers=2, d_inner=None,
+                          max_len=2048, dropout_rate=0.0, is_test=False):
+    """Encoder-decoder translation model -> [b, t, tgt_vocab] softmax."""
+    enc = transformer_encoder(src_ids, src_vocab, d_model, n_heads,
+                              n_layers, d_inner, max_len, dropout_rate,
+                              is_test)
+    dec = transformer_decoder(tgt_ids, enc, tgt_vocab, d_model, n_heads,
+                              n_layers, d_inner, max_len, dropout_rate,
+                              is_test)
+    logits = layers.fc(input=dec, size=tgt_vocab, num_flatten_dims=2)
+    return layers.softmax(logits)

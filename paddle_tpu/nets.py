@@ -64,12 +64,16 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(queries, keys, values,
-                                 num_heads=1, dropout_rate=0.0):
+                                 num_heads=1, dropout_rate=0.0,
+                                 causal=False, is_test=False):
     """Multi-head attention on [batch, seq, dim] tensors (reference
     nets.py:162-219).  With no attention-weight dropout the hot path lowers
     to the Pallas flash-attention kernel; with dropout it falls back to the
-    reference's matmul -> softmax -> dropout -> matmul composition."""
+    reference's matmul -> softmax -> dropout -> matmul composition.
+    `causal=True` masks future positions (decoder self-attention)."""
     import math
+
+    import numpy as np
 
     d_model = int(queries.shape[-1])
     if num_heads < 1:
@@ -83,10 +87,11 @@ def scaled_dot_product_attention(queries, keys, values,
         # [b, s, d] -> [b, s, h, d/h]
         return layers.reshape(x, shape=[0, 0, num_heads, d_head])
 
-    if not dropout_rate:
+    if not dropout_rate or is_test:
+        # at inference dropout is a no-op, so the fused kernel stays exact
         out = layers.flash_attention(split_heads(queries),
                                      split_heads(keys),
-                                     split_heads(values))
+                                     split_heads(values), causal=causal)
         return layers.reshape(out, shape=[0, 0, d_model])
 
     # composed fallback (weight dropout needs the materialized weights)
@@ -95,8 +100,15 @@ def scaled_dot_product_attention(queries, keys, values,
     v = layers.transpose(split_heads(values), axis=[0, 2, 1, 3])
     scaled_q = layers.scale(q, scale=1.0 / math.sqrt(d_head))
     product = layers.matmul(scaled_q, k, transpose_y=True)
+    if causal:
+        seq_q = int(queries.shape[1])
+        seq_k = int(keys.shape[1])
+        mask = np.triu(np.full((seq_q, seq_k), -1e9, dtype=np.float32), k=1)
+        product = layers.elementwise_add(product, layers.assign(mask),
+                                         axis=2)
     weights = layers.softmax(product)
-    weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                             is_test=is_test)
     ctx = layers.matmul(weights, v)                  # [b, h, s, d/h]
     ctx = layers.transpose(ctx, axis=[0, 2, 1, 3])   # [b, s, h, d/h]
     return layers.reshape(ctx, shape=[0, 0, d_model])

@@ -1,0 +1,138 @@
+"""Transformer model family tests (models/transformer.py).
+
+The reference has no transformer; these tests play the role its book
+suites play for the other model families (SURVEY.md section 4.2): tiny
+configs, synthetic data, convergence + save/restore-free forward checks.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.transformer import (
+    transformer_lm,
+    transformer_translate,
+)
+
+VOCAB = 16
+SEQ = 8
+
+
+def _next_token_batch(rng, batch):
+    ids = rng.randint(0, VOCAB, (batch, SEQ)).astype(np.int64)
+    labels = ((ids + 1) % VOCAB).reshape(batch * SEQ, 1)
+    return ids, labels
+
+
+def test_transformer_lm_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[SEQ], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        probs = transformer_lm(ids, VOCAB, d_model=32, n_heads=2,
+                               n_layers=2, max_len=SEQ)
+        flat = fluid.layers.reshape(probs, shape=[-1, VOCAB])
+        cost = fluid.layers.cross_entropy(input=flat, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    first = last = None
+    for _ in range(200):
+        ids_np, labels_np = _next_token_batch(rng, 32)
+        loss, = exe.run(main, feed={"ids": ids_np, "label": labels_np},
+                        fetch_list=[avg_cost])
+        if first is None:
+            first = float(loss[0])
+        last = float(loss[0])
+    assert last < 0.25, f"transformer LM did not converge: {first} -> {last}"
+
+
+def test_transformer_lm_causality():
+    """Changing a future token must not change earlier predictions."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[SEQ], dtype="int64")
+        probs = transformer_lm(ids, VOCAB, d_model=32, n_heads=2,
+                               n_layers=1, max_len=SEQ, is_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(1)
+    a = rng.randint(0, VOCAB, (1, SEQ)).astype(np.int64)
+    b = a.copy()
+    b[0, -1] = (b[0, -1] + 3) % VOCAB  # perturb only the last position
+    pa, = exe.run(main, feed={"ids": a}, fetch_list=[probs])
+    pb, = exe.run(main, feed={"ids": b}, fetch_list=[probs])
+    np.testing.assert_allclose(pa[0, :-1], pb[0, :-1], rtol=1e-5, atol=1e-5)
+    assert np.abs(pa[0, -1] - pb[0, -1]).max() > 1e-6
+
+
+def test_fc_bias_shape_with_flatten_dims():
+    """fc(num_flatten_dims=2) must create a [size] bias, not [seq, size]
+    (reference layers/nn.py:74 passes dim_start=num_flatten_dims)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5, 8], dtype="float32")
+        fluid.layers.fc(input=x, size=16, num_flatten_dims=2)
+    bias_params = [p for p in main.global_block().all_parameters()
+                   if ".b_" in p.name]
+    assert len(bias_params) == 1
+    assert list(bias_params[0].shape) == [16], bias_params[0].shape
+
+
+def test_transformer_lm_dropout_path_is_causal():
+    """dropout_rate>0 takes the composed (materialized-weights) fallback;
+    its explicit causal mask must match the flash path's causality."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[SEQ], dtype="int64")
+        probs = transformer_lm(ids, VOCAB, d_model=32, n_heads=2,
+                               n_layers=1, max_len=SEQ, dropout_rate=0.1,
+                               is_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    a = rng.randint(0, VOCAB, (1, SEQ)).astype(np.int64)
+    b = a.copy()
+    b[0, -1] = (b[0, -1] + 5) % VOCAB
+    pa, = exe.run(main, feed={"ids": a}, fetch_list=[probs])
+    pb, = exe.run(main, feed={"ids": b}, fetch_list=[probs])
+    np.testing.assert_allclose(pa[0, :-1], pb[0, :-1], rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_translate_trains():
+    src_len, tgt_len = 6, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[src_len], dtype="int64")
+        tgt = fluid.layers.data(name="tgt", shape=[tgt_len], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        probs = transformer_translate(src, tgt, VOCAB, VOCAB, d_model=32,
+                                      n_heads=2, n_layers=1,
+                                      max_len=max(src_len, tgt_len))
+        flat = fluid.layers.reshape(probs, shape=[-1, VOCAB])
+        cost = fluid.layers.cross_entropy(input=flat, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=0.02).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(2)
+    # copy task: target = first tgt_len tokens of source
+    losses = []
+    for _ in range(120):
+        s = rng.randint(0, VOCAB, (16, src_len)).astype(np.int64)
+        t = s[:, :tgt_len]
+        lab = t.reshape(-1, 1)
+        loss, = exe.run(main, feed={"src": s, "tgt": t, "label": lab},
+                        fetch_list=[avg_cost])
+        losses.append(float(loss[0]))
+    assert losses[-1] < losses[0], (
+        f"translate loss did not improve: {losses[0]} -> {losses[-1]}")
+    # cross-attention copy task should get well below chance
+    assert losses[-1] < 1.5, f"translate loss too high: {losses[-1]}"
