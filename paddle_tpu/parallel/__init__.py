@@ -1,6 +1,6 @@
 """Parallelism package: mesh, SPMD ParallelExecutor, collectives,
-ring/Ulysses attention, sharded embedding (SURVEY.md §2.5/§5.8 rebuilt as
-ICI-native XLA collectives)."""
+ring/Ulysses attention, sharded embedding, GPipe pipeline (SURVEY.md
+§2.5/§5.8 rebuilt as ICI-native XLA collectives)."""
 from . import collective  # noqa: F401  (registers c_* ops)
 from .collective import (  # noqa: F401
     shard_embedding_table,
@@ -16,6 +16,12 @@ from .mesh import (  # noqa: F401
     init_distributed,
     make_mesh,
     replicated,
+)
+from .pipeline import (  # noqa: F401
+    microbatch,
+    spmd_pipeline,
+    stack_stage_params,
+    unmicrobatch,
 )
 from .ring_attention import (  # noqa: F401
     all_to_all_attention,

@@ -1,0 +1,106 @@
+"""SPMD pipeline parallelism (GPipe schedule) over a 'pp' mesh axis.
+
+The reference's only pipeline-ish facility is per-layer device placement in
+the legacy engine (ParallelNeuralNetwork,
+/root/reference/paddle/gserver/gradientmachines/ParallelNeuralNetwork.h —
+layers annotated with deviceId run on different GPUs).  The TPU rebuild
+expresses the same capability the XLA way: every pipeline stage runs the
+SAME traced computation under `shard_map`, each device holds only its
+stage's parameters (a stacked pytree sharded on the leading axis), and
+activations hop stage->stage with one `lax.ppermute` (one ICI hop) per
+schedule tick.  The whole schedule is written with `lax.scan`, so JAX's
+autodiff derives the reverse (backward) pipeline automatically — no
+hand-written 1F1B bookkeeping.
+
+Constraints (documented, checked): every stage maps activations of one
+fixed shape to the same shape — put embedding/classifier layers outside
+the pipelined trunk (the usual GPipe decomposition).  Bubble fraction is
+(pp-1)/(n_micro+pp-1), so use n_micro >= ~4*pp for real runs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["spmd_pipeline", "stack_stage_params", "microbatch",
+           "unmicrobatch"]
+
+
+def stack_stage_params(per_stage: Sequence[Any]):
+    """Stack a list of per-stage parameter pytrees along a new leading
+    axis (to be sharded over the pp mesh axis)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage)
+
+
+def microbatch(x, n_micro: int):
+    """[batch, ...] -> [n_micro, batch/n_micro, ...]"""
+    if x.shape[0] % n_micro:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by n_micro {n_micro}")
+    return x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
+
+
+def unmicrobatch(y):
+    """[n_micro, mb, ...] -> [n_micro*mb, ...]"""
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
+                  axis: str = "pp"):
+    """Run `stage_fn` as a `pp`-stage GPipe pipeline.
+
+    stage_fn:     (params, activation[mb, ...]) -> activation[mb, ...]
+                  (same callable for every stage; per-stage behavior comes
+                  from the per-stage params)
+    stage_params: pytree whose leaves are stacked [pp, ...] per-stage
+                  parameters (see stack_stage_params)
+    x:            [n_micro, mb, ...] microbatched input (see microbatch)
+    returns:      [n_micro, mb, ...] last-stage outputs, replicated.
+
+    Differentiable end-to-end: grad through this function yields the
+    reverse pipeline schedule, with per-stage param grads sharded exactly
+    like the params.
+    """
+    pp = mesh.shape[axis]
+    n_micro = x.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != pp:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pipeline "
+                f"axis size {pp}: one stacked stage per '{axis}' device "
+                "(a mismatch would silently drop stages)")
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P())
+    def _run(params_blk, xs):
+        stage = jax.lax.axis_index(axis)
+        params_local = jax.tree_util.tree_map(lambda p: p[0], params_blk)
+        # pad the input stream with pp-1 drain ticks
+        pad = jnp.zeros((pp - 1,) + xs.shape[1:], xs.dtype)
+        stream = jnp.concatenate([xs, pad], axis=0)
+        state0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        state0 = jax.lax.pcast(state0, (axis,), to="varying")
+
+        def tick(state, xt):
+            # stage 0 ingests from the stream; others from the neighbor
+            inp = jnp.where(stage == 0, xt, state)
+            out = stage_fn(params_local, inp)
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % pp) for i in range(pp)])
+            return nxt, out
+
+        _, ys = jax.lax.scan(tick, state0, stream)
+        # only the last stage's emissions are real outputs; psum over the
+        # (otherwise-zero) mask replicates them to every stage
+        mask = (stage == pp - 1).astype(ys.dtype)
+        ys = jax.lax.psum(ys * mask, axis)
+        return jax.lax.dynamic_slice_in_dim(ys, pp - 1, n_micro, axis=0)
+
+    return _run(stage_params, x)
