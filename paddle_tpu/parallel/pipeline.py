@@ -51,7 +51,7 @@ def unmicrobatch(y):
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
-                  axis: str = "pp"):
+                  axis: str = "pp", batch_axis: str | None = None):
     """Run `stage_fn` as a `pp`-stage GPipe pipeline.
 
     stage_fn:     (params, activation[mb, ...]) -> activation[mb, ...]
@@ -60,11 +60,18 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
     stage_params: pytree whose leaves are stacked [pp, ...] per-stage
                   parameters (see stack_stage_params)
     x:            [n_micro, mb, ...] microbatched input (see microbatch)
-    returns:      [n_micro, mb, ...] last-stage outputs, replicated.
+    batch_axis:   optional mesh axis to shard the microbatch dim over
+                  (dp x pp composition: each dp replica pipelines its own
+                  batch shard; param grads psum over dp automatically in
+                  shard_map's backward)
+    returns:      [n_micro, mb, ...] last-stage outputs (sharded over
+                  `batch_axis` if given, otherwise replicated).
 
     Differentiable end-to-end: grad through this function yields the
     reverse pipeline schedule, with per-stage param grads sharded exactly
-    like the params.
+    like the params.  During the pp-1 fill/drain bubble ticks stages run
+    on recirculated real microbatch data (never synthetic zeros), so a
+    stage_fn that divides by activation statistics stays NaN-free.
     """
     pp = mesh.shape[axis]
     n_micro = x.shape[0]
@@ -74,18 +81,20 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                 f"stage_params leading dim {leaf.shape[0]} != pipeline "
                 f"axis size {pp}: one stacked stage per '{axis}' device "
                 "(a mismatch would silently drop stages)")
+    x_spec = P(None, batch_axis) if batch_axis else P()
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P())
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec)
     def _run(params_blk, xs):
         stage = jax.lax.axis_index(axis)
         params_local = jax.tree_util.tree_map(lambda p: p[0], params_blk)
-        # pad the input stream with pp-1 drain ticks
-        pad = jnp.zeros((pp - 1,) + xs.shape[1:], xs.dtype)
+        # drain ticks recirculate real data (see docstring); their outputs
+        # are sliced away below
+        pad = jnp.broadcast_to(xs[:1], (pp - 1,) + xs.shape[1:])
         stream = jnp.concatenate([xs, pad], axis=0)
-        state0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        state0 = jax.lax.stop_gradient(xs[0])
         state0 = jax.lax.pcast(state0, (axis,), to="varying")
 
         def tick(state, xt):
@@ -97,10 +106,11 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
             return nxt, out
 
         _, ys = jax.lax.scan(tick, state0, stream)
-        # only the last stage's emissions are real outputs; psum over the
-        # (otherwise-zero) mask replicates them to every stage
+        # keep only the last stage's real emissions (drop the pp-1 warm-up
+        # ticks BEFORE the psum so bubble outputs never cross the ICI),
+        # then psum over the (otherwise-zero) mask to replicate them
+        ys = jax.lax.slice_in_dim(ys, pp - 1, pp - 1 + n_micro, axis=0)
         mask = (stage == pp - 1).astype(ys.dtype)
-        ys = jax.lax.psum(ys * mask, axis)
-        return jax.lax.dynamic_slice_in_dim(ys, pp - 1, n_micro, axis=0)
+        return jax.lax.psum(ys * mask, axis)
 
     return _run(stage_params, x)
