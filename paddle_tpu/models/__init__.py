@@ -1,4 +1,5 @@
 """Model zoo built on the layers DSL (reference book + benchmark models)."""
+from .ctr import deepfm, wide_deep  # noqa: F401
 from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
 from .transformer import (  # noqa: F401
     transformer_decoder,
