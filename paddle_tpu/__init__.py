@@ -11,6 +11,7 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
 """
 from . import (  # noqa: F401
     clip,
+    debugger,
     evaluator,
     initializer,
     io,
@@ -55,5 +56,6 @@ from .optimizer import (  # noqa: F401
     RMSProp,
 )
 from .data_feeder import DataFeeder  # noqa: F401
+from .memory_optimization_transpiler import memory_optimize  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,153 @@
+"""Memory-optimization transpiler: liveness-based variable reuse.
+
+Mirror of the reference's
+/root/reference/python/paddle/v2/fluid/memory_optimization_transpiler.py
+(ControlFlowGraph :33, dataflow analysis :90): walk the program, compute
+per-op live sets, and rename each newly-defined temporary onto a dead
+variable of identical shape+dtype, so consecutive ops reuse buffers
+instead of growing the scope.
+
+TPU-native framing: for XLA-compiled blocks buffer reuse already happens
+inside the compiler, so the win here is the op-by-op CPU interpreter path
+(debugging, host-side programs) and the scope footprint between runs —
+a renamed-over var is overwritten in the interpreter env, dropping the
+old buffer's last reference.  Semantics are unchanged either way; this is
+the rebuild's analogue of the reference's "memory_optimize then train"
+book tests (tests/book_memory_optimization/).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core.framework import Parameter, Program
+
+__all__ = ["ControlFlowGraph", "memory_optimize"]
+
+
+class ControlFlowGraph:
+    """Def/use + liveness over one straight-line block (reference :33).
+
+    live_out[i] = union of live_in of successors (straight line: i+1);
+    live_in[i]  = use[i] | (live_out[i] - def[i]).
+    """
+
+    def __init__(self, ops):
+        self.ops = list(ops)
+        n = len(self.ops)
+        self.uses: List[Set[str]] = [set() for _ in range(n)]
+        self.defs: List[Set[str]] = [set() for _ in range(n)]
+        for i, op in enumerate(self.ops):
+            for names in op.inputs.values():
+                self.uses[i].update(n_ for n_ in names if n_)
+            for names in op.outputs.values():
+                self.defs[i].update(n_ for n_ in names if n_)
+        self.live_in: List[Set[str]] = [set() for _ in range(n)]
+        self.live_out: List[Set[str]] = [set() for _ in range(n)]
+        self._dataflow()
+
+    def _dataflow(self):
+        for i in range(len(self.ops) - 1, -1, -1):
+            self.live_out[i] = (set(self.live_in[i + 1])
+                                if i + 1 < len(self.ops) else set())
+            self.live_in[i] = self.uses[i] | (self.live_out[i]
+                                              - self.defs[i])
+
+
+def _sub_block_names(program: Program) -> Set[str]:
+    """All names referenced anywhere in non-global blocks: sub-blocks
+    resolve names against the parent scope, so renaming them is unsafe."""
+    names: Set[str] = set()
+    for block in program.blocks[1:]:
+        names.update(block.vars.keys())
+        for op in block.ops:
+            for ns in op.inputs.values():
+                names.update(ns)
+            for ns in op.outputs.values():
+                names.update(ns)
+    return names
+
+
+def memory_optimize(program: Program,
+                    skip_vars: Optional[Sequence] = None,
+                    level: int = 0) -> int:
+    """Rewrite `program` in place so dead temporaries are reused; returns
+    the number of variables eliminated.
+
+    skip_vars: names (or Variables) never to optimize — pass everything
+    you intend to fetch after the final op (same contract as the
+    reference: fetch targets must survive to the end of the run).
+    level=0 requires exact shape+dtype match for reuse (reference
+    memory_optimization_transpiler.py level semantics).
+    """
+    del level  # only exact-match (level 0) reuse is implemented
+    block = program.global_block()
+    if isinstance(skip_vars, str) or not hasattr(skip_vars or [],
+                                                 "__iter__"):
+        skip_vars = [skip_vars]  # a bare name/Variable, not a collection
+    skip: Set[str] = set()
+    for v in skip_vars or []:
+        skip.add(v if isinstance(v, str) else v.name)
+    skip |= _sub_block_names(program)
+
+    cfg = ControlFlowGraph(block.ops)
+    n = len(cfg.ops)
+
+    # a name's buffer is finished once past its last def AND last use
+    last_touch: Dict[str, int] = {}
+    defined: Set[str] = set()
+    for i in range(n):
+        for name in cfg.uses[i] | cfg.defs[i]:
+            last_touch[name] = i
+        defined |= cfg.defs[i]
+
+    def eligible(name: str) -> bool:
+        if name in skip or name not in defined or not block.has_var(name):
+            return False
+        v = block.var(name)
+        if isinstance(v, Parameter) or getattr(v, "persistable", False):
+            return False
+        if v.shape is None or v.dtype is None:
+            return False
+        return True
+
+    def key_of(name):
+        v = block.var(name)
+        return tuple(v.shape), str(v.dtype)
+
+    pool: List[str] = []          # finished var names, buffers reusable
+    rename: Dict[str, str] = {}   # original name -> reused name
+    eliminated = 0
+
+    for i, op in enumerate(cfg.ops):
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(nm, nm) for nm in names]
+
+        for slot, names in op.outputs.items():
+            out = []
+            for name in names:
+                if name in rename:
+                    out.append(rename[name])
+                    continue
+                if eligible(name):
+                    for cand in pool:
+                        if key_of(cand) == key_of(name):
+                            pool.remove(cand)
+                            rename[name] = cand
+                            block.vars.pop(name, None)
+                            eliminated += 1
+                            name = cand
+                            break
+                out.append(name)
+            op.outputs[slot] = out
+
+        # buffers finished at this op become reusable for later ops (for a
+        # renamed var the reuse target carries the buffer, so check THAT)
+        for name in cfg.uses[i] | cfg.defs[i]:
+            if last_touch.get(name) != i:
+                continue
+            cur = rename.get(name, name)
+            if eligible(cur) and cur not in pool:
+                pool.append(cur)
+
+    program.bump_version()
+    return eliminated
