@@ -1,7 +1,8 @@
 """Dataset package with the reference's `paddle.v2.dataset` surface.
 
 Reference: /root/reference/python/paddle/v2/dataset/ (uci_housing, mnist,
-cifar, imdb, imikolov, movielens, conll05, wmt14, sentiment, ...).
+cifar, imdb, imikolov, movielens, conll05, wmt14, wmt16, sentiment,
+flowers, voc2012, mq2007).
 
 This environment has no network egress, so each module serves DETERMINISTIC
 SYNTHETIC data with the same schema (shapes/dtypes/vocab accessors) as the
@@ -11,23 +12,31 @@ swap in real data by pointing the loaders at files with the same layout.
 from . import (  # noqa: F401
     cifar,
     conll05,
+    flowers,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
     sentiment,
     uci_housing,
+    voc2012,
     wmt14,
+    wmt16,
 )
 
 __all__ = [
     "uci_housing",
     "mnist",
     "cifar",
+    "flowers",
+    "voc2012",
     "imdb",
     "imikolov",
     "movielens",
+    "mq2007",
     "conll05",
     "wmt14",
+    "wmt16",
     "sentiment",
 ]

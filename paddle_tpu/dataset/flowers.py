@@ -1,0 +1,55 @@
+"""flowers: 102-category Oxford flowers surface — (3x224x224 float image,
+int label).
+
+Reference: /root/reference/python/paddle/v2/dataset/flowers.py
+(train/test/valid readers over the tarball + mapper pipeline).  Synthetic
+(zero-egress) class-template images with per-sample noise, same reader
+contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached, fixed_rng
+
+__all__ = ["train", "test", "valid"]
+
+_CLASSES = 102
+_IMG = 3 * 224 * 224
+_N = {"train": 512, "test": 128, "valid": 128}
+
+
+@cached
+def _templates():
+    r = fixed_rng("flowers")
+    # low-res class templates upsampled: keeps memory small but images
+    # class-separable like the real data
+    small = r.randn(_CLASSES, 3, 8, 8).astype(np.float32)
+    return small
+
+
+def _reader(tag, mapper=None):
+    def reader():
+        t = _templates()
+        r = fixed_rng(f"flowers/{tag}")
+        for _ in range(_N[tag]):
+            label = int(r.randint(0, _CLASSES))
+            img = np.kron(t[label], np.ones((28, 28), np.float32))
+            img = img + 0.3 * r.randn(3, 224, 224).astype(np.float32)
+            sample = (np.clip(img, -2.0, 2.0).astype(np.float32).ravel(),
+                      label)
+            yield mapper(sample) if mapper is not None else sample
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("train", mapper)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("test", mapper)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid", mapper)

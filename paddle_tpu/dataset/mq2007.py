@@ -1,0 +1,69 @@
+"""mq2007: LETOR learning-to-rank surface — pointwise / pairwise /
+listwise readers over 46-dim query-document feature vectors.
+
+Reference: /root/reference/python/paddle/v2/dataset/mq2007.py (gen_point,
+gen_pair, gen_list over Query/QueryList records).  Synthetic
+(zero-egress): per-query documents whose relevance (0-2) correlates with
+a known weight vector, so rankers have learnable signal.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached, fixed_rng
+
+__all__ = ["train", "test"]
+
+NDIM = 46
+_N_QUERY = {"train": 120, "test": 30}
+_DOCS_PER_QUERY = 8
+
+
+@cached
+def _weights():
+    return fixed_rng("mq2007/w").randn(NDIM).astype(np.float32)
+
+
+def _queries(tag):
+    r = fixed_rng(f"mq2007/{tag}")
+    w = _weights()
+    out = []
+    for _ in range(_N_QUERY[tag]):
+        feats = r.randn(_DOCS_PER_QUERY, NDIM).astype(np.float32)
+        score = feats @ w + 0.25 * r.randn(_DOCS_PER_QUERY)
+        rel = np.digitize(score, np.percentile(score, [50, 80]))
+        out.append((feats, rel.astype(np.int64)))
+    return out
+
+
+def _reader(tag, format):
+    def pointwise():
+        for feats, rel in _queries(tag):
+            for f, y in zip(feats, rel):
+                yield f, int(y)
+
+    def pairwise():
+        for feats, rel in _queries(tag):
+            for i in range(len(rel)):
+                for j in range(len(rel)):
+                    if rel[i] > rel[j]:
+                        yield feats[i], feats[j]
+
+    def listwise():
+        for feats, rel in _queries(tag):
+            yield feats, rel
+
+    table = {"pointwise": pointwise, "pairwise": pairwise,
+             "listwise": listwise}
+    if format not in table:
+        raise ValueError(f"format must be one of {sorted(table)}, "
+                         f"got {format!r}")
+    return table[format]
+
+
+def train(format="pairwise"):
+    return _reader("train", format)
+
+
+def test(format="pairwise"):
+    return _reader("test", format)

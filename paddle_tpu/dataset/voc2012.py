@@ -1,0 +1,51 @@
+"""voc2012: segmentation surface — (3xHxW float image, HxW int mask).
+
+Reference: /root/reference/python/paddle/v2/dataset/voc2012.py
+(train/test/val readers yielding image + per-pixel label).  Synthetic
+(zero-egress): blocky masks with 21 classes (20 objects + background),
+images correlated with their mask so segmentation is learnable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixed_rng
+
+__all__ = ["train", "test", "val"]
+
+_CLASSES = 21
+_H = _W = 64
+_N = {"train": 256, "test": 64, "val": 64}
+
+
+def _sample(r):
+    mask = np.zeros((_H, _W), np.int64)
+    for _ in range(int(r.randint(1, 4))):
+        c = int(r.randint(1, _CLASSES))
+        y0, x0 = r.randint(0, _H // 2, 2)
+        h, w = r.randint(_H // 8, _H // 2, 2)
+        mask[y0:y0 + h, x0:x0 + w] = c
+    img = (mask[None, :, :] / float(_CLASSES)
+           + 0.1 * r.randn(3, _H, _W)).astype(np.float32)
+    return img, mask
+
+
+def _reader(tag):
+    def reader():
+        r = fixed_rng(f"voc2012/{tag}")
+        for _ in range(_N[tag]):
+            yield _sample(r)
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def val():
+    return _reader("val")

@@ -1,0 +1,73 @@
+"""wmt16: Multi30k-style en<->de translation surface — (src_ids,
+trg_ids, trg_ids_next) with <s>/<e>/<unk> conventions.
+
+Reference: /root/reference/python/paddle/v2/dataset/wmt16.py
+(train/test/validation parameterized by dict sizes + get_dict).
+Synthetic (zero-egress): source sentences are random token streams and
+the "translation" is a deterministic per-token mapping with a length
+change, so seq2seq models can learn it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixed_rng
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+_N = {"train": 2048, "test": 256, "validation": 256}
+
+# special ids, reference wmt16.py: <s>=0, <e>=1, <unk>=2
+START_ID, END_ID, UNK_ID = 0, 1, 2
+_RESERVED = 3
+
+
+def _clip_size(n):
+    return max(int(n), _RESERVED + 2)
+
+
+def _translate(tokens, trg_dict_size):
+    # deterministic affine token mapping into the target vocab
+    return [(_RESERVED + (7 * t + 3) % (trg_dict_size - _RESERVED))
+            for t in tokens]
+
+
+def _reader(tag, src_dict_size, trg_dict_size, src_lang):
+    src_dict_size = _clip_size(src_dict_size)
+    trg_dict_size = _clip_size(trg_dict_size)
+
+    def reader():
+        r = fixed_rng(f"wmt16/{tag}/{src_lang}")
+        for _ in range(_N[tag]):
+            n = int(r.randint(3, 12))
+            src = r.randint(_RESERVED, src_dict_size, n).tolist()
+            trg = _translate(src, trg_dict_size)
+            src_ids = [START_ID] + src + [END_ID]
+            trg_ids = [START_ID] + trg
+            trg_next = trg + [END_ID]
+            yield src_ids, trg_ids, trg_next
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("train", src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("test", src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("validation", src_dict_size, trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """id<->token table; synthetic tokens are '<lang>_<id>'."""
+    dict_size = _clip_size(dict_size)
+    words = {START_ID: "<s>", END_ID: "<e>", UNK_ID: "<unk>"}
+    for i in range(_RESERVED, dict_size):
+        words[i] = f"{lang}_{i}"
+    if reverse:
+        return {w: i for i, w in words.items()}
+    return words

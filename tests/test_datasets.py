@@ -1,0 +1,73 @@
+"""Dataset package schema tests: every module yields the reference's
+record shapes/dtypes deterministically (SURVEY.md §2.8 v2/dataset row)."""
+import numpy as np
+
+from paddle_tpu import dataset
+
+
+def _take(reader, n=3):
+    out = []
+    for i, rec in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(rec)
+    return out
+
+
+def test_flowers_schema():
+    img, label = _take(dataset.flowers.train())[0]
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+    assert 0 <= label < 102
+    assert _take(dataset.flowers.test()) and _take(dataset.flowers.valid())
+
+
+def test_flowers_mapper_applied():
+    small = _take(dataset.flowers.train(
+        mapper=lambda s: (s[0][:12], s[1])))[0]
+    assert small[0].shape == (12,)
+
+
+def test_voc2012_schema():
+    img, mask = _take(dataset.voc2012.train())[0]
+    assert img.shape[0] == 3 and img.dtype == np.float32
+    assert mask.shape == img.shape[1:] and mask.dtype == np.int64
+    assert mask.max() < 21
+    assert _take(dataset.voc2012.val())
+
+
+def test_mq2007_formats():
+    f, y = _take(dataset.mq2007.train(format="pointwise"))[0]
+    assert f.shape == (dataset.mq2007.NDIM,) and isinstance(y, int)
+    a, b = _take(dataset.mq2007.train(format="pairwise"))[0]
+    assert a.shape == b.shape == (dataset.mq2007.NDIM,)
+    feats, rels = _take(dataset.mq2007.train(format="listwise"))[0]
+    assert feats.shape[0] == rels.shape[0]
+    try:
+        dataset.mq2007.train(format="bogus")
+        raise AssertionError("bad format accepted")
+    except ValueError:
+        pass
+
+
+def test_wmt16_schema_and_dict():
+    recs = _take(dataset.wmt16.train(50, 60), n=5)
+    for src, trg, nxt in recs:
+        assert src[0] == dataset.wmt16.START_ID
+        assert src[-1] == dataset.wmt16.END_ID
+        assert trg[0] == dataset.wmt16.START_ID
+        assert nxt[-1] == dataset.wmt16.END_ID
+        assert len(trg) == len(nxt)
+        assert max(trg) < 60 and max(src) < 50
+    d = dataset.wmt16.get_dict("en", 50)
+    assert d[0] == "<s>" and len(d) == 50
+    rd = dataset.wmt16.get_dict("en", 50, reverse=True)
+    assert rd["<s>"] == 0
+
+
+def test_determinism():
+    a = _take(dataset.wmt16.train(50, 60), n=2)
+    b = _take(dataset.wmt16.train(50, 60), n=2)
+    assert a == b
+    fa, la = _take(dataset.flowers.train())[0]
+    fb, lb = _take(dataset.flowers.train())[0]
+    assert la == lb and np.array_equal(fa, fb)
