@@ -1,0 +1,92 @@
+"""Python side of the C inference API (native/src/capi.cc).
+
+The reference ships a pure-C inference ABI
+(/root/reference/paddle/capi/gradient_machine.h:
+paddle_gradient_machine_create_for_inference_with_parameters + forward)
+so C/C++/mobile hosts can embed trained models.  The TPU rebuild keeps the
+C ABI but the engine behind it is this module: the .so embeds CPython,
+loads the saved inference model (fluid.io.load_inference_model) and runs
+it through the normal executor (XLA-compiled; CPU by default for embedded
+hosts, TPU when PADDLE_TPU_CAPI_PLACE=tpu).
+
+Handles are tracked in a registry keyed by integer id so the C side never
+owns Python object lifetimes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["create", "feed", "run", "fetch", "destroy"]
+
+_sessions: Dict[int, "InferenceSession"] = {}
+_next_id = 1
+_lock = threading.Lock()
+
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32}
+
+
+class InferenceSession:
+    def __init__(self, model_dir: str):
+        import paddle_tpu as fluid
+
+        place = (fluid.TPUPlace()
+                 if os.environ.get("PADDLE_TPU_CAPI_PLACE") == "tpu"
+                 else fluid.CPUPlace())
+        self.exe = fluid.Executor(place)
+        self.scope = fluid.Scope()
+        (self.program, self.feed_names,
+         self.fetch_vars) = fluid.io.load_inference_model(
+            model_dir, self.exe, scope=self.scope)
+        self.feeds: Dict[str, np.ndarray] = {}
+        self.results = []
+
+    def feed(self, name: str, payload: bytes, dtype_code: int, dims):
+        arr = np.frombuffer(payload, dtype=_DTYPES[dtype_code])
+        self.feeds[name] = arr.reshape([int(d) for d in dims]).copy()
+
+    def run(self) -> int:
+        missing = [n for n in self.feed_names if n not in self.feeds]
+        if missing:
+            raise ValueError(f"missing feeds: {missing}")
+        self.results = [
+            np.asarray(r) for r in self.exe.run(
+                self.program, feed=dict(self.feeds),
+                fetch_list=self.fetch_vars, scope=self.scope)
+        ]
+        return len(self.results)
+
+    def fetch(self, idx: int):
+        r = np.ascontiguousarray(self.results[idx], dtype=np.float32)
+        return r.tobytes(), list(r.shape)
+
+
+def create(model_dir: str) -> int:
+    global _next_id
+    s = InferenceSession(model_dir)
+    with _lock:
+        sid = _next_id
+        _next_id += 1
+        _sessions[sid] = s
+    return sid
+
+
+def feed(sid: int, name: str, payload: bytes, dtype_code: int,
+         dims) -> None:
+    _sessions[sid].feed(name, payload, dtype_code, dims)
+
+
+def run(sid: int) -> int:
+    return _sessions[sid].run()
+
+
+def fetch(sid: int, idx: int):
+    return _sessions[sid].fetch(idx)
+
+
+def destroy(sid: int) -> None:
+    with _lock:
+        _sessions.pop(sid, None)

@@ -1,0 +1,102 @@
+"""C inference ABI test (native/_capi.so + capi_runtime.py).
+
+Reference analogue: /root/reference/paddle/capi/tests and
+capi/examples/model_inference — host apps embed a trained model through a
+pure-C surface.  Here we exercise the exact extern-C entry points through
+ctypes from the live interpreter (the .so detects Py_IsInitialized and
+reuses it), asserting the C-path results match the direct executor.
+"""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+SO = os.path.join(os.path.dirname(fluid.__file__), "native", "_capi.so")
+
+
+def _build_so():
+    if not os.path.exists(SO):
+        subprocess.run(["make", "_capi.so"], check=True,
+                       cwd=os.path.dirname(SO))
+
+
+def _save_tiny_model(dirname):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        hidden = fluid.layers.fc(input=x, size=8, act="relu")
+        out = fluid.layers.fc(input=hidden, size=3, act="softmax")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    fluid.io.save_inference_model(dirname, ["x"], [out], exe,
+                                  main_program=main, scope=scope)
+    return main, scope, out
+
+
+def test_capi_inference_matches_executor(tmp_path):
+    _build_so()
+    model_dir = str(tmp_path / "model")
+    main, scope, out = _save_tiny_model(model_dir)
+
+    lib = ctypes.CDLL(SO)
+    lib.paddle_tpu_inference_create.restype = ctypes.c_int64
+    lib.paddle_tpu_inference_create.argtypes = [ctypes.c_char_p]
+    lib.paddle_tpu_inference_feed.restype = ctypes.c_int
+    lib.paddle_tpu_inference_feed.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+    lib.paddle_tpu_inference_run.restype = ctypes.c_int
+    lib.paddle_tpu_inference_run.argtypes = [ctypes.c_int64]
+    lib.paddle_tpu_inference_fetch.restype = ctypes.c_int64
+    lib.paddle_tpu_inference_fetch.argtypes = [
+        ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.paddle_tpu_inference_destroy.restype = ctypes.c_int
+    lib.paddle_tpu_inference_destroy.argtypes = [ctypes.c_int64]
+    lib.paddle_tpu_last_error.restype = ctypes.c_char_p
+
+    sid = lib.paddle_tpu_inference_create(model_dir.encode())
+    assert sid > 0, lib.paddle_tpu_last_error().decode()
+
+    x = np.random.RandomState(7).rand(2, 4).astype(np.float32)
+    dims = (ctypes.c_int64 * 2)(2, 4)
+    rc = lib.paddle_tpu_inference_feed(
+        sid, b"x", x.ctypes.data_as(ctypes.c_void_p), dims, 2, 0)
+    assert rc == 0, lib.paddle_tpu_last_error().decode()
+
+    nout = lib.paddle_tpu_inference_run(sid)
+    assert nout == 1, lib.paddle_tpu_last_error().decode()
+
+    buf = (ctypes.c_float * 64)()
+    odims = (ctypes.c_int64 * 8)()
+    ondim = ctypes.c_int()
+    count = lib.paddle_tpu_inference_fetch(sid, 0, buf, 64, odims,
+                                           ctypes.byref(ondim))
+    assert count == 6, lib.paddle_tpu_last_error().decode()
+    assert ondim.value == 2 and list(odims[:2]) == [2, 3]
+    got = np.ctypeslib.as_array(buf)[:6].reshape(2, 3)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    want = np.asarray(
+        exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    assert lib.paddle_tpu_inference_destroy(sid) == 0
+
+
+def test_capi_error_reporting(tmp_path):
+    _build_so()
+    lib = ctypes.CDLL(SO)
+    lib.paddle_tpu_inference_create.restype = ctypes.c_int64
+    lib.paddle_tpu_inference_create.argtypes = [ctypes.c_char_p]
+    lib.paddle_tpu_last_error.restype = ctypes.c_char_p
+    sid = lib.paddle_tpu_inference_create(
+        str(tmp_path / "does_not_exist").encode())
+    assert sid == 0
+    assert lib.paddle_tpu_last_error()
