@@ -13,11 +13,13 @@ from . import (  # noqa: F401
     clip,
     debugger,
     evaluator,
+    image,
     initializer,
     io,
     layers,
     learning_rate_decay,
     nets,
+    plot,
     regularizer,
 )
 from .clip import (  # noqa: F401
@@ -57,5 +59,9 @@ from .optimizer import (  # noqa: F401
 )
 from .data_feeder import DataFeeder  # noqa: F401
 from .memory_optimization_transpiler import memory_optimize  # noqa: F401
+from .parallel.executor import (  # noqa: F401
+    DistributeTranspiler,
+    ParallelExecutor,
+)
 
 __version__ = "0.1.0"

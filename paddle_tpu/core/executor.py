@@ -36,6 +36,20 @@ from .lod import LoDTensor
 from .scope import Scope
 
 
+def _dp_replicated_sharding(ops):
+    """If any op in `ops` is a parallel_do, a replicated NamedSharding over
+    its device mesh (so jitted inputs land on the full device set);
+    else None."""
+    n = 0
+    for op in ops:
+        if op.type == "parallel_do":
+            n = max(n, int(op.attrs.get("num_places", 1)))
+    if n == 0:
+        return None
+    from ..parallel.mesh import make_mesh, replicated
+    return replicated(make_mesh({"dp": min(n, len(jax.devices()))}))
+
+
 def _run_op_instrumented(ctx, op, env):
     """run_op + optional profiling (reference executor.cc:124 RecordEvent)
     and nan/inf scanning (executor.cc:132-140 FLAGS_check_nan_inf).
@@ -208,7 +222,13 @@ class Executor:
         )
         self._step += 1
 
-        if compiled:
+        if compiled and self._has_host_ops(block):
+            # host ops can't be jit-traced: "compiled" here means compile
+            # the maximal device segments between them
+            outs = self._run_segmented(
+                program, block, scope, feed, fetch_names, step_key
+            )
+        elif compiled:
             try:
                 outs = self._run_compiled(
                     program, block, scope, feed, fetch_names, step_key
@@ -348,7 +368,11 @@ class Executor:
                     run_op(seg_ctx, op, seg_env)
                 return {n: seg_env.d[n] for n in seg_env.written
                         if n in seg_env.d}
-            fn = jax.jit(fn)
+            repl = _dp_replicated_sharding(ops)
+            if repl is not None:
+                fn = jax.jit(fn, in_shardings=(repl, repl))
+            else:
+                fn = jax.jit(fn)
             self._cache[cache_key] = fn
         from paddle_tpu import profiler
 
@@ -453,6 +477,14 @@ class Executor:
             return fetches, state_out
 
         # donate read-write state buffers: in-place param updates on device
+        repl = _dp_replicated_sharding(block.ops)
+        if repl is not None:
+            # a parallel_do op constrains values to a multi-device mesh:
+            # land every input replicated on that device set so the
+            # partitioner may shard the annotated subgraph (single-device
+            # committed args would conflict with the mesh)
+            return jax.jit(fn, donate_argnums=(2,),
+                           in_shardings=(repl, repl, repl, repl))
         return jax.jit(fn, donate_argnums=(2,))
 
 

@@ -23,6 +23,11 @@ __all__ = [
     "While",
     "StaticRNN",
     "DynamicRNN",
+    "IfElse",
+    "ParallelDo",
+    "get_places",
+    "split_lod_tensor",
+    "merge_lod_tensor",
     "less_than",
     "equal",
     "increment",
@@ -220,6 +225,227 @@ def beam_search_decode(ids, scores):
 # ---------------------------------------------------------------------------
 # While
 # ---------------------------------------------------------------------------
+
+
+def get_places(device_count=None, device_type=None):
+    """Materialize the device list (reference layers/device.py get_places /
+    get_places_op.cc).  Returns jax devices rather than a Places variable —
+    on a TPU mesh "places" are mesh coordinates, not program state."""
+    from ..parallel.mesh import get_places as _mesh_places
+    del device_type  # single accelerator type per process in jax
+    return _mesh_places(device_count)
+
+
+class ParallelDo:
+    """Single-host data parallelism over a block (reference ParallelDo /
+    parallel_do_op.cc:113).
+
+    The reference splits the batch into per-place scopes and runs the block
+    on worker threads, summing partial grads back to place 0.  Here the
+    construct lowers to one `parallel_do` op that annotates its inputs with
+    a batch sharding over a 'dp' device mesh and traces the block inline —
+    XLA partitions forward AND backward across devices (the thread pool,
+    scope copies, and AccumulateGrad sum all disappear into the partitioner).
+
+    Usage (reference test_parallel_op.py shape):
+        places = layers.get_places()
+        pd = layers.ParallelDo(places)
+        with pd.do():
+            x_ = pd.read_input(x)
+            hidden = layers.fc(input=x_, size=n)
+            pd.write_output(hidden)
+        out = pd()
+    """
+
+    def __init__(self, places, use_nccl=False, name=None):
+        self.helper = LayerHelper("parallel_do", name=name)
+        self.places = list(places)
+        self.use_nccl = use_nccl
+        self.sub = None
+        self.parent = None
+        self._inputs = []   # (parent var, placeholder)
+        self._outputs = []  # sub-block vars
+        self._result_vars = None
+        self._finalized = False
+
+    @contextlib.contextmanager
+    def do(self):
+        program = self.helper.main_program
+        self.parent = program.current_block
+        self.sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        self._finalize()
+
+    def read_input(self, var):
+        assert self.sub is not None, "read_input must be called in do()"
+        ph = self.sub.create_var(
+            name=unique_name("pdo_in"), shape=var.shape, dtype=var.dtype)
+        self._inputs.append((var, ph))
+        return ph
+
+    def write_output(self, var):
+        self._outputs.append(var)
+
+    def __call__(self):
+        assert self._finalized, "use `with pd.do():` before pd()"
+        if len(self._result_vars) == 1:
+            return self._result_vars[0]
+        return list(self._result_vars)
+
+    def _captured_names(self):
+        local = set(self.sub.vars.keys())
+        captured = []
+        for op in self.sub.ops:
+            for n in op.input_names():
+                if n in ("", "@EMPTY@") or n in local or n in captured:
+                    continue
+                if self.parent.has_var(n):
+                    captured.append(n)
+        return captured
+
+    def _finalize(self):
+        assert self._outputs, "parallel_do block must write_output"
+        cap_f, cap_i = [], []
+        for n in self._captured_names():
+            v = self.parent.var(n)
+            if v.dtype is not None and is_float_dtype(v.dtype):
+                cap_f.append(n)
+            else:
+                cap_i.append(n)
+        out_vars = [
+            self.parent.create_var(name=unique_name("pdo_out"),
+                                   shape=ov.shape, dtype=ov.dtype)
+            for ov in self._outputs
+        ]
+        self.parent.append_op(
+            "parallel_do",
+            {"Inputs": [x.name for x, _ in self._inputs],
+             "Captured": cap_f,
+             "CapturedNoGrad": cap_i},
+            {"Outs": [v.name for v in out_vars]},
+            {"sub_block": {"__block__": self.sub.idx},
+             "use_nccl": self.use_nccl,
+             "num_places": len(self.places),
+             "input_names": [p.name for _, p in self._inputs],
+             "output_names": [v.name for v in self._outputs]})
+        self._result_vars = out_vars
+        self._finalized = True
+
+
+def split_lod_tensor(input, mask, level=0):
+    """Split `input` rows (or level-`level` sequences) into the true/false
+    branches selected by the bool column `mask` (reference
+    layers.split_lod_tensor / split_lod_tensor_op.cc)."""
+    helper = LayerHelper("split_lod_tensor")
+    out_true = helper.create_tmp_variable(dtype=input.dtype)
+    out_false = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        "split_lod_tensor",
+        {"X": [input.name], "Mask": [mask.name]},
+        {"OutTrue": [out_true.name], "OutFalse": [out_false.name]},
+        {"level": level})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Inverse of split_lod_tensor: interleave the branches back into `x`'s
+    row order (reference layers.merge_lod_tensor)."""
+    helper = LayerHelper("merge_lod_tensor")
+    out = helper.create_tmp_variable(dtype=in_true.dtype)
+    helper.append_op(
+        "merge_lod_tensor",
+        {"X": [x.name], "Mask": [mask.name],
+         "InTrue": [in_true.name], "InFalse": [in_false.name]},
+        {"Out": [out.name]},
+        {"level": level})
+    return out
+
+
+class IfElse:
+    """Batch-row conditional (reference layers.IfElse): rows where `cond`
+    is true flow through the true block, the rest through the false block,
+    and outputs are merged back into batch order.
+
+    TPU-native design note: the reference wraps each branch in a
+    ConditionalBlock sub-block; here branch ops are appended to the current
+    block operating directly on the split row-subsets (a branch with zero
+    selected rows simply computes on 0-row tensors).  That keeps the whole
+    construct differentiable through split/merge grads and lets branch ops
+    run in compiled segments keyed by the realized shapes."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError("cond must be a Variable")
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.input_table = {}
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.output_table = ([], [])  # (false_outs, true_outs)
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside a block")
+        if id(x) not in self.input_table:
+            self.input_table[id(x)] = split_lod_tensor(x, self.cond)
+        out_true, out_false = self.input_table[id(x)]
+        return (out_true if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                else out_false)
+
+    @contextlib.contextmanager
+    def _block(self, is_true):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("cannot nest IfElse blocks")
+        self.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if is_true
+                       else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+        try:
+            yield
+        finally:
+            self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        if len(self.output_table[1 if is_true else 0]) == 0:
+            raise ValueError("must call output() inside the block")
+
+    def true_block(self):
+        return self._block(True)
+
+    def false_block(self):
+        return self._block(False)
+
+    def output(self, *outs):
+        if self.status == self.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output() can only be called inside a block")
+        table = self.output_table[
+            1 if self.status == self.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        from .tensor import assign
+        for each in outs:
+            if not isinstance(each, Variable):
+                raise TypeError("each output must be a Variable")
+            table.append(assign(each))
+
+    def __call__(self):
+        if self.status != self.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("__call__ must be outside the blocks")
+        false_len, true_len = map(len, self.output_table)
+        if false_len == 0 and true_len == 0:
+            raise ValueError("must call true_block/false_block before "
+                             "__call__")
+        if false_len != true_len and false_len != 0 and true_len != 0:
+            raise ValueError("true/false blocks must set the same number "
+                             "of outputs")
+        if false_len == 0 or true_len == 0:
+            return self.output_table[0 if false_len != 0 else 1]
+        rlist = []
+        for false_var, true_var in zip(*self.output_table):
+            rlist.append(merge_lod_tensor(
+                in_true=true_var, in_false=false_var,
+                x=self.cond, mask=self.cond))
+        return rlist
 
 
 class While:

@@ -1,12 +1,15 @@
-"""Data-declaration layer.
+"""Data-declaration and distributed-IO layers.
 
-Reference: /root/reference/python/paddle/v2/fluid/layers/io.py (`data()`).
+Reference: /root/reference/python/paddle/v2/fluid/layers/io.py (`data()`,
+`ListenAndServ`, `Send`, `Recv`).
 """
 from __future__ import annotations
 
+import contextlib
+
 from ..core.framework import default_main_program, default_startup_program
 
-__all__ = ["data"]
+__all__ = ["data", "ListenAndServ", "Send", "Recv"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -26,3 +29,64 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     # mirror the var desc into the startup program for symmetry
     default_startup_program()
     return v
+
+
+class ListenAndServ:
+    """Pserver-side block: serve variables, run the block as the optimize
+    program after `fan_in` barriers (reference layers/io.py ListenAndServ /
+    listen_and_serv_op.cc).
+
+    Usage:
+        serv = ListenAndServ("127.0.0.1:6174", fan_in=1)
+        with serv.do():
+            ...optimize ops on served vars...
+        exe.run(main)   # blocks serving until a client sends STOP
+    """
+
+    def __init__(self, endpoint, inputs=None, fan_in=1, optimizer_mode=True):
+        self.endpoint = endpoint
+        self.fan_in = fan_in
+        self.inputs = inputs or []
+        del optimizer_mode  # reference flag; the block is always the program
+        self.sub = None
+
+    @contextlib.contextmanager
+    def do(self):
+        program = default_main_program()
+        parent = program.current_block
+        self.sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        parent.append_op(
+            "listen_and_serv",
+            {"X": [v.name for v in self.inputs]},
+            {},
+            {"sub_block": {"__block__": self.sub.idx},
+             "endpoint": self.endpoint,
+             "Fanin": self.fan_in})
+
+
+def Send(endpoint, send_vars, get_vars):
+    """Push `send_vars`, barrier, pull `get_vars` (reference layers Send /
+    send_op.cc:44)."""
+    helper_block = default_main_program().current_block
+    helper_block.append_op(
+        "send",
+        {"X": [v.name for v in send_vars]},
+        {"Out": [v.name for v in get_vars]},
+        {"endpoints": [endpoint],
+         "epmap": [endpoint] * len(send_vars)})
+    return get_vars
+
+
+def Recv(endpoint, get_vars):
+    """Fetch `get_vars` from `endpoint` (reference recv_op.cc:28)."""
+    block = default_main_program().current_block
+    block.append_op(
+        "recv",
+        {"X": []},
+        {"Out": [v.name for v in get_vars]},
+        {"endpoint": endpoint})
+    return get_vars

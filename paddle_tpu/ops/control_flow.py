@@ -97,6 +97,129 @@ def conditional_block(ctx, ins, attrs):
 
 
 # ---------------------------------------------------------------------------
+# split/merge by mask — the IfElse machinery (reference
+# split_lod_tensor_op.cc, merge_lod_tensor_op.cc).  Host ops: the mask is
+# data-dependent so row counts are only known at run time; ops downstream of
+# the split run inside compiled segments keyed by the realized shapes.
+# ---------------------------------------------------------------------------
+
+
+def _mask_bools(mask) -> np.ndarray:
+    return np.asarray(data_of(mask)).reshape(-1).astype(bool)
+
+
+def _branch_rows(xv, m: np.ndarray, level: int):
+    """-> (true_rows, false_rows, true_lens, false_lens); lens are None for
+    dense inputs.  For LoD inputs the mask entries select whole level-`level`
+    sequences (reference split_lod_tensor_op.cc CopyTensorAndLod)."""
+    if isinstance(xv, LoDTensor) and xv.lod:
+        lod = xv.lod[level]
+        if len(m) != len(lod) - 1:
+            raise ValueError(
+                f"split_lod_tensor: mask has {len(m)} entries but input has "
+                f"{len(lod) - 1} level-{level} sequences")
+        t_rows, f_rows, t_lens, f_lens = [], [], [], []
+        for s, take in enumerate(m):
+            rows = range(lod[s], lod[s + 1])
+            if take:
+                t_rows.extend(rows)
+                t_lens.append(len(rows))
+            else:
+                f_rows.extend(rows)
+                f_lens.append(len(rows))
+        return t_rows, f_rows, t_lens, f_lens
+    n = np.asarray(data_of(xv)).shape[0]
+    if len(m) != n:
+        raise ValueError(
+            f"split_lod_tensor: mask has {len(m)} entries for {n} rows")
+    idx = np.arange(n)
+    return idx[m].tolist(), idx[~m].tolist(), None, None
+
+
+def _branch_out(xv, x: np.ndarray, rows, lens):
+    out = jnp.asarray(x[rows] if rows else
+                      np.zeros((0,) + x.shape[1:], x.dtype))
+    if lens is None:
+        return out
+    return LoDTensor(out, [lod_from_seq_lens(lens)])
+
+
+@register_op("split_lod_tensor", inputs=("X", "Mask"),
+             outputs=("OutTrue", "OutFalse"),
+             attrs={"level": 0}, diff_inputs=("X",), host=True)
+def split_lod_tensor(ctx, ins, attrs):
+    xv = one(ins, "X")
+    m = _mask_bools(one(ins, "Mask"))
+    t_rows, f_rows, t_lens, f_lens = _branch_rows(xv, m, attrs["level"])
+    x = np.asarray(data_of(xv))
+    return {"OutTrue": _branch_out(xv, x, t_rows, t_lens),
+            "OutFalse": _branch_out(xv, x, f_rows, f_lens)}
+
+
+@register_op("split_lod_tensor_grad",
+             inputs=("X", "Mask", "OutTrue@GRAD", "OutFalse@GRAD"),
+             outputs=("X@GRAD",), attrs={"level": 0}, host=True)
+def split_lod_tensor_grad(ctx, ins, attrs):
+    """Scatter the branch grads back to the original rows."""
+    xv = one(ins, "X")
+    m = _mask_bools(one(ins, "Mask"))
+    t_rows, f_rows, _, _ = _branch_rows(xv, m, attrs["level"])
+    x = np.asarray(data_of(xv))
+    gx = np.zeros(x.shape, x.dtype)
+    gt = many(ins, "OutTrue@GRAD")
+    gf = many(ins, "OutFalse@GRAD")
+    if gt and t_rows:
+        gx[t_rows] = np.asarray(data_of(gt[0])).reshape(
+            (len(t_rows),) + x.shape[1:])
+    if gf and f_rows:
+        gx[f_rows] = np.asarray(data_of(gf[0])).reshape(
+            (len(f_rows),) + x.shape[1:])
+    out = jnp.asarray(gx)
+    if isinstance(xv, LoDTensor) and xv.lod:
+        out = LoDTensor(out, xv.lod)
+    return {"X@GRAD": out}
+
+
+@register_op("merge_lod_tensor", inputs=("X", "Mask", "InTrue", "InFalse"),
+             outputs=("Out",), attrs={"level": 0},
+             diff_inputs=("InTrue", "InFalse"), host=True)
+def merge_lod_tensor(ctx, ins, attrs):
+    """Interleave the two branches back into X's sequence order (reference
+    merge_lod_tensor_op.cc).  X supplies the LoD frame the split used."""
+    xv = one(ins, "X")
+    m = _mask_bools(one(ins, "Mask"))
+    t_rows, f_rows, t_lens, f_lens = _branch_rows(xv, m, attrs["level"])
+    tv, fv = one(ins, "InTrue"), one(ins, "InFalse")
+    t = np.asarray(data_of(tv))
+    f = np.asarray(data_of(fv))
+    feat = t.shape[1:] if t.size or not f.size else f.shape[1:]
+    n = len(t_rows) + len(f_rows)
+    out = np.zeros((n,) + feat, t.dtype if t.size or not f.size else f.dtype)
+    if len(t_rows):
+        out[t_rows] = t.reshape((len(t_rows),) + feat)
+    if len(f_rows):
+        out[f_rows] = f.reshape((len(f_rows),) + feat)
+    res = jnp.asarray(out)
+    if isinstance(xv, LoDTensor) and xv.lod:
+        res = LoDTensor(res, xv.lod)
+    return {"Out": res}
+
+
+@register_op("merge_lod_tensor_grad",
+             inputs=("X", "Mask", "InTrue", "InFalse", "Out@GRAD"),
+             outputs=("InTrue@GRAD", "InFalse@GRAD"),
+             attrs={"level": 0}, host=True)
+def merge_lod_tensor_grad(ctx, ins, attrs):
+    """Split the merged grad back into the two branch grads."""
+    xv = one(ins, "X")
+    m = _mask_bools(one(ins, "Mask"))
+    t_rows, f_rows, t_lens, f_lens = _branch_rows(xv, m, attrs["level"])
+    g = np.asarray(data_of(one(ins, "Out@GRAD")))
+    return {"InTrue@GRAD": _branch_out(xv, g, t_rows, t_lens),
+            "InFalse@GRAD": _branch_out(xv, g, f_rows, f_lens)}
+
+
+# ---------------------------------------------------------------------------
 # tensor arrays (reference tensor_array_read_write_op.cc)
 # ---------------------------------------------------------------------------
 
@@ -397,6 +520,62 @@ def _make_sentence(node):
         scores.append(node[1])
         node = node[2]
     return words[::-1], scores[::-1]
+
+
+# ---------------------------------------------------------------------------
+# parallel_do — single-host data parallelism (reference parallel_do_op.cc:113)
+#
+# The reference splits the batch into per-place scopes, runs the sub-block on
+# worker threads, and sums partial grads back to place 0 (:249-267).  Here
+# data parallelism is a *sharding annotation*: inputs get a
+# with_sharding_constraint over a 'dp' device mesh, the sub-block is traced
+# inline, and XLA partitions the whole computation (compute AND the generic
+# VJP backward) across devices — no threads, no scope copies, grads arrive
+# pre-summed by XLA's partitioner.
+# ---------------------------------------------------------------------------
+
+
+def _dp_shardings(num_places: int):
+    """(batch-sharded, replicated) NamedShardings over a 'dp' mesh built
+    by the shared parallel.mesh helpers (one mesh-construction path
+    framework-wide)."""
+    from ..parallel.mesh import data_sharding, make_mesh, replicated
+    mesh = make_mesh({"dp": num_places})
+    return data_sharding(mesh), replicated(mesh)
+
+
+def _dp_constrain(d, row_shard, repl, num_places):
+    if d.ndim >= 1 and d.shape[0] % num_places == 0:
+        return jax.lax.with_sharding_constraint(d, row_shard)
+    return jax.lax.with_sharding_constraint(d, repl)
+
+
+@register_op(
+    "parallel_do",
+    inputs=("Inputs", "Captured", "CapturedNoGrad"),
+    outputs=("Outs",),
+    attrs={"use_nccl": False},
+    diff_inputs=("Inputs", "Captured"),
+    diff_outputs=("Outs",))
+def parallel_do(ctx, ins, attrs):
+    in_vals = many(ins, "Inputs")
+    cap_vals = many(ins, "Captured")
+    capng_vals = many(ins, "CapturedNoGrad")
+    num_places = min(attrs["num_places"], len(jax.devices()))
+    row_shard, repl = _dp_shardings(num_places)
+
+    env = _ChainEnv({}, {})
+    env.outer = dict(zip(ctx.op.input("Captured"), cap_vals))
+    env.outer.update(zip(ctx.op.input("CapturedNoGrad"), capng_vals))
+    for name, v in zip(attrs["input_names"], in_vals):
+        env.set(name, _dp_constrain(data_of(v), row_shard, repl,
+                                    num_places))
+    sub = ctx.op.sub_block()
+    for op_ in sub.ops:
+        run_op(ctx, op_, env)
+    outs = [_dp_constrain(data_of(env.get(n)), row_shard, repl, num_places)
+            for n in attrs["output_names"]]
+    return {"Outs": outs}
 
 
 # ---------------------------------------------------------------------------

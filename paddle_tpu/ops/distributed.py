@@ -1,0 +1,101 @@
+"""Distributed variable-transfer ops: send / recv / listen_and_serv.
+
+Reference: /root/reference/paddle/fluid/operators/send_op.cc:44-94,
+recv_op.cc:28-53, listen_and_serv_op.cc:56-185.  Host ops over the
+parallel.pserver TCP transport (the gRPC layer's stand-in).  The
+TPU-recommended data-parallel path remains psum over the mesh; these ops
+serve the reference's multi-process pserver workflow and host-side
+variable transfer.
+"""
+from __future__ import annotations
+
+from ..core.execution import data_of, many, one
+from ..core.registry import register_op
+
+_CLIENTS = {}
+
+
+def _client(endpoint: str):
+    from ..parallel.pserver import VariableClient
+
+    c = _CLIENTS.get(endpoint)
+    if c is None:
+        c = VariableClient(endpoint)
+        _CLIENTS[endpoint] = c
+    return c
+
+
+def reset_clients():
+    for c in _CLIENTS.values():
+        c.close()
+    _CLIENTS.clear()
+
+
+@register_op("send", inputs=("X",), outputs=("Out",),
+             attrs={"endpoints": [], "epmap": []},
+             not_differentiable=True, host=True)
+def send(ctx, ins, attrs):
+    """Push grads to their endpoints, barrier, pull updated params
+    (send_op.cc:44-94: AsyncSendVariable / SendBatchBarrier /
+    AsyncGetVariable)."""
+    xs = many(ins, "X")
+    in_names = ctx.op.input("X")
+    out_names = ctx.op.output("Out")
+    epmap = attrs["epmap"] or [attrs["endpoints"][0]] * len(in_names)
+    for name, val, ep in zip(in_names, xs, epmap):
+        _client(ep).send_var(name, data_of(val))
+    for ep in sorted(set(epmap)):
+        _client(ep).send_batch_barrier()
+    out_epmap = (attrs.get("out_epmap") or
+                 [attrs["endpoints"][0]] * len(out_names))
+    outs = [_client(ep).get_var(n) for n, ep in zip(out_names, out_epmap)]
+    return {"Out": outs}
+
+
+@register_op("recv", inputs=("X",), outputs=("Out",),
+             attrs={"endpoint": ""},
+             not_differentiable=True, host=True)
+def recv(ctx, ins, attrs):
+    """Standalone param fetch (recv_op.cc:28-53)."""
+    out_names = ctx.op.output("Out")
+    c = _client(attrs["endpoint"])
+    return {"Out": [c.get_var(n) for n in out_names]}
+
+
+@register_op("listen_and_serv", inputs=("X",), outputs=(),
+             attrs={"endpoint": "127.0.0.1:0", "Fanin": 1},
+             not_differentiable=True, host=True)
+def listen_and_serv(ctx, ins, attrs):
+    """Run a VariableServer over this op's sub-block as the optimize
+    program (listen_and_serv_op.cc:56-185).  Blocks until a client sends
+    STOP — run it from a dedicated thread/process like the reference's
+    send_recv_op_test.cc does."""
+    import time
+
+    from ..core.framework import Program
+    from ..core.executor import CPUPlace, Executor, global_scope
+    from ..parallel.pserver import VariableServer
+
+    # wrap the optimize sub-block into a standalone single-block program
+    # (the reference hands the block to a nested Executor, :160)
+    sub = ctx.op.sub_block()
+    prog = Program()
+    blk = prog.global_block()
+    for v in sub.vars.values():
+        if not blk.has_var(v.name):
+            blk.create_var(name=v.name, shape=v.shape, dtype=v.dtype,
+                           persistable=True)
+    for op_ in sub.ops:
+        blk.append_op(op_.type, dict(op_.inputs), dict(op_.outputs),
+                      dict(op_.attrs))
+    scope = getattr(ctx, "scope", None) or global_scope()
+    server = VariableServer(prog if sub.ops else None, scope,
+                            Executor(CPUPlace()),
+                            fan_in=attrs.get("Fanin", 1))
+    endpoint = attrs["endpoint"]
+    port = int(endpoint.rsplit(":", 1)[1])
+    server.serve(port)
+    ctx.env.set("__listen_and_serv_port__", server.port)
+    while not server._stopping:
+        time.sleep(0.05)
+    return {}

@@ -127,3 +127,65 @@ def edit_distance(ctx, ins, attrs):
         outs.append(d)
     return {"Out": np.asarray(outs, np.float32).reshape(-1, 1),
             "SequenceNum": np.asarray([len(outs)], np.int64)}
+
+
+@register_op("positive_negative_pair",
+             inputs=("Score", "Label", "QueryID", "Weight",
+                     "AccumulatePositivePair", "AccumulateNegativePair",
+                     "AccumulateNeutralPair"),
+             outputs=("PositivePair", "NegativePair", "NeutralPair"),
+             attrs={"column": -1},
+             not_differentiable=True, host=True)
+def positive_negative_pair(ctx, ins, attrs):
+    """Per-query correctly/incorrectly-ordered pair counts (reference
+    positive_negative_pair_op.h).  Host op: rows are grouped by QueryID
+    and pairs are vectorized WITHIN each query, so memory is O(max query
+    size squared), matching the reference's per-query loop rather than
+    O(total rows squared).
+
+    Keeps the reference's exact edge semantics: pairs with equal scores add
+    their weight to BOTH NeutralPair and NegativePair (the kernel's ternary
+    falls through to `neg` when the score delta is zero)."""
+    import numpy as np
+
+    from ..core.execution import many
+
+    score = np.asarray(data_of(one(ins, "Score")))
+    label = np.asarray(data_of(one(ins, "Label"))).reshape(-1)
+    query = np.asarray(data_of(one(ins, "QueryID"))).reshape(-1)
+    col = attrs.get("column", -1)
+    s = (score[:, col] if score.ndim == 2 else score.reshape(-1)
+         ).astype(np.float64)
+    wv = many(ins, "Weight")
+    w = (np.asarray(data_of(wv[0])).reshape(-1).astype(np.float64) if wv
+         else np.ones_like(s))
+
+    pos = neg = neu = 0.0
+    for q in np.unique(query):
+        idx = np.flatnonzero(query == q)
+        sq, lq, wq = s[idx], label[idx].astype(np.float64), w[idx]
+        k = len(idx)
+        if k < 2:
+            continue
+        iu = np.triu(np.ones((k, k), bool), k=1)
+        ldiff = lq[:, None] - lq[None, :]
+        sdiff = sq[:, None] - sq[None, :]
+        vw = np.where(iu & (ldiff != 0), (wq[:, None] + wq[None, :]) * 0.5,
+                      0.0)
+        correct = sdiff * ldiff > 0
+        pos += float(np.sum(np.where(correct, vw, 0.0)))
+        neg += float(np.sum(np.where(correct, 0.0, vw)))
+        neu += float(np.sum(np.where(sdiff == 0, vw, 0.0)))
+
+    # accumulators apply only when all three are wired, matching the
+    # reference's combined nullptr check (positive_negative_pair_op.h:81)
+    accs = [many(ins, k) for k in ("AccumulatePositivePair",
+                                   "AccumulateNegativePair",
+                                   "AccumulateNeutralPair")]
+    if all(accs):
+        pos += float(np.asarray(data_of(accs[0][0])).reshape(-1)[0])
+        neg += float(np.asarray(data_of(accs[1][0])).reshape(-1)[0])
+        neu += float(np.asarray(data_of(accs[2][0])).reshape(-1)[0])
+    return {"PositivePair": np.asarray([pos], np.float32),
+            "NegativePair": np.asarray([neg], np.float32),
+            "NeutralPair": np.asarray([neu], np.float32)}

@@ -117,6 +117,7 @@ class Optimizer:
         self._startup_program = startup_program
         self._create_lr_var(program)
         self._create_accumulators(block, [p for p, _ in params_grads])
+        start = len(block.ops)
         for p, g in params_grads:
             if g is None:
                 continue
@@ -127,7 +128,9 @@ class Optimizer:
                             {"X": [self._global_step.name]},
                             {"Out": [self._global_step.name]},
                             {"step": 1.0})
-        return []
+        # the ops this pass appended — what DistributeTranspiler moves to
+        # the pserver program (reference optimizer.py returns them too)
+        return block.ops[start:]
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

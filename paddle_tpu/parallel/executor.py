@@ -175,30 +175,110 @@ class DistributeTranspiler:
     def __init__(self):
         self._mesh_axes = None
         self._program = None
+        self._startup = None
         self._shard_opt = True
+        self._endpoints = []
+        self._assign = {}          # param name -> endpoint
+        self._pairs_by_ep = {}     # endpoint -> [(param, grad)]
+        self._optimize_ops = []
 
     def transpile(self, optimize_ops=None, params_grads=None,
                   trainers=1, pservers: str = "", program=None,
+                  startup_program=None,
                   mesh_axes: Optional[Dict[str, int]] = None,
                   shard_optimizer_states: bool = True):
         from ..core.framework import default_main_program
 
         self._program = program or default_main_program()
+        self._startup = startup_program or default_startup_program()
         if mesh_axes is None:
             # reference-style arg mapping: `trainers` data-parallel workers
             mesh_axes = {"dp": trainers}
         self._mesh_axes = mesh_axes
         self._shard_opt = shard_optimizer_states
+        self._endpoints = [e.strip() for e in (pservers or "").split(",")
+                           if e.strip()]
+        self._optimize_ops = list(optimize_ops or [])
+        self._trainers = trainers
+        if self._endpoints and params_grads:
+            self._transpile_pserver(list(params_grads))
+
+    # -- real pserver mode (multi-process CPU clusters / host-side path) ----
+    def _transpile_pserver(self, params_grads):
+        """Rewrite the trainer program: optimizer ops out, send ops in
+        (reference distribute_transpiler.py:134-231; whole-param
+        round-robin placement as in distribute_transpiler_simple.py +
+        distributed_spliter.round_robin)."""
+        eps = self._endpoints
+        self._pairs_by_ep = {ep: [] for ep in eps}
+        for i, (p, g) in enumerate(params_grads):
+            ep = eps[i % len(eps)]
+            self._assign[p.name] = ep
+            self._pairs_by_ep[ep].append((p, g))
+
+        block = self._program.global_block()
+        drop = set(id(op) for op in self._optimize_ops)
+        block.ops[:] = [op for op in block.ops if id(op) not in drop]
+        for ep in eps:
+            pairs = self._pairs_by_ep[ep]
+            if not pairs:
+                continue
+            block.append_op(
+                "send",
+                {"X": [g.name for _, g in pairs]},
+                {"Out": [p.name for p, _ in pairs]},
+                {"endpoints": [ep], "epmap": [ep] * len(pairs)})
+        self._program.bump_version()
 
     def get_trainer_program(self):
         return self._program
 
     def get_pserver_program(self, endpoint=None):
-        """No pserver role exists on a TPU mesh; kept for API parity."""
-        return self._program
+        """Build the per-endpoint pserver program: one listen_and_serv op
+        whose sub-block holds the optimizer ops of the params assigned to
+        this endpoint (reference distribute_transpiler.py:523-618).
 
-    def get_startup_program(self, *a, **kw):
-        return default_startup_program()
+        On a TPU mesh (no `pservers` given) there is no pserver role and
+        the original program is returned for API parity."""
+        if not self._endpoints:
+            return self._program
+        from ..core.framework import Program, program_guard
+        from ..layers.io import ListenAndServ
+
+        pairs = self._pairs_by_ep.get(endpoint, [])
+        mine = {p.name for p, _ in pairs}
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            serv = ListenAndServ(endpoint, fan_in=self._trainers)
+            with serv.do():
+                sub = prog.current_block
+                for op in self._optimize_ops:
+                    param_in = op.inputs.get("Param", [])
+                    if param_in and param_in[0] not in mine:
+                        continue
+                    for n in (op.input_names() + op.output_names()):
+                        if not sub.has_var(n):
+                            src = self._find_var(n)
+                            sub.create_var(
+                                name=n,
+                                shape=src.shape if src else None,
+                                dtype=src.dtype if src else "float32",
+                                persistable=True)
+                    sub.append_op(op.type, dict(op.inputs),
+                                  dict(op.outputs), dict(op.attrs))
+        return prog
+
+    def _find_var(self, name):
+        for blk in self._program.blocks:
+            if blk.has_var(name):
+                return blk.var(name)
+        return None
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """The pserver process initializes params/accumulators/lr with the
+        same startup program the trainer uses (values are then owned by
+        the pserver; reference get_startup_program :620)."""
+        return self._startup or default_startup_program()
 
     def build_executor(self, feed_names, fetch_list, startup_program=None,
                        **kw) -> ParallelExecutor:

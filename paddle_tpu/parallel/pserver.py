@@ -1,0 +1,276 @@
+"""Parameter-server variable transport: send/recv/listen_and_serv.
+
+Reference: /root/reference/paddle/fluid/operators/send_op.cc:44,
+recv_op.cc:28, listen_and_serv_op.cc:56 and detail/{grpc_client,
+grpc_server,send_recv.proto,sendrecvop_utils.cc} — trainers push grad
+blocks to pservers, a fan-in barrier triggers the optimize block, then
+trainers pull updated params.
+
+TPU-native position (SURVEY.md §5.8): the *recommended* data-parallel path
+is psum over ICI (parallel.ParallelExecutor) — this module exists for the
+reference's multi-process workflow parity: host-side feed/eval transfer and
+CPU-cluster pserver training.  Transport is a length-prefixed JSON+raw
+frame over TCP instead of gRPC VariableMessage; semantics (per-trainer grad
+rename `%s.trainer_%d`, batch barrier fan-in, blocking Get until the
+optimize block ran) mirror listen_and_serv_op.cc:78-175.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.lod import LoDTensor
+
+__all__ = ["VariableServer", "VariableClient", "serialize_var",
+           "deserialize_var"]
+
+_HDR = struct.Struct("<I")
+
+
+# ---------------------------------------------------------------------------
+# wire format (reference sendrecvop_utils.cc SerializeToMessage)
+# ---------------------------------------------------------------------------
+
+
+def serialize_var(value) -> bytes:
+    if isinstance(value, LoDTensor):
+        data = np.asarray(value.data)
+        lod = [list(map(int, lvl)) for lvl in value.lod]
+    else:
+        data = np.asarray(value)
+        lod = None
+    head = json.dumps({
+        "dtype": str(data.dtype), "shape": list(data.shape), "lod": lod,
+    }).encode()
+    raw = np.ascontiguousarray(data).tobytes()
+    return _HDR.pack(len(head)) + head + raw
+
+
+def deserialize_var(payload: bytes):
+    (hlen,) = _HDR.unpack_from(payload)
+    head = json.loads(payload[_HDR.size:_HDR.size + hlen])
+    raw = payload[_HDR.size + hlen:]
+    data = np.frombuffer(raw, dtype=np.dtype(head["dtype"])).reshape(
+        head["shape"]).copy()
+    if head["lod"] is not None:
+        return LoDTensor(data, [tuple(lvl) for lvl in head["lod"]])
+    return data
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, verb: str, name: str = "",
+                payload: bytes = b""):
+    head = json.dumps({"verb": verb, "name": name}).encode()
+    sock.sendall(_HDR.pack(len(head)) + _HDR.pack(len(payload)) + head +
+                 payload)
+
+
+def _recv_frame(sock: socket.socket):
+    (hlen,) = _HDR.unpack(_read_exact(sock, 4))
+    (plen,) = _HDR.unpack(_read_exact(sock, 4))
+    head = json.loads(_read_exact(sock, hlen))
+    payload = _read_exact(sock, plen) if plen else b""
+    return head["verb"], head["name"], payload
+
+
+# ---------------------------------------------------------------------------
+# server (listen_and_serv_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class VariableServer:
+    """Holds a scope; applies the optimize program after `fan_in` barriers.
+
+    Round protocol (listen_and_serv_op.cc:114-175): trainers SEND grad
+    vars (stored as `<name>.trainer_<i>` — the per-trainer rename at :82),
+    then send BARRIER; once `fan_in` barriers arrive the optimize program
+    runs in the server scope and blocked GETs are released.
+    """
+
+    def __init__(self, optimize_program, scope, executor, fan_in: int = 1):
+        self.program = optimize_program
+        self.scope = scope
+        self.exe = executor
+        self.fan_in = fan_in
+        self._lock = threading.Condition()
+        self._barriers = 0
+        self._round = 0
+        self._trainer_ids: Dict[str, int] = {}
+        self._next_trainer = 0
+        self._sock: Optional[socket.socket] = None
+        self._threads = []
+        self._stopping = False
+        self.port = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def serve(self, port: int = 0) -> int:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self.port
+
+    def stop(self):
+        self._stopping = True
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._lock.notify_all()
+
+    # -- internals ----------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _trainer_id(self, peer: str) -> int:
+        with self._lock:
+            if peer not in self._trainer_ids:
+                self._trainer_ids[peer] = self._next_trainer
+                self._next_trainer += 1
+            return self._trainer_ids[peer]
+
+    def _serve_conn(self, conn: socket.socket):
+        peer = None
+        try:
+            while True:
+                verb, name, payload = _recv_frame(conn)
+                if verb == "HELLO":
+                    peer = name
+                    _send_frame(conn, "OK")
+                elif verb == "SEND":
+                    tid = self._trainer_id(peer or "anon")
+                    value = deserialize_var(payload)
+                    with self._lock:
+                        # per-trainer grad rename (listen_and_serv :82)
+                        self.scope.set_var(f"{name}.trainer_{tid}", value)
+                    _send_frame(conn, "OK")
+                elif verb == "BARRIER":
+                    self._barrier()
+                    _send_frame(conn, "OK")
+                elif verb == "GET":
+                    val = self._blocking_get(name)
+                    _send_frame(conn, "VAR", name, serialize_var(val))
+                elif verb == "STOP":
+                    _send_frame(conn, "OK")
+                    self.stop()
+                    return
+                else:
+                    _send_frame(conn, "ERR", f"unknown verb {verb}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _barrier(self):
+        with self._lock:
+            self._barriers += 1
+            if self._barriers >= self.fan_in:
+                self._run_optimize()
+                self._barriers = 0
+                self._round += 1
+                self._lock.notify_all()
+            else:
+                rnd = self._round
+                while self._round == rnd and not self._stopping:
+                    self._lock.wait(timeout=0.1)
+
+    def _run_optimize(self):
+        # sum per-trainer grads into the canonical grad var, then run the
+        # optimize program (the reference generates sum ops in the pserver
+        # program; here the fan-in sum is part of the serving contract)
+        names = {}
+        for n in list(self.scope.local_names()):
+            if ".trainer_" in n:
+                base = n.split(".trainer_")[0]
+                names.setdefault(base, []).append(n)
+        for base, parts in names.items():
+            vals = [np.asarray(self.scope.find_var(p)) for p in parts]
+            self.scope.set_var(base, np.sum(vals, axis=0)
+                               if len(vals) > 1 else vals[0])
+        if self.program is not None:
+            self.exe.run(self.program, scope=self.scope)
+
+    def _blocking_get(self, name: str):
+        # The fan-in optimize runs atomically under the server lock, so a
+        # GET serializes either fully before or fully after a round's
+        # update — and a trainer only GETs after its own barrier returned,
+        # i.e. after its round completed.  Reading under the lock is
+        # therefore both torn-read-free and deadlock-free (waiting on
+        # `_barriers == 0` here could deadlock: a fast trainer's next-round
+        # barrier would block a slow trainer's GET forever).
+        with self._lock:
+            v = self.scope.find_var(name)
+        if v is None:
+            raise KeyError(f"pserver has no variable {name!r}")
+        return v
+
+
+# ---------------------------------------------------------------------------
+# client (grpc_client.h AsyncSendVariable/AsyncGetVariable/SendBatchBarrier)
+# ---------------------------------------------------------------------------
+
+
+class VariableClient:
+    def __init__(self, endpoint: str, client_id: str = ""):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=30)
+        _send_frame(self.sock, "HELLO", client_id or f"pid{id(self)}")
+        self._expect_ok()
+
+    def _expect_ok(self):
+        verb, _, _ = _recv_frame(self.sock)
+        if verb != "OK":
+            raise RuntimeError(f"pserver error: {verb}")
+
+    def send_var(self, name: str, value):
+        _send_frame(self.sock, "SEND", name, serialize_var(value))
+        self._expect_ok()
+
+    def send_batch_barrier(self):
+        _send_frame(self.sock, "BARRIER")
+        self._expect_ok()
+
+    def get_var(self, name: str):
+        _send_frame(self.sock, "GET", name)
+        verb, got_name, payload = _recv_frame(self.sock)
+        if verb != "VAR":
+            raise RuntimeError(f"pserver error fetching {name!r}")
+        return deserialize_var(payload)
+
+    def stop_server(self):
+        _send_frame(self.sock, "STOP")
+        self._expect_ok()
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

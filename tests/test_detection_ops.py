@@ -257,3 +257,4 @@ def test_detection_map_global_score_ranking():
                       "g": LoDTensor(gt, [[0, 1, 2]])})
     # TP first (score .9): precision 1 at recall .5; then FP. AP = 0.5
     assert abs(float(np.asarray(m)[0]) - 0.5) < 1e-6
+

@@ -1,0 +1,121 @@
+"""split_lod_tensor / merge_lod_tensor ops + the IfElse layer.
+
+Reference analogues:
+/root/reference/python/paddle/v2/fluid/tests/test_split_and_merge_lod_tensor_op.py
+and tests/test_ifelse.py (+ layers/control_flow.py IfElse :1243).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+class TestSplitLoDTensorDense(OpTest):
+    op_type = "split_lod_tensor"
+
+    def setUp(self):
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        mask = (np.arange(10) % 3 == 0).reshape(10, 1)
+        self.inputs = {"X": x, "Mask": mask}
+        self.outputs = {"OutTrue": x[mask.reshape(-1)],
+                        "OutFalse": x[~mask.reshape(-1)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], max_relative_error=0.01)
+
+
+class TestMergeLoDTensorDense(OpTest):
+    op_type = "merge_lod_tensor"
+
+    def setUp(self):
+        x = np.zeros((6, 3), np.float32)
+        mask = np.array([1, 0, 0, 1, 1, 0]).reshape(6, 1).astype(bool)
+        t = np.random.RandomState(0).rand(3, 3).astype(np.float32)
+        f = np.random.RandomState(1).rand(3, 3).astype(np.float32)
+        out = np.zeros((6, 3), np.float32)
+        out[mask.reshape(-1)] = t
+        out[~mask.reshape(-1)] = f
+        self.inputs = {"X": x, "Mask": mask, "InTrue": t, "InFalse": f}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["InTrue", "InFalse"])
+
+
+def test_split_lod_sequences_roundtrip():
+    """LoD path: mask selects whole sequences; merge restores order."""
+    data = np.arange(14, dtype=np.float32).reshape(7, 2)
+    lod = [(0, 3, 5, 7)]  # three sequences: rows 0-2, 3-4, 5-6
+    mask = np.array([[1], [0], [1]], dtype=bool)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        m = fluid.layers.data(name="m", shape=[1], dtype="bool")
+        t, f = fluid.layers.split_lod_tensor(x, m)
+        merged = fluid.layers.merge_lod_tensor(t, f, x, m)
+    exe = fluid.Executor(fluid.CPUPlace())
+    from paddle_tpu.core.lod import LoDTensor
+    outs = exe.run(main,
+                   feed={"x": LoDTensor(data, lod), "m": mask},
+                   fetch_list=[t, f, merged])
+    def as_np(v):
+        return np.asarray(v.data if isinstance(v, LoDTensor) else v)
+
+    np.testing.assert_allclose(as_np(outs[0]), data[[0, 1, 2, 5, 6]])
+    np.testing.assert_allclose(as_np(outs[1]), data[[3, 4]])
+    np.testing.assert_allclose(as_np(outs[2]), data)
+
+
+def test_ifelse_forward_and_training():
+    """Rows with label>=0.5 go through one fc, others through another;
+    the merged result trains (reference tests/test_ifelse.py shape)."""
+    rng = np.random.RandomState(42)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        limit = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=0.0)
+        sel = fluid.layers.data(name="sel", shape=[1], dtype="bool")
+        ie = fluid.layers.IfElse(sel)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(fluid.layers.scale(xt, scale=2.0))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(fluid.layers.scale(xf, scale=-1.0))
+        out = ie()[0]
+        loss = fluid.layers.mean(out)
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+        assert limit is not None
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.rand(8, 4).astype(np.float32)
+    selv = (xv[:, :1] > 0.5)
+    got, = exe.run(main, feed={"x": xv, "sel": selv}, fetch_list=[out])
+    want = np.where(selv, xv * 2.0, xv * -1.0)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_ifelse_single_branch():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        sel = fluid.layers.data(name="sel", shape=[1], dtype="bool")
+        ie = fluid.layers.IfElse(sel)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=3.0))
+        out = ie()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(6, dtype=np.float32).reshape(3, 2)
+    selv = np.array([[1], [0], [1]], dtype=bool)
+    got, = exe.run(main, feed={"x": xv, "sel": selv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), xv[[0, 2]] * 3.0)

@@ -1,0 +1,71 @@
+"""Image preprocessing + Ploter utilities.
+
+Reference analogues: python/paddle/v2/tests/test_image.py and the
+v2/plot/tests (DISABLE_PLOT path).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import image, plot
+
+
+def _fake_im(h=64, w=48):
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 255, (h, w, 3), np.uint8)
+
+
+def test_resize_short_keeps_aspect():
+    im = image.resize_short(_fake_im(64, 48), 32)
+    assert im.shape[:2] == (42, 32)  # short edge (w) -> 32
+    im = image.resize_short(_fake_im(48, 64), 32)
+    assert im.shape[:2] == (32, 42)
+
+
+def test_crops_and_flip():
+    im = _fake_im(64, 64)
+    c = image.center_crop(im, 32)
+    assert c.shape == (32, 32, 3)
+    np.testing.assert_array_equal(c, im[16:48, 16:48])
+    r = image.random_crop(im, 32)
+    assert r.shape == (32, 32, 3)
+    f = image.left_right_flip(im)
+    np.testing.assert_array_equal(f, im[:, ::-1])
+
+
+def test_simple_transform_chw_and_mean():
+    im = _fake_im(64, 64)
+    out = image.simple_transform(im, 48, 32, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+
+
+def test_load_roundtrip(tmp_path):
+    from PIL import Image
+    p = str(tmp_path / "x.png")
+    Image.fromarray(_fake_im(16, 16)).save(p)
+    im = image.load_image(p)
+    assert im.shape == (16, 16, 3)
+    gray = image.load_image(p, is_color=False)
+    assert gray.shape == (16, 16)
+    out = image.load_and_transform(p, 16, 8, is_train=True)
+    assert out.shape == (3, 8, 8)
+
+
+def test_ploter_collect_and_save(tmp_path, monkeypatch):
+    p = plot.Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    p.append("test", 0, 1.2)
+    if p.plt is not None:
+        out = str(tmp_path / "curve.png")
+        p.plot(out)
+        import os
+        assert os.path.exists(out)
+    p.reset()
+    assert p.__plot_data__["train"].step == []
+
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p2 = plot.Ploter("a")
+    p2.append("a", 0, 1.0)
+    p2.plot()  # no-op, must not raise

@@ -272,3 +272,39 @@ def test_nce_bias_attr_false():
                              "y": np.zeros((2, 1), np.int64)},
                  fetch_list=[cost])
     assert np.isfinite(np.asarray(c)).all()
+
+
+from op_test import OpTest  # noqa: E402
+class TestPositiveNegativePair(OpTest):
+    op_type = "positive_negative_pair"
+
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        n = 12
+        score = rng.rand(n, 3).astype(np.float32)
+        label = rng.randint(0, 3, (n, 1)).astype(np.float32)
+        query = np.repeat(np.arange(3, dtype=np.int64), 4).reshape(n, 1)
+        # numpy reference mirroring positive_negative_pair_op.h exactly
+        pos = neg = neu = 0.0
+        s = score[:, -1]
+        for i in range(n):
+            for j in range(i + 1, n):
+                if query[i, 0] != query[j, 0] or label[i, 0] == label[j, 0]:
+                    continue
+                w = 1.0
+                if s[i] == s[j]:
+                    neu += w
+                if (s[i] - s[j]) * (label[i, 0] - label[j, 0]) > 0:
+                    pos += w
+                else:
+                    neg += w
+        self.inputs = {"Score": score, "Label": label, "QueryID": query}
+        self.outputs = {
+            "PositivePair": np.array([pos], np.float32),
+            "NegativePair": np.array([neg], np.float32),
+            "NeutralPair": np.array([neu], np.float32),
+        }
+
+    def test_output(self):
+        self.check_output()
+

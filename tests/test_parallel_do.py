@@ -1,0 +1,59 @@
+"""ParallelDo: serial vs parallel outputs and gradients must match.
+
+Reference analogue: tests/test_parallel_op.py (BaseParallelForTest:21-150)
+— the same network run plainly and under ParallelDo, asserting outputs and
+param grads agree.  Here the dp "places" are the 8 virtual CPU devices the
+conftest forces; parallel_do lowers to sharding annotations, so equality is
+exact up to float reduction order.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(use_parallel):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        if use_parallel:
+            places = fluid.layers.get_places()
+            pd = fluid.layers.ParallelDo(places)
+            with pd.do():
+                x_ = pd.read_input(x)
+                h = fluid.layers.fc(input=x_, size=16, act="tanh",
+                                    param_attr={"name": "pdo_w0"}, bias_attr={"name": "pdo_b0"})
+                y = fluid.layers.fc(input=h, size=4,
+                                    param_attr={"name": "pdo_w1"}, bias_attr={"name": "pdo_b1"})
+                pd.write_output(y)
+            out = pd()
+        else:
+            h = fluid.layers.fc(input=x, size=16, act="tanh",
+                                param_attr={"name": "pdo_w0"}, bias_attr={"name": "pdo_b0"})
+            out = fluid.layers.fc(input=h, size=4,
+                                  param_attr={"name": "pdo_w1"}, bias_attr={"name": "pdo_b1"})
+        loss = fluid.layers.mean(out)
+        grads = fluid.append_backward(loss)
+    fetch = [loss.name] + [g.name for _, g in grads]
+    return main, startup, fetch
+
+
+def test_parallel_do_matches_serial():
+    xv = np.random.RandomState(3).rand(16, 8).astype(np.float32)
+    results = []
+    for use_parallel in (False, True):
+        main, startup, fetch = _build(use_parallel)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        results.append(
+            exe.run(main, feed={"x": xv}, fetch_list=fetch, scope=scope))
+    for serial, parallel in zip(*results):
+        np.testing.assert_allclose(np.asarray(serial),
+                                   np.asarray(parallel),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_get_places():
+    places = fluid.layers.get_places()
+    assert len(places) >= 1
+    assert len(fluid.layers.get_places(device_count=1)) == 1

@@ -1,0 +1,230 @@
+"""send/recv/listen_and_serv pserver runtime.
+
+Reference analogues: send_recv_op_test.cc:27-36 (listen_and_serv started
+in a std::thread inside the test process, real send against 127.0.0.1)
+and python tests/test_recv_op.py:25-37 (ListenAndServ program in a
+separate process, layers.Send from the parent).
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.pserver import (
+    VariableClient,
+    VariableServer,
+    deserialize_var,
+    serialize_var,
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_serialize_roundtrip():
+    from paddle_tpu.core.lod import LoDTensor
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    np.testing.assert_array_equal(deserialize_var(serialize_var(x)), x)
+    lt = LoDTensor(x, [(0, 1, 3)])
+    back = deserialize_var(serialize_var(lt))
+    np.testing.assert_array_equal(np.asarray(back.data), x)
+    assert tuple(back.lod) == ((0, 1, 3),)
+
+
+def _sgd_program(param_name, grad_name, lr):
+    """pserver optimize program: param -= lr * grad (the reference
+    transpiler emits exactly these optimizer ops into the pserver block)."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        p = blk.create_var(name=param_name, shape=[4], dtype="float32",
+                           persistable=True)
+        g = blk.create_var(name=grad_name, shape=[4], dtype="float32",
+                           persistable=True)
+        lrv = blk.create_var(name="pserver_lr", shape=[1],
+                             dtype="float32", persistable=True)
+        blk.append_op("sgd",
+                      {"Param": [p.name], "Grad": [g.name],
+                       "LearningRate": [lrv.name]},
+                      {"ParamOut": [p.name]}, {})
+    return prog
+
+
+def test_variable_server_two_trainers():
+    """fan_in=2: grads from two trainers are summed before the optimize
+    program runs (listen_and_serv_op.cc:140-153 semantics)."""
+    scope = fluid.Scope()
+    w0 = np.ones(4, np.float32)
+    scope.set_var("w", w0.copy())
+    scope.set_var("pserver_lr", np.asarray([0.1], np.float32))
+    exe = fluid.Executor(fluid.CPUPlace())
+    server = VariableServer(_sgd_program("w", "w@GRAD", 0.1), scope, exe,
+                            fan_in=2)
+    port = server.serve(0)
+
+    g1 = np.full(4, 1.0, np.float32)
+    g2 = np.full(4, 3.0, np.float32)
+
+    def trainer(gid, grad):
+        c = VariableClient(f"127.0.0.1:{port}", client_id=f"t{gid}")
+        c.send_var("w@GRAD", grad)
+        c.send_batch_barrier()
+        got = c.get_var("w")
+        results[gid] = got
+        c.close()
+
+    results = {}
+    ts = [threading.Thread(target=trainer, args=(i, g))
+          for i, g in enumerate([g1, g2])]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    server.stop()
+
+    # w = 1 - 0.1 * (g1 + g2) = 1 - 0.4 = 0.6
+    want = w0 - 0.1 * (g1 + g2)
+    for got in results.values():
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_listen_and_serv_op_with_send():
+    """Full op/layer path: a ListenAndServ program served from a thread,
+    layers.Send from the main thread (reference test_recv_op.py)."""
+    port = _free_port()
+    scope = fluid.Scope()
+    scope.set_var("w_served", np.full(4, 2.0, np.float32))
+    scope.set_var("lr_served", np.asarray([0.5], np.float32))
+
+    serv_main, serv_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(serv_main, serv_start):
+        serv = fluid.layers.ListenAndServ(f"127.0.0.1:{port}", fan_in=1)
+        with serv.do():
+            blk = serv_main.current_block
+            p = blk.create_var(name="w_served", shape=[4], dtype="float32",
+                               persistable=True)
+            g = blk.create_var(name="w_served@GRAD", shape=[4],
+                               dtype="float32", persistable=True)
+            lr = blk.create_var(name="lr_served", shape=[1],
+                                dtype="float32", persistable=True)
+            blk.append_op("sgd",
+                          {"Param": [p.name], "Grad": [g.name],
+                           "LearningRate": [lr.name]},
+                          {"ParamOut": [p.name]}, {})
+
+    def run_server():
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(serv_main, scope=scope)
+
+    th = threading.Thread(target=run_server, daemon=True)
+    th.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            probe = socket.create_connection(("127.0.0.1", port),
+                                             timeout=0.2)
+            probe.close()
+            break
+        except OSError:
+            time.sleep(0.05)
+
+    cli_main, cli_start = fluid.Program(), fluid.Program()
+    cli_scope = fluid.Scope()
+    with fluid.program_guard(cli_main, cli_start):
+        gvar = fluid.layers.data(name="w_served@GRAD", shape=[4],
+                                 dtype="float32", append_batch_size=False)
+        wvar = cli_main.global_block().create_var(
+            name="w_served", shape=[4], dtype="float32")
+        fluid.layers.Send(f"127.0.0.1:{port}", [gvar], [wvar])
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(cli_main,
+                   feed={"w_served@GRAD": np.ones(4, np.float32)},
+                   fetch_list=[wvar], scope=cli_scope)
+    # w = 2.0 - 0.5 * 1.0 = 1.5
+    np.testing.assert_allclose(np.asarray(out), np.full(4, 1.5), rtol=1e-6)
+
+    VariableClient(f"127.0.0.1:{port}").stop_server()
+    th.join(timeout=10)
+    from paddle_tpu.ops.distributed import reset_clients
+    reset_clients()
+
+
+def test_distribute_transpiler_pserver_mode():
+    """End-to-end pserver training (reference
+    tests/book_distribute/notest_dist_fit_a_line.py): transpile splits
+    params round-robin over two pservers, trainer sends grads and pulls
+    updated params, loss decreases."""
+    eps = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        opt_ops, params_grads = fluid.SGD(
+            learning_rate=0.05).minimize(loss)
+
+    t = fluid.DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(optimize_ops=opt_ops, params_grads=params_grads,
+                    trainers=1, pservers=",".join(eps))
+    trainer_prog = t.get_trainer_program()
+    assert any(op.type == "send" for op in
+               trainer_prog.global_block().ops)
+    assert not any(op.type == "sgd" for op in
+                   trainer_prog.global_block().ops)
+
+    # start both pservers, each with its own scope initialized by startup
+    threads = []
+    for ep in eps:
+        pprog = t.get_pserver_program(ep)
+        pscope = fluid.Scope()
+        pexe = fluid.Executor(fluid.CPUPlace())
+        pexe.run(t.get_startup_program(ep), scope=pscope)
+
+        def serve(prog=pprog, sc=pscope):
+            fluid.Executor(fluid.CPUPlace()).run(prog, scope=sc)
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        threads.append(th)
+    for ep in eps:
+        host, port = ep.rsplit(":", 1)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                socket.create_connection((host, int(port)),
+                                         timeout=0.2).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+
+    # trainer: params also initialized locally (first send returns the
+    # pserver's values anyway)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(32, 4).astype(np.float32)
+    ys = (xs @ np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+          ).astype(np.float32)
+    losses = []
+    for _ in range(12):
+        lv, = exe.run(trainer_prog, feed={"x": xs, "y": ys},
+                      fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    from paddle_tpu.ops.distributed import reset_clients
+    for ep in eps:
+        VariableClient(ep).stop_server()
+    reset_clients()
