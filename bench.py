@@ -21,6 +21,12 @@ BATCH = int(os.environ.get("BENCH_BATCH", "64"))
 IMG = 224
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+# mixed precision (paddle_tpu.amp): bf16 compute with f32 master weights.
+# The bench model is already end-to-end bf16 (params follow the input
+# dtype), so amp only adds f32-stat batch-norms here — off by default;
+# BENCH_AMP=1 to measure the amp path.
+AMP = os.environ.get("BENCH_AMP", "0").lower() in ("1", "true", "yes",
+                                                   "on")
 
 
 def build_resnet50_train(batch, dtype):
@@ -45,6 +51,8 @@ def main():
     import paddle_tpu as fluid
     from paddle_tpu.core.executor import program_to_fn
 
+    if AMP:
+        fluid.amp.enable_bf16()
     main_p, startup, avg = build_resnet50_train(BATCH, DTYPE)
     fn = program_to_fn(main_p, ["img", "label"], [avg.name])
 

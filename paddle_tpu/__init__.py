@@ -10,6 +10,7 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
     ride ICI via the parallel package
 """
 from . import (  # noqa: F401
+    amp,
     clip,
     debugger,
     evaluator,

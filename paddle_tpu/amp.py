@@ -1,0 +1,86 @@
+"""Mixed-precision (bfloat16) training support.
+
+Reference: /root/reference/doc/design/float16.md (the fp16 design note —
+the reference never shipped a training AMP; math/float16.h is an
+interchange type).  The TPU rebuild makes bf16 a first-class training
+mode, designed around the MXU and HBM:
+
+  * Whitelisted MXU ops (mul / matmul / conv2d family) cast float32
+    operands to bfloat16 at their input edge — XLA fuses the converts into
+    the surrounding computation, so activations flow through the network
+    in bf16 (half the HBM traffic) and matmuls/convs hit the MXU's native
+    bf16 path.
+  * Parameters stay float32 ("master weights").  The generic-VJP backward
+    produces bf16 grads for bf16 compute; optimizer ops then apply them to
+    f32 params, where jnp type promotion upcasts — no grad-scaling loop is
+    needed because bf16 has f32's exponent range (unlike fp16).
+  * Numerically sensitive tails (softmax, cross-entropy) upcast their
+    inputs back to f32 inside their own lowerings.
+
+Usage:
+    with fluid.amp.bf16_guard():
+        exe.run(main, feed=..., fetch_list=[loss])
+or process-wide: fluid.amp.enable_bf16() / PADDLE_TPU_AMP_BF16=1.
+
+NOTE: the flag is read at TRACE time inside op lowerings, and toggling it
+does not change input avals — so every compile cache must key on it
+explicitly.  Executor includes the flag in its cache keys and
+ParallelExecutor refreshes its jit on a flag flip; code that jits
+`program_to_fn` directly (e.g. bench.py) must set the amp state before
+tracing and keep it fixed.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .core.flags import get_flag, set_flags
+
+__all__ = ["enable_bf16", "disable_bf16", "bf16_guard", "amp_cast",
+           "amp_upcast", "is_bf16_enabled"]
+
+
+def is_bf16_enabled() -> bool:
+    return bool(get_flag("amp_bf16"))
+
+
+def enable_bf16():
+    set_flags({"amp_bf16": True})
+
+
+def disable_bf16():
+    set_flags({"amp_bf16": False})
+
+
+@contextlib.contextmanager
+def bf16_guard():
+    prev = is_bf16_enabled()
+    set_flags({"amp_bf16": True})
+    try:
+        yield
+    finally:
+        set_flags({"amp_bf16": prev})
+
+
+def amp_cast(*arrays):
+    """Whitelist-edge cast: float32 -> bfloat16 when amp is on (other
+    dtypes pass through untouched)."""
+    if not is_bf16_enabled():
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(
+        a.astype(jnp.bfloat16)
+        if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+        for a in arrays
+    )
+    return out if len(out) > 1 else out[0]
+
+
+def amp_upcast(a):
+    """Blacklist-edge cast: bfloat16 -> float32 for numerically sensitive
+    ops (softmax/cross-entropy) while amp is on.  Gated on the flag so
+    programs that are deliberately pure-bf16 (no amp) keep their dtypes."""
+    if is_bf16_enabled() and hasattr(a, "dtype") \
+            and a.dtype == jnp.bfloat16:
+        return a.astype(jnp.float32)
+    return a

@@ -358,6 +358,7 @@ class Executor:
         cache_key = (
             fp, "seg", seg_idx,
             tuple((n, _aval_key(v)) for n, v in sorted(in_vals.items())),
+            get_flag("amp_bf16"),  # amp changes traced compute dtypes
         )
         fn = self._cache.get(cache_key)
         if fn is None:
@@ -443,6 +444,7 @@ class Executor:
             tuple((n, _aval_key(v)) for n, v in rw.items()),
             tuple(fetch_names),
             str(device),
+            get_flag("amp_bf16"),  # amp changes traced compute dtypes
         )
         fn = self._cache.get(cache_key)
         if fn is None:

@@ -53,3 +53,7 @@ define_flag("check_nan_inf", False,
             "(executor.cc FLAGS_check_nan_inf)")
 define_flag("benchmark", False,
             "per-op sync + timing logs (executor.cc FLAGS_benchmark)")
+define_flag("amp_bf16", False,
+            "mixed precision: whitelisted MXU ops (mul/matmul/conv) cast "
+            "float32 operands to bfloat16; optimizer ops keep float32 "
+            "master params (dtype promotion upcasts bf16 grads)")

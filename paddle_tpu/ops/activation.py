@@ -77,9 +77,12 @@ _unary("swish", lambda x, a: x * jax.nn.sigmoid(a["beta"] * x),
 
 @register_op("softmax", inputs=("X",), outputs=("Out",))
 def softmax(ctx, ins, attrs):
-    """Reference softmax_op.cc: softmax over the last dim of a 2D input."""
+    """Reference softmax_op.cc: softmax over the last dim of a 2D input.
+    bf16 inputs upcast to f32 (numerically sensitive amp blacklist)."""
+    from ..amp import amp_upcast
     xv = one(ins, "X")
-    return {"Out": with_lod_of(xv, jax.nn.softmax(data_of(xv), axis=-1))}
+    return {"Out": with_lod_of(
+        xv, jax.nn.softmax(amp_upcast(data_of(xv)), axis=-1))}
 
 
 @register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",))

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..amp import amp_cast
 from ..core.execution import data_of, one
 from ..core.registry import register_op
 
@@ -33,6 +34,7 @@ def _pair(v, n=2):
 def conv2d(ctx, ins, attrs):
     x = data_of(one(ins, "Input"))        # [N, C, H, W]
     w = data_of(one(ins, "Filter"))       # [M, C/groups, kh, kw]
+    x, w = amp_cast(x, w)
     s, p, d = (_pair(attrs["strides"]), _pair(attrs["paddings"]),
                _pair(attrs["dilations"]))
     out = jax.lax.conv_general_dilated(
@@ -62,6 +64,7 @@ def depthwise_conv2d(ctx, ins, attrs):
 def conv3d(ctx, ins, attrs):
     x = data_of(one(ins, "Input"))        # [N, C, D, H, W]
     w = data_of(one(ins, "Filter"))
+    x, w = amp_cast(x, w)
     s = _pair(attrs["strides"], 3)
     p = _pair(attrs["paddings"], 3)
     d = _pair(attrs["dilations"], 3)
@@ -79,6 +82,7 @@ def conv3d(ctx, ins, attrs):
 def conv2d_transpose(ctx, ins, attrs):
     x = data_of(one(ins, "Input"))        # [N, C, H, W]
     w = data_of(one(ins, "Filter"))       # [C, M, kh, kw] (reference layout)
+    x, w = amp_cast(x, w)
     s, p = _pair(attrs["strides"]), _pair(attrs["paddings"])
     d = _pair(attrs.get("dilations", [1, 1]))
     kh, kw = w.shape[2], w.shape[3]

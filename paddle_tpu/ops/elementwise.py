@@ -36,10 +36,23 @@ def _make_elementwise(name, fn):
     def lower(ctx, ins, attrs, _fn=fn):
         xv, yv = one(ins, "X"), one(ins, "Y")
         x, y = data_of(xv), data_of(yv)
+        if _amp_mixed(x, y):
+            # under amp, a bf16 activation meeting an f32 side (bias,
+            # residual) computes in bf16 — keeps the activation chain in
+            # bf16 instead of silently promoting back to f32
+            x, y = x.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
         out = _fn(x, _broadcast_y(x, y, attrs.get("axis", -1)))
         return {"Out": with_lod_of(xv, out)}
 
     return lower
+
+
+def _amp_mixed(x, y) -> bool:
+    from ..amp import is_bf16_enabled
+    if not is_bf16_enabled():
+        return False
+    dts = {getattr(x, "dtype", None), getattr(y, "dtype", None)}
+    return dts == {jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32)}
 
 
 _make_elementwise("elementwise_add", jnp.add)

@@ -10,6 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..amp import amp_upcast
 from ..core.execution import data_of, one, with_lod_of
 from ..core.registry import register_op
 
@@ -27,7 +28,8 @@ def _take_label(x, label):
              attrs={"soft_label": False}, diff_inputs=("X",))
 def cross_entropy(ctx, ins, attrs):
     xv = one(ins, "X")
-    x = data_of(xv)
+    # numerically sensitive tail: bf16 probabilities upcast to f32
+    x = amp_upcast(data_of(xv))
     # additive eps (not clamp): keeps a finite, recovery-capable gradient
     # -1/(p+eps) when the softmax saturates to p≈0 on the true class
     eps = jnp.asarray(1e-10 if x.dtype == jnp.float32 else 1e-20, x.dtype)
@@ -45,7 +47,7 @@ def cross_entropy(ctx, ins, attrs):
              attrs={"soft_label": False},
              diff_inputs=("Logits",), diff_outputs=("Loss",))
 def softmax_with_cross_entropy(ctx, ins, attrs):
-    logits = data_of(one(ins, "Logits"))
+    logits = amp_upcast(data_of(one(ins, "Logits")))
     log_p = jax.nn.log_softmax(logits, axis=-1)
     if attrs.get("soft_label"):
         lbl = data_of(one(ins, "Label"))

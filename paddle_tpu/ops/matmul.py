@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from ..amp import amp_cast
 from ..core.execution import data_of, one, with_lod_of
 from ..core.registry import register_op
 
@@ -29,6 +30,7 @@ def mul(ctx, ins, attrs):
     xd, yd = attrs["x_num_col_dims"], attrs["y_num_col_dims"]
     x2 = _flatten2d(x, xd)
     y2 = y.reshape(int(np.prod(y.shape[:yd], dtype=np.int64)), -1)
+    x2, y2 = amp_cast(x2, y2)
     out = jnp.matmul(x2, y2)
     out_shape = x.shape[:xd] + y.shape[yd:]
     # rows map 1:1 -> sequence structure survives a projection
@@ -43,6 +45,7 @@ def matmul(ctx, ins, attrs):
     leading batch dims broadcast."""
     x = data_of(one(ins, "X"))
     y = data_of(one(ins, "Y"))
+    x, y = amp_cast(x, y)
     tx, ty = attrs["transpose_X"], attrs["transpose_Y"]
     squeeze_first = squeeze_last = False
     if x.ndim == 1:

@@ -21,7 +21,13 @@ from ..core.registry import register_op
              diff_inputs=("X", "Scale", "Bias"), diff_outputs=("Y",),
              inplace={"MeanOut": "Mean", "VarianceOut": "Variance"})
 def batch_norm(ctx, ins, attrs):
+    from ..amp import is_bf16_enabled
     x = data_of(one(ins, "X"))
+    # under amp, stats compute in f32 (bf16 mean/var is too coarse) and Y
+    # returns in x's dtype; outside amp the user's dtype is honored as-is
+    out_dtype = x.dtype
+    if is_bf16_enabled() and x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
     scale = data_of(one(ins, "Scale"))
     bias = data_of(one(ins, "Bias"))
     mean = data_of(one(ins, "Mean"))
@@ -49,7 +55,11 @@ def batch_norm(ctx, ins, attrs):
     inv_std = 1.0 / jnp.sqrt(use_var + eps)
     y = ((x - use_mean.reshape(bshape)) * inv_std.reshape(bshape)
          * scale.reshape(bshape) + bias.reshape(bshape))
-    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+    # running stats keep the state var's dtype: a dtype flip here would
+    # change the train-step state avals and force a recompile every step
+    return {"Y": y.astype(out_dtype),
+            "MeanOut": mean_out.astype(mean.dtype),
+            "VarianceOut": var_out.astype(var.dtype),
             "SavedMean": saved_mean, "SavedVariance": saved_var}
 
 

@@ -34,6 +34,11 @@ from ..core.framework import Variable, default_startup_program
 from ..core.scope import Scope
 from .mesh import make_mesh
 
+
+def _amp_enabled() -> bool:
+    from ..amp import is_bf16_enabled
+    return is_bf16_enabled()
+
 __all__ = ["ParallelExecutor", "DistributeTranspiler"]
 
 
@@ -97,11 +102,24 @@ class ParallelExecutor:
             fetches, new_states = fn(feeds, states, key)
             return fetches, new_states
 
-        self._jit_step = jax.jit(
-            step,
+        self._step_fn = step
+        self._jit_step = self._make_jit_step()
+        self._amp_state = _amp_enabled()
+
+    def _make_jit_step(self):
+        return jax.jit(
+            self._step_fn,
             out_shardings=(None, self._out_state_shardings()),
             donate_argnums=(1,),
         )
+
+    def _refresh_amp(self):
+        # the amp flag is read at TRACE time inside op lowerings; identical
+        # input avals would silently reuse an executable traced under the
+        # old flag state, so toggling amp gets a fresh jit cache
+        if _amp_enabled() != self._amp_state:
+            self._jit_step = self._make_jit_step()
+            self._amp_state = _amp_enabled()
 
     # -- sharding policy -----------------------------------------------------
     def _spec_for(self, name, val, param_names, param_shardings,
@@ -129,6 +147,7 @@ class ParallelExecutor:
 
     # -- execution -----------------------------------------------------------
     def run(self, feed: Dict, fetch_list=None, return_numpy=True):
+        self._refresh_amp()
         fetch_names = ([v.name if isinstance(v, Variable) else str(v)
                         for v in fetch_list]
                        if fetch_list is not None else self.fetch_names)

@@ -1,0 +1,76 @@
+"""Mixed-precision (bf16) training mode.
+
+Reference analogue: doc/design/float16.md (design only — the reference
+never shipped AMP training; this is the TPU rebuild's MXU-native mode).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _convnet():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4,
+                                   filter_size=3, act="relu")
+        fc = fluid.layers.fc(input=conv, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=fc, label=label))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, conv, fc, loss
+
+
+def _feed(rng):
+    return {"img": rng.rand(8, 1, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+
+
+def test_bf16_guard_activations_and_master_weights():
+    rng = np.random.RandomState(0)
+    main, startup, conv, fc, loss = _convnet()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    param_names = [v.name for v in main.list_vars()
+                   if getattr(v, "trainable", False)]
+    assert param_names
+
+    with fluid.amp.bf16_guard():
+        feed = _feed(rng)
+        conv_v, loss0 = exe.run(main, feed=feed,
+                                fetch_list=[conv, loss], scope=scope,
+                                return_numpy=False)
+        # conv output flows in bf16...
+        assert str(np.asarray(conv_v).dtype) == "bfloat16" or \
+            str(conv_v.dtype) == "bfloat16"
+        losses = [float(np.asarray(loss0).reshape(-1)[0])]
+        for _ in range(30):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    # ...while master params stay float32 and training converges
+    for n in param_names:
+        assert np.asarray(scope.find_var(n)).dtype == np.float32, n
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_amp_off_keeps_f32_and_caches_separately():
+    rng = np.random.RandomState(1)
+    main, startup, conv, fc, loss = _convnet()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    feed = _feed(rng)
+    conv_f32, = exe.run(main, feed=feed, fetch_list=[conv], scope=scope,
+                        return_numpy=False)
+    assert str(conv_f32.dtype) == "float32"
+    # same program/feeds with amp on must NOT reuse the f32 executable
+    with fluid.amp.bf16_guard():
+        conv_bf16, = exe.run(main, feed=feed, fetch_list=[conv],
+                             scope=scope, return_numpy=False)
+    assert str(conv_bf16.dtype) == "bfloat16"
+    conv_back, = exe.run(main, feed=feed, fetch_list=[conv], scope=scope,
+                         return_numpy=False)
+    assert str(conv_back.dtype) == "float32"
