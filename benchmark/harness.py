@@ -1,0 +1,42 @@
+"""Shared benchmark scaffold: build -> jit -> warmup -> timed loop.
+
+One copy of the measure loop (reference `paddle train --job=time`
+semantics) used by bench.py, run_image.py and run_rnn.py so warmup /
+sync / timing changes can't silently diverge between published numbers.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def time_program(main, startup, feeds, fetch_name, iters):
+    """Run `iters` steady-state training steps of `main`'s block 0 on the
+    default device; returns ms/batch.  `feeds` are device_put as-is;
+    states are donated so param updates stay on device."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.executor import program_to_fn
+
+    fn = program_to_fn(main, list(feeds.keys()), [fetch_name])
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    states = {n: jax.device_put(np.asarray(scope.find_var(n)))
+              for n in fn.state_in_names}
+    key = jax.random.key(0)
+
+    @jax.jit
+    def step(feeds, states):
+        fetches, new_states = fn(feeds, states, key)
+        return fetches[fetch_name], new_states
+
+    dev_feeds = jax.device_put(feeds)
+    loss, states = step(dev_feeds, states)  # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, states = step(dev_feeds, states)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / iters * 1000

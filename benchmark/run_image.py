@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Image-model training benchmark (reference benchmark/paddle/image/run.sh
+`paddle train --job=time`; published tables benchmark/README.md:33-95).
+
+Prints one JSON line per (model, batch) with ms/batch and images/sec.
+
+    python benchmark/run_image.py --model alexnet --batch 128
+    python benchmark/run_image.py --all            # the reference table grid
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from harness import time_program
+
+SPECS = {
+    # name -> (input HxW, reference 1xK40m ms/batch table keyed by batch,
+    #          from the reference benchmark/README.md:33-95)
+    "alexnet": (227, {64: 195.0, 128: 334.0, 256: 602.0, 512: 1629.0}),
+    "googlenet": (224, {64: 613.0, 128: 1149.0, 256: 2348.0}),
+    "smallnet": (32, {64: 10.5, 128: 18.2, 256: 33.1, 512: 63.0}),
+    "resnet50": (224, {}),
+    "vgg19": (224, {}),
+}
+
+
+def build(model, img, dtype):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name="img", shape=[3, img, img],
+                                 dtype=dtype)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        if model == "alexnet":
+            predict = models.alexnet(data, class_dim=1000)
+        elif model == "googlenet":
+            predict = models.googlenet(data, class_dim=1000)
+        elif model == "smallnet":
+            predict = models.smallnet_mnist_cifar(data, class_dim=10)
+        elif model == "resnet50":
+            predict = models.resnet_imagenet(data, class_dim=1000, depth=50)
+        elif model == "vgg19":
+            predict = models.vgg(data, class_dim=1000, depth=19)
+        else:
+            raise SystemExit(f"unknown model {model}")
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.Momentum(learning_rate=0.01, momentum=0.9).minimize(avg)
+    return main, startup, avg
+
+
+def run_one(model, batch, iters, dtype):
+    from paddle_tpu.core.types import np_dtype
+
+    img, ref_table = SPECS[model]
+    classes = 10 if model == "smallnet" else 1000
+    main, startup, avg = build(model, img, dtype)
+    r = np.random.RandomState(0)
+    feeds = {
+        "img": r.rand(batch, 3, img, img).astype(np_dtype(dtype)),
+        "label": r.randint(0, classes, (batch, 1)).astype(np.int32),
+    }
+    ms = time_program(main, startup, feeds, avg.name, iters)
+    ref = ref_table.get(batch)
+    print(json.dumps({
+        "model": model, "batch": batch,
+        "ms_per_batch": round(ms, 2),
+        "images_per_sec": round(batch / ms * 1000, 1),
+        "ref_k40m_ms_per_batch": ref,
+        "speedup_vs_ref": round(ref / ms, 2) if ref else None,
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="alexnet", choices=sorted(SPECS))
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--all", action="store_true",
+                    help="reference table grid (README.md:33-95)")
+    args = ap.parse_args()
+    if args.all:
+        for model in ("alexnet", "googlenet", "smallnet"):
+            for batch in sorted(SPECS[model][1]):
+                run_one(model, batch, args.iters, args.dtype)
+    else:
+        run_one(args.model, args.batch, args.iters, args.dtype)
+
+
+if __name__ == "__main__":
+    main()

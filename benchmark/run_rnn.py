@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""LSTM text-classification benchmark (reference benchmark/paddle/rnn/
+rnn.py: IMDB, embedding 128, simple_lstm(hidden), last_seq, fc softmax;
+published ms/batch tables benchmark/README.md:115-161).
+
+    python benchmark/run_rnn.py --batch 128 --hidden 512
+    python benchmark/run_rnn.py --all
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from harness import time_program
+
+VOCAB = 30000
+SEQ_LEN = 100  # reference fixedlen=100 (pad_seq=True mode)
+
+# benchmark/README.md:115-135 — 1x K40m ms/batch, {batch: {hidden: ms}}
+REF = {
+    64: {256: 83.0, 512: 184.0, 1280: 641.0},
+    128: {256: 110.0, 512: 261.0, 1280: 1007.0},
+    256: {256: 170.0, 512: 414.0, 1280: 1655.0},
+}
+
+
+def build(batch, hidden, dtype):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(input=data, size=[VOCAB, 128],
+                                     dtype=dtype)
+        # reference simple_lstm = fc (4h) + lstm over the sequence; the
+        # scan-based lstm op consumes the LoD rows [N, 4h]
+        proj = fluid.layers.fc(input=emb, size=hidden * 4)
+        lstm_out, _ = fluid.layers.dynamic_lstm(input=proj, size=hidden * 4)
+        last = fluid.layers.sequence_pool(lstm_out, pool_type="last")
+        predict = fluid.layers.fc(input=last, size=2, act="softmax")
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=2e-3).minimize(avg)
+    return main, startup, avg
+
+
+def run_one(batch, hidden, iters, dtype):
+    from paddle_tpu.core.lod import LoDTensor, lod_from_seq_lens
+
+    main, startup, avg = build(batch, hidden, dtype)
+    r = np.random.RandomState(0)
+    words = LoDTensor(
+        r.randint(0, VOCAB, (batch * SEQ_LEN, 1)).astype(np.int32),
+        [lod_from_seq_lens([SEQ_LEN] * batch)])
+    feeds = {"words": words,
+             "label": r.randint(0, 2, (batch, 1)).astype(np.int32)}
+    ms = time_program(main, startup, feeds, avg.name, iters)
+    ref = REF.get(batch, {}).get(hidden)
+    print(json.dumps({
+        "model": "lstm_textcls", "batch": batch, "hidden": hidden,
+        "seq_len": SEQ_LEN,
+        "ms_per_batch": round(ms, 2),
+        "tokens_per_sec": round(batch * SEQ_LEN / ms * 1000, 1),
+        "ref_k40m_ms_per_batch": ref,
+        "speedup_vs_ref": round(ref / ms, 2) if ref else None,
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=10)
+    # bf16 embeddings/params put the scan's per-step matmuls on the MXU
+    # fast path — ~10x over f32 at hidden 512 on v5e
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--all", action="store_true")
+    args = ap.parse_args()
+    if args.all:
+        for batch in sorted(REF):
+            for hidden in sorted(REF[batch]):
+                run_one(batch, hidden, args.iters, args.dtype)
+    else:
+        run_one(args.batch, args.hidden, args.iters, args.dtype)
+
+
+if __name__ == "__main__":
+    main()

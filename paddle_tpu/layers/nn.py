@@ -370,16 +370,27 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
     return precision, recall, f1, n_infer, n_label, n_correct
 
 
+def _check_layout(value, name="data_format"):
+    """Normalize/validate a layout string — a typo like "nhwc" silently
+    building a mixed-layout network is the failure mode this closes."""
+    v = str(value).upper()
+    if v not in ("NCHW", "NHWC"):
+        raise ValueError(f"{name} must be 'NCHW' or 'NHWC', got {value!r}")
+    return v
+
+
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, act=None,
            name=None, use_cudnn=True, main_program=None,
-           startup_program=None):
+           startup_program=None, data_format="NCHW"):
     helper = LayerHelper("conv2d", input=input, param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name,
                          main_program=main_program,
                          startup_program=startup_program)
     dtype = input.dtype
-    num_channels = input.shape[1]
+    data_format = _check_layout(data_format)
+    c_axis = 3 if data_format == "NHWC" else 1
+    num_channels = input.shape[c_axis]
     groups = groups or 1
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
@@ -403,8 +414,10 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         "conv2d", {"Input": [input.name], "Filter": [w.name]},
         {"Output": [pre_bias.name]},
         {"strides": stride, "paddings": padding, "dilations": dilation,
-         "groups": groups, "use_cudnn": use_cudnn})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+         "groups": groups, "use_cudnn": use_cudnn,
+         "data_format": data_format})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=c_axis,
+                                    dim_end=c_axis + 1)
     return helper.append_activation(pre_act)
 
 
@@ -439,8 +452,10 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=1,
-           pool_padding=0, global_pooling=False, use_cudnn=True, name=None):
+           pool_padding=0, global_pooling=False, use_cudnn=True, name=None,
+           data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
+    data_format = _check_layout(data_format)
     if isinstance(pool_size, int):
         pool_size = [pool_size, pool_size]
     if isinstance(pool_stride, int):
@@ -452,7 +467,8 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1,
         "pool2d", {"X": [input.name]}, {"Out": [out.name]},
         {"pooling_type": pool_type, "ksize": list(pool_size),
          "strides": list(pool_stride), "paddings": list(pool_padding),
-         "global_pooling": global_pooling, "use_cudnn": use_cudnn})
+         "global_pooling": global_pooling, "use_cudnn": use_cudnn,
+         "data_format": data_format})
     return out
 
 
@@ -462,6 +478,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     helper = LayerHelper("batch_norm", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
+    data_layout = _check_layout(data_layout, "data_layout")
     c_axis = 1 if data_layout == "NCHW" else len(input.shape) - 1
     channels = input.shape[c_axis]
     scale = helper.create_parameter(

@@ -1,5 +1,7 @@
 """Model zoo built on the layers DSL (reference book + benchmark models)."""
+from .alexnet import alexnet  # noqa: F401
 from .ctr import deepfm, wide_deep  # noqa: F401
+from .googlenet import googlenet, smallnet_mnist_cifar  # noqa: F401
 from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
 from .transformer import (  # noqa: F401
     transformer_decoder,

@@ -14,17 +14,20 @@ __all__ = ["resnet_imagenet", "resnet_cifar10"]
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
-                  bias_attr=False, is_test=False):
+                  bias_attr=False, is_test=False, data_format="NCHW"):
     conv = layers.conv2d(input=input, num_filters=ch_out,
                          filter_size=filter_size, stride=stride,
-                         padding=padding, act=None, bias_attr=bias_attr)
-    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+                         padding=padding, act=None, bias_attr=bias_attr,
+                         data_format=data_format)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test,
+                             data_layout=data_format)
 
 
-def _shortcut(input, ch_in, ch_out, stride, is_test=False):
+def _shortcut(input, ch_in, ch_out, stride, is_test=False,
+              data_format="NCHW"):
     if stride != 1 or ch_in != ch_out:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
-                             is_test=is_test)
+                             is_test=is_test, data_format=data_format)
     return input
 
 
@@ -33,33 +36,41 @@ def _add_relu(a, b):
     return layers.relu(s)
 
 
-def basicblock(input, ch_in, ch_out, stride, is_test=False):
-    short = _shortcut(input, ch_in, ch_out, stride, is_test)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+def basicblock(input, ch_in, ch_out, stride, is_test=False,
+               data_format="NCHW"):
+    short = _shortcut(input, ch_in, ch_out, stride, is_test, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test,
+                          data_format=data_format)
     return _add_relu(short, conv2)
 
 
-def bottleneck(input, ch_in, ch_out, stride, is_test=False):
-    short = _shortcut(input, ch_in, ch_out * 4, stride, is_test)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+def bottleneck(input, ch_in, ch_out, stride, is_test=False,
+               data_format="NCHW"):
+    short = _shortcut(input, ch_in, ch_out * 4, stride, is_test, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test,
+                          data_format=data_format)
     conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
-                          is_test=is_test)
+                          is_test=is_test, data_format=data_format)
     return _add_relu(short, conv3)
 
 
 def _layer_warp(block_func, input, ch_in, ch_out, count, stride,
-                is_test=False):
-    res = block_func(input, ch_in, ch_out, stride, is_test)
+                is_test=False, data_format="NCHW"):
+    res = block_func(input, ch_in, ch_out, stride, is_test, data_format)
     for _ in range(1, count):
         ch_in_cur = ch_out * (4 if block_func is bottleneck else 1)
-        res = block_func(res, ch_in_cur, ch_out, 1, is_test)
+        res = block_func(res, ch_in_cur, ch_out, 1, is_test, data_format)
     return res
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
-    """ResNet-50/101/152 (bottleneck) for 224x224 NCHW input."""
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
+                    data_format="NCHW"):
+    """ResNet-50/101/152 (bottleneck) for 224x224 input; data_format
+    "NHWC" runs channels-last — the TPU-native conv layout."""
     cfg = {
         50: ([3, 4, 6, 3], bottleneck),
         101: ([3, 4, 23, 3], bottleneck),
@@ -68,17 +79,21 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
         34: ([3, 4, 6, 3], basicblock),
     }
     stages, block = cfg[depth]
-    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test,
+                          data_format=data_format)
     pool1 = layers.pool2d(input=conv1, pool_size=3, pool_stride=2,
-                          pool_padding=1, pool_type="max")
+                          pool_padding=1, pool_type="max",
+                          data_format=data_format)
     expansion = 4 if block is bottleneck else 1
     res = pool1
     ch_in = 64
     for i, (count, ch_out) in enumerate(zip(stages, [64, 128, 256, 512])):
         stride = 1 if i == 0 else 2
-        res = _layer_warp(block, res, ch_in, ch_out, count, stride, is_test)
+        res = _layer_warp(block, res, ch_in, ch_out, count, stride, is_test,
+                          data_format)
         ch_in = ch_out * expansion
-    pool2 = layers.pool2d(input=res, pool_type="avg", global_pooling=True)
+    pool2 = layers.pool2d(input=res, pool_type="avg", global_pooling=True,
+                          data_format=data_format)
     return layers.fc(input=pool2, size=class_dim, act="softmax")
 
 

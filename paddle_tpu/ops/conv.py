@@ -30,18 +30,23 @@ def _pair(v, n=2):
 
 @register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",),
              attrs={"strides": [1, 1], "paddings": [0, 0],
-                    "dilations": [1, 1], "groups": 1, "use_cudnn": True})
+                    "dilations": [1, 1], "groups": 1, "use_cudnn": True,
+                    "data_format": "NCHW"})
 def conv2d(ctx, ins, attrs):
-    x = data_of(one(ins, "Input"))        # [N, C, H, W]
+    """data_format "NHWC" keeps activations channels-last — the TPU's
+    native conv layout (vector lanes = channels); weights stay OIHW at the
+    IR level either way (lax handles the rhs spec)."""
+    x = data_of(one(ins, "Input"))        # [N, C, H, W] or [N, H, W, C]
     w = data_of(one(ins, "Filter"))       # [M, C/groups, kh, kw]
     x, w = amp_cast(x, w)
     s, p, d = (_pair(attrs["strides"]), _pair(attrs["paddings"]),
                _pair(attrs["dilations"]))
+    df = attrs.get("data_format", "NCHW")
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=s,
         padding=[(p[0], p[0]), (p[1], p[1])],
         rhs_dilation=d,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(df, "OIHW", df),
         feature_group_count=int(attrs.get("groups") or 1),
         preferred_element_type=jnp.float32
         if x.dtype == jnp.float32 else None)
@@ -106,12 +111,19 @@ def _pool2d(x, attrs):
     k = _pair(attrs.get("ksize", [2, 2]))
     s = _pair(attrs.get("strides", [1, 1]))
     p = _pair(attrs.get("paddings", [0, 0]))
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    h_ax, w_ax = (1, 2) if nhwc else (2, 3)
     if attrs.get("global_pooling"):
-        k = (x.shape[2], x.shape[3])
+        k = (x.shape[h_ax], x.shape[w_ax])
         s, p = (1, 1), (0, 0)
-    window = (1, 1) + k
-    strides = (1, 1) + s
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if nhwc:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -130,7 +142,8 @@ def _pool2d(x, attrs):
 @register_op("pool2d", inputs=("X",), outputs=("Out",),
              attrs={"pooling_type": "max", "ksize": [2, 2],
                     "strides": [1, 1], "paddings": [0, 0],
-                    "global_pooling": False, "use_cudnn": True})
+                    "global_pooling": False, "use_cudnn": True,
+                    "data_format": "NCHW"})
 def pool2d(ctx, ins, attrs):
     return {"Out": _pool2d(data_of(one(ins, "X")), attrs)}
 

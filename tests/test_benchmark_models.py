@@ -1,0 +1,50 @@
+"""AlexNet / GoogLeNet / SmallNet model builders (benchmark zoo).
+
+Reference model defs: benchmark/paddle/image/{alexnet,googlenet,
+smallnet_mnist_cifar}.py — here built fluid-style and smoke-trained on
+tiny inputs.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+def _train_steps(build, img_shape, classes, steps=2):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=list(img_shape),
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred = build(img)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.SGD(learning_rate=0.01).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(2, *img_shape).astype(np.float32),
+            "label": rng.randint(0, classes, (2, 1)).astype(np.int64)}
+    vals = [float(np.asarray(
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0]
+    ).reshape(-1)[0]) for _ in range(steps)]
+    assert all(np.isfinite(v) for v in vals), vals
+    return vals
+
+
+def test_alexnet_smoke():
+    # 67x67 input keeps conv chain valid (11/4 then 3 pool stages) and fast
+    _train_steps(lambda x: models.alexnet(x, class_dim=10), (3, 67, 67), 10)
+
+
+def test_googlenet_smoke():
+    _train_steps(lambda x: models.googlenet(x, class_dim=10), (3, 64, 64),
+                 10)
+
+
+def test_smallnet_smoke():
+    vals = _train_steps(
+        lambda x: models.smallnet_mnist_cifar(x, class_dim=10),
+        (3, 32, 32), 10, steps=8)
+    assert vals[-1] < vals[0] + 0.5  # sanity: not diverging

@@ -1,0 +1,74 @@
+"""NHWC (channels-last) data_format support for conv/pool/batch_norm.
+
+TPU-native addition (no reference analogue — the reference is
+NCHW/cuDNN-only): NHWC is the MXU/VPU-native conv layout; these tests pin
+layout equivalence against NCHW so the fast path can't drift numerically.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run_conv_pool_bn(data_format, x_nchw, seed=7):
+    rng = np.random.RandomState(seed)
+    w = rng.rand(8, 3, 3, 3).astype(np.float32) * 0.1
+    x = (x_nchw if data_format == "NCHW"
+         else np.transpose(x_nchw, (0, 2, 3, 1)))
+    shape = list(x.shape[1:])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=shape, dtype="float32")
+        conv = fluid.layers.conv2d(
+            input=img, num_filters=8, filter_size=3, padding=1,
+            param_attr={"name": "w_fixed"}, bias_attr=False, act="relu",
+            data_format=data_format)
+        bn = fluid.layers.batch_norm(input=conv, data_layout=data_format)
+        pool = fluid.layers.pool2d(input=bn, pool_size=2, pool_stride=2,
+                                   pool_type="avg",
+                                   data_format=data_format)
+        gpool = fluid.layers.pool2d(input=pool, pool_type="max",
+                                    global_pooling=True,
+                                    data_format=data_format)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    scope.set_var("w_fixed", w)
+    conv_v, pool_v, gp = exe.run(
+        main, feed={"img": x}, fetch_list=[conv, pool, gpool], scope=scope)
+    if data_format == "NHWC":
+        conv_v = np.transpose(conv_v, (0, 3, 1, 2))
+        pool_v = np.transpose(pool_v, (0, 3, 1, 2))
+        gp = np.transpose(gp, (0, 3, 1, 2))
+    return np.asarray(conv_v), np.asarray(pool_v), np.asarray(gp)
+
+
+def test_nhwc_matches_nchw():
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    a = _run_conv_pool_bn("NCHW", x)
+    b = _run_conv_pool_bn("NHWC", x)
+    for got, want in zip(b, a):
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_nhwc_trains():
+    import paddle_tpu.models.resnet as resnet
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[32, 32, 3],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred = resnet.resnet_imagenet(img, class_dim=10, depth=18,
+                                      data_format="NHWC")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.SGD(learning_rate=0.01).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    feed = {"img": rng.rand(4, 32, 32, 3).astype(np.float32),
+            "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+    l0, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    for _ in range(3):
+        l, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(l)).all()
