@@ -1,0 +1,68 @@
+"""Distributed fit-a-line with the pserver transpiler (env-var roles).
+
+Reference: tests/book_distribute/notest_dist_fit_a_line.py:43-78 — the
+same program built on every node; PSERVERS / TRAINING_ROLE /
+SERVER_ENDPOINT / PADDLE_INIT_TRAINER_ID (set by tools/launch.py) select
+what each process runs.
+
+    python tools/launch.py --pservers 2 --trainers 1 \
+        examples/dist_fit_a_line.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid
+
+
+def main():
+    pservers = os.environ["PSERVERS"]
+    role = os.environ["TRAINING_ROLE"]
+    trainers = int(os.environ.get("PADDLE_INIT_NUM_GRADIENT_SERVERS", "1"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        opt_ops, params_grads = fluid.SGD(
+            learning_rate=0.001).minimize(loss)
+
+        t = fluid.DistributeTranspiler()
+        t.transpile(optimize_ops=opt_ops, params_grads=params_grads,
+                    trainers=trainers, pservers=pservers)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        endpoint = os.environ["SERVER_ENDPOINT"]
+        exe.run(t.get_startup_program(endpoint))
+        exe.run(t.get_pserver_program(endpoint))  # serves until STOP
+        return
+
+    assert role == "TRAINER", role
+    exe.run(startup)
+    trainer_prog = t.get_trainer_program()
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(13, 1).astype(np.float32)
+    losses = []
+    for step in range(30):
+        xs = rng.rand(32, 13).astype(np.float32)
+        ys = xs @ w_true
+        lv, = exe.run(trainer_prog, feed={"x": xs, "y": ys},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    print(f"first loss {losses[0]:.5f} final loss {losses[-1]:.5f}")
+    if not losses[-1] < losses[0]:
+        raise SystemExit("loss did not decrease")
+    # pserver shutdown is the LAUNCHER's job (it terminates pservers once
+    # every trainer exits) — a trainer must never STOP the cluster itself,
+    # or the fastest trainer would kill it under still-running peers
+
+
+if __name__ == "__main__":
+    main()

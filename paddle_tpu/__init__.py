@@ -12,6 +12,7 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
 from . import (  # noqa: F401
     amp,
     clip,
+    concurrency,
     debugger,
     evaluator,
     image,
@@ -57,6 +58,14 @@ from .optimizer import (  # noqa: F401
     Ftrl,
     Momentum,
     RMSProp,
+)
+from .concurrency import (  # noqa: F401
+    Go,
+    channel_close,
+    channel_recv,
+    channel_send,
+    go,
+    make_channel,
 )
 from .data_feeder import DataFeeder  # noqa: F401
 from .memory_optimization_transpiler import memory_optimize  # noqa: F401

@@ -57,7 +57,17 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: int = 1, process_id: int = 0):
     """Multi-host bring-up (replaces etcd registration + gRPC endpoints):
     wires this process into the jax coordination service.  No-op for
-    single-process runs."""
+    single-process runs.
+
+    Arguments default from the PADDLE_TPU_{COORDINATOR,NUM_PROCESSES,
+    PROCESS_ID} env vars set by tools/launch.py --coordinator mode."""
+    import os
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("PADDLE_TPU_COORDINATOR")
+        if coordinator_address is not None:
+            num_processes = int(
+                os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+            process_id = int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0"))
     if num_processes <= 1:
         return
     jax.distributed.initialize(

@@ -239,10 +239,34 @@ class VariableServer:
 
 
 class VariableClient:
-    def __init__(self, endpoint: str, client_id: str = ""):
+    def __init__(self, endpoint: str, client_id: str = "",
+                 connect_timeout: float = 60.0):
+        import os
+        import time
+        import uuid
+
         host, port = endpoint.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=30)
-        _send_frame(self.sock, "HELLO", client_id or f"pid{id(self)}")
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self.sock = socket.create_connection(
+                    (host, int(port)), timeout=5)
+                break
+            except OSError:
+                # server process may still be booting (jax import +
+                # program build); retry until the deadline
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        # requests block indefinitely after the handshake: a BARRIER
+        # response legitimately waits for straggler trainers + the first
+        # optimize-program compile (sync-SGD semantics, like the
+        # reference's gRPC client Wait())
+        self.sock.settimeout(None)
+        # process-unique id: id(self) can collide ACROSS processes, which
+        # would alias two trainers to one per-trainer grad slot
+        cid = client_id or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        _send_frame(self.sock, "HELLO", cid)
         self._expect_ok()
 
     def _expect_ok(self):

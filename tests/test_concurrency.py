@@ -1,0 +1,84 @@
+"""CSP surface: make_channel / channel_send / channel_recv / go.
+
+Reference analogue: tests/notest_csp.py (the surface the reference
+declared but never implemented) + framework/channel_test.cc semantics —
+here backed by the native C++ channels, actually working.
+"""
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_buffered_channel_fifo_and_close():
+    ch = fluid.make_channel(dtype=int, capacity=3)
+    assert fluid.channel_send(ch, 1)
+    assert fluid.channel_send(ch, 2)
+    assert fluid.channel_send(ch, 3)
+    assert len(ch) == 3
+    assert fluid.channel_recv(ch) == 1
+    assert fluid.channel_recv(ch) == 2
+    fluid.channel_close(ch)
+    assert fluid.channel_recv(ch) == 3   # drain after close
+    assert fluid.channel_recv(ch) is None  # closed + drained
+    assert not fluid.channel_send(ch, 4)   # send on closed fails
+
+
+def test_unbuffered_channel_rendezvous():
+    ch = fluid.make_channel(dtype=str)
+    state = {"sent": False}
+
+    def sender():
+        fluid.channel_send(ch, "hello")
+        state["sent"] = True
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not state["sent"]  # blocked until a receiver arrives
+    assert fluid.channel_recv(ch) == "hello"
+    t.join(timeout=10)
+    assert state["sent"]
+    fluid.channel_close(ch)
+
+
+def test_channel_type_check_and_arrays():
+    ch = fluid.make_channel(dtype=int, capacity=1)
+    try:
+        fluid.channel_send(ch, "nope")
+        raise AssertionError("expected TypeError")
+    except TypeError:
+        pass
+    anych = fluid.make_channel(capacity=1)
+    x = np.arange(6).reshape(2, 3)
+    fluid.channel_send(anych, x)
+    np.testing.assert_array_equal(fluid.channel_recv(anych), x)
+
+
+def test_go_daisy_chain():
+    """The reference's CSP demo (notest_csp.py:19-33) at n=100: a chain of
+    goroutines each adding 1; leftmost receives n+1."""
+    n = 100
+    leftmost = fluid.make_channel(dtype=int)
+    left = leftmost
+    with fluid.go() as g:
+        for _ in range(n):
+            right = fluid.make_channel(dtype=int)
+            g(lambda l=left, r=right: fluid.channel_send(
+                l, 1 + fluid.channel_recv(r)))
+            left = right
+        g(lambda r=left: fluid.channel_send(r, 1))
+    got = fluid.channel_recv(leftmost)
+    g.wait(timeout=30)
+    assert got == n + 1, got
+
+
+def test_go_exception_surfaces_on_wait():
+    h = fluid.Go().spawn(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    try:
+        h.wait(timeout=10)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "boom" in str(e)

@@ -1,0 +1,39 @@
+"""tools/launch.py — the cluster launcher.
+
+Reference analogue: paddle/scripts/cluster_train/paddle.py (env-var
+launcher) + the book_distribute role convention; here the whole
+pserver-cluster flow runs as real subprocesses on localhost.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from launch import launch_pserver_cluster  # noqa: E402
+
+
+def test_launch_dist_fit_a_line(monkeypatch):
+    """2 pservers + 2 trainers, real processes, loss must decrease
+    (reference notest_dist_fit_a_line.py as a CI test).  Pservers are
+    terminated by the caller once trainers exit — the launcher main()'s
+    contract."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    procs = launch_pserver_cluster(
+        os.path.join(REPO, "examples", "dist_fit_a_line.py"), [],
+        n_pservers=2, n_trainers=2)
+    try:
+        rcs = [p.wait(timeout=240) for role, p in procs
+               if role == "trainer"]
+        assert all(rc == 0 for rc in rcs), rcs
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for _, p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()

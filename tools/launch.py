@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Cluster launcher — the reference's cluster_train scripts rebuilt.
+
+Reference: /root/reference/paddle/scripts/cluster_train/paddle.py (ssh
+fabric launcher setting PADDLE_INIT_* env), cluster_train_v2/{fabric,
+openmpi}, and the book_distribute env-var convention
+(tests/book_distribute/notest_dist_fit_a_line.py:43-60: PSERVERS /
+TRAINING_ROLE / SERVER_ENDPOINT / PADDLE_INIT_TRAINER_ID).
+
+Two modes:
+
+1. pserver cluster (CPU hosts, DistributeTranspiler pserver mode):
+       python tools/launch.py --pservers 2 --trainers 2 train.py [args...]
+   Spawns the script once per role-instance with the reference's env-var
+   convention; pserver endpoints are auto-assigned on localhost.  For a
+   multi-host cluster, pass --endpoints with ALL pserver endpoints and run
+   one launcher per host spawning only that host's share, using
+   --pserver-offset to pick which endpoints this host serves:
+       hostA$ launch.py --endpoints A:7164,B:7164 --pservers 1 \
+                  --pserver-offset 0 --trainers 2 train.py
+       hostB$ launch.py --endpoints A:7164,B:7164 --pservers 1 \
+                  --pserver-offset 1 --trainers 2 train.py
+
+2. multi-host SPMD (TPU pods, jax.distributed):
+       python tools/launch.py --coordinator host0:1234 --num-processes 4 \
+           --process-id 0 train.py [args...]
+   Exports JAX coordination env (the etcd-membership analogue) and execs
+   the script; paddle_tpu.parallel.init_distributed() picks it up.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+__all__ = ["launch_pserver_cluster"]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_pserver_cluster(script, script_args, n_pservers, n_trainers,
+                           endpoints=None, pserver_offset=0,
+                           python=sys.executable):
+    """Spawn pserver + trainer processes with the book_distribute env-var
+    convention; returns the list of (role, proc).
+
+    `endpoints` lists the FULL cluster's pservers; this call serves
+    eps[pserver_offset : pserver_offset+n_pservers] (multi-host: one call
+    per host with its own offset)."""
+    eps = (endpoints.split(",") if endpoints else
+           [f"127.0.0.1:{_free_port()}" for _ in range(n_pservers)])
+    if pserver_offset + n_pservers > len(eps):
+        raise ValueError(
+            f"--pservers {n_pservers} at offset {pserver_offset} exceeds "
+            f"the {len(eps)} endpoints given")
+    procs = []
+    for i, ep in enumerate(eps[pserver_offset:pserver_offset + n_pservers]):
+        env = dict(os.environ,
+                   PSERVERS=",".join(eps),
+                   TRAINING_ROLE="PSERVER",
+                   SERVER_ENDPOINT=ep,
+                   PADDLE_INIT_NUM_GRADIENT_SERVERS=str(n_trainers))
+        procs.append(("pserver",
+                      subprocess.Popen([python, script] + script_args,
+                                       env=env)))
+    for i in range(n_trainers):
+        env = dict(os.environ,
+                   PSERVERS=",".join(eps),
+                   TRAINING_ROLE="TRAINER",
+                   PADDLE_INIT_TRAINER_ID=str(i),
+                   PADDLE_INIT_NUM_GRADIENT_SERVERS=str(n_trainers))
+        procs.append(("trainer",
+                      subprocess.Popen([python, script] + script_args,
+                                       env=env)))
+    return procs
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--pservers", type=int, default=0)
+    ap.add_argument("--trainers", type=int, default=1)
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated pserver endpoints of the FULL "
+                         "cluster (default: auto-assign localhost ports)")
+    ap.add_argument("--pserver-offset", type=int, default=0,
+                    help="index into --endpoints of this host's first "
+                         "pserver (multi-host)")
+    ap.add_argument("--coordinator", default=None,
+                    help="jax.distributed coordinator address host:port")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+
+    if args.coordinator:
+        # multi-host SPMD: one process per host, env consumed by
+        # parallel.mesh.init_distributed()
+        if args.num_processes is None or args.process_id is None:
+            ap.error("--coordinator requires --num-processes and "
+                     "--process-id (otherwise each host silently runs an "
+                     "independent single-host job)")
+        env = dict(os.environ,
+                   PADDLE_TPU_COORDINATOR=args.coordinator,
+                   PADDLE_TPU_NUM_PROCESSES=str(args.num_processes),
+                   PADDLE_TPU_PROCESS_ID=str(args.process_id))
+        sys.exit(subprocess.call([sys.executable, args.script] +
+                                 args.script_args, env=env))
+
+    procs = launch_pserver_cluster(args.script, args.script_args,
+                                   args.pservers, args.trainers,
+                                   args.endpoints, args.pserver_offset)
+    rc = 0
+    # trainers finishing ends the job; pservers are then terminated
+    # (the reference's fabric launcher kills pservers the same way)
+    for role, p in procs:
+        if role == "trainer":
+            rc |= p.wait()
+    for role, p in procs:
+        if role == "pserver" and p.poll() is None:
+            p.terminate()
+    for role, p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
