@@ -21,6 +21,9 @@ from .tensor import fill_constant
 
 __all__ = [
     "While",
+    "Switch",
+    "ConditionalBlock",
+    "Print",
     "StaticRNN",
     "DynamicRNN",
     "IfElse",
@@ -645,3 +648,103 @@ class StaticRNN(_RNNBase):
     def step(self):
         with self.block():
             yield
+
+
+class ConditionalBlock:
+    """Thin wrapper over the conditional_block op (reference
+    layers/control_flow.py ConditionalBlock / conditional_block_op.cc):
+    runs the block iff every input is true/non-empty."""
+
+    def __init__(self, inputs, name=None, is_scalar_condition=False):
+        self.inputs = list(inputs)
+        self.is_scalar_condition = is_scalar_condition
+        self.helper = LayerHelper("conditional_block", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent = program.current_block
+        sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        parent.append_op(
+            "conditional_block",
+            {"X": [v.name for v in self.inputs], "Params": []},
+            {"Out": []},
+            {"sub_block": {"__block__": sub.idx},
+             "is_scalar_condition": self.is_scalar_condition})
+
+
+class Switch:
+    """Scalar-condition switch/case chain (reference
+    layers/control_flow.py Switch): the FIRST case whose condition is true
+    runs; default() runs when none matched.
+
+        with Switch() as switch:
+            with switch.case(cond1): ...
+            with switch.case(cond2): ...
+            with switch.default(): ...
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.pre_not_conditions = []
+        self.inside = False
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        if not self.inside:
+            raise ValueError("case() must be inside `with Switch()`")
+        from .ops import logical_and, logical_not
+        if self.pre_not_conditions:
+            pre = self.pre_not_conditions[-1]
+            cond = logical_and(x=pre, y=condition)
+        else:
+            cond = condition
+        not_cond = logical_not(x=condition)
+        if self.pre_not_conditions:
+            not_cond = logical_and(x=self.pre_not_conditions[-1],
+                                   y=not_cond)
+        self.pre_not_conditions.append(not_cond)
+        cb = ConditionalBlock([cond], is_scalar_condition=True)
+        with cb.block():
+            yield
+
+    @contextlib.contextmanager
+    def default(self):
+        if not self.pre_not_conditions:
+            raise ValueError("default() requires at least one case()")
+        cb = ConditionalBlock([self.pre_not_conditions[-1]],
+                              is_scalar_condition=True)
+        with cb.block():
+            yield
+
+    def __enter__(self):
+        self.inside = True
+        return self
+
+    def __exit__(self, *a):
+        self.inside = False
+        return False
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor when it is executed (reference
+    layers/control_flow.py:149 Print / print_op.cc)."""
+    helper = LayerHelper("print")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        "print", {"In": [input.name]}, {"Out": [out.name]},
+        {"first_n": first_n, "message": message or "",
+         "summarize": summarize,
+         "print_tensor_name": print_tensor_name,
+         "print_tensor_type": print_tensor_type,
+         "print_tensor_shape": print_tensor_shape,
+         "print_tensor_lod": print_tensor_lod,
+         "print_phase": print_phase.upper()})
+    return out

@@ -49,7 +49,13 @@ __all__ = [
     "autoincreased_step_counter",
     "smooth_l1",
     "dynamic_lstm",
+    "dynamic_lstmp",
     "dynamic_gru",
+    "gru_unit",
+    "lstm_unit",
+    "row_conv",
+    "multiplex",
+    "ctc_greedy_decoder",
     "sequence_conv",
     "sequence_pool",
     "sequence_first_step",
@@ -881,4 +887,131 @@ def im2sequence(input, filter_size=1, stride=1, padding=0):
                      {"kernels": list(fs), "strides": list(st),
                       "paddings": list(pd)})
     out.lod_level = 1
+    return out
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with recurrent projection (reference layers/nn.py:400
+    dynamic_lstmp / lstmp_op.cc).  `input` is a LoD var of width 4*hidden;
+    `size` = 4*hidden, `proj_size` = projection width."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(param_attr, [proj_size, 4 * hidden],
+                                     dtype, suffix="w")
+    proj_weight = helper.create_parameter(param_attr,
+                                          [hidden, proj_size], dtype,
+                                          suffix="proj_w")
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    bias = helper.create_parameter(bias_attr or {}, [1, bias_size], dtype,
+                                   is_bias=True, suffix="b")
+    proj = helper.create_tmp_variable(dtype)
+    cell = helper.create_tmp_variable(dtype)
+    bg = helper.create_tmp_variable(dtype, stop_gradient=True)
+    bh = helper.create_tmp_variable(dtype, stop_gradient=True)
+    bc = helper.create_tmp_variable(dtype, stop_gradient=True)
+    helper.append_op(
+        "lstmp",
+        {"Input": [input.name], "Weight": [weight.name],
+         "ProjWeight": [proj_weight.name], "Bias": [bias.name]},
+        {"Projection": [proj.name], "Cell": [cell.name],
+         "BatchGate": [bg.name], "BatchHidden": [bh.name],
+         "BatchCellPreAct": [bc.name]},
+        {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+         "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation,
+         "proj_activation": proj_activation})
+    return proj, cell
+
+
+def gru_unit(input, hidden, size, weight=None, bias=None, param_attr=None,
+             bias_attr=None, activation="tanh",
+             gate_activation="sigmoid"):
+    """Single GRU step (reference layers/nn.py:693 / gru_unit_op.cc);
+    `input` is the projected gate input of width `size` (= 3*hidden)."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    h = size // 3
+    if weight is None:
+        weight = helper.create_parameter(param_attr, [h, 3 * h], dtype,
+                                         suffix="w")
+    if bias is None:
+        bias = helper.create_parameter(bias_attr or {}, [1, 3 * h], dtype,
+                                       is_bias=True, suffix="b")
+    gate = helper.create_tmp_variable(dtype)
+    reset_hidden_pre = helper.create_tmp_variable(dtype)
+    updated_hidden = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        "gru_unit",
+        {"Input": [input.name], "HiddenPrev": [hidden.name],
+         "Weight": [weight.name], "Bias": [bias.name]},
+        {"Gate": [gate.name], "ResetHiddenPrev": [reset_hidden_pre.name],
+         "Hidden": [updated_hidden.name]},
+        {"activation": activation, "gate_activation": gate_activation})
+    return updated_hidden, reset_hidden_pre, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference layers/nn.py:1942 / lstm_unit_op.cc):
+    gates = fc([x_t, h_prev]); returns (h, c)."""
+    helper = LayerHelper("lstm_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    from .tensor import concat
+    dtype = x_t.dtype
+    size = hidden_t_prev.shape[-1]
+    concat_out = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(input=concat_out, size=4 * size, param_attr=param_attr,
+                bias_attr=bias_attr)
+    c = helper.create_tmp_variable(dtype)
+    h = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        "lstm_unit",
+        {"X": [fc_out.name], "C_prev": [cell_t_prev.name]},
+        {"C": [c.name], "H": [h.name]},
+        {"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference layers/nn.py:2993 /
+    row_conv_op.cc)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(param_attr, filter_shape, dtype, suffix="w")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("row_conv",
+                     {"X": [input.name], "Filter": [w.name]},
+                     {"Out": [out.name]}, {})
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    """Row-wise select across candidate tensors (reference multiplex_op)."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_tmp_variable(inputs[0].dtype)
+    helper.append_op(
+        "multiplex",
+        {"Ids": [index.name], "X": [v.name for v in inputs]},
+        {"Out": [out.name]}, {})
+    return out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Per-step argmax then CTC collapse (reference layers/nn.py:2579:
+    top_k(k=1) + ctc_align merge_repeated + blank removal)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    _, ids = topk(input, k=1)
+    out = helper.create_tmp_variable("int64")
+    out.lod_level = 1
+    helper.append_op(
+        "ctc_align", {"Input": [ids.name]}, {"Output": [out.name]},
+        {"blank": blank, "merge_repeated": True})
     return out

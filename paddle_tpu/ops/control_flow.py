@@ -711,3 +711,15 @@ def dynamic_rnn(ctx, ins, attrs):
     else:
         outs = list(ys)
     return {"Outs": outs}
+
+
+# `recurrent` (reference recurrent_op.cc, the static RNN) is the same
+# lowering as dynamic_rnn with is_dynamic=False — registered under both
+# names so reference-shaped programs resolve
+register_op("recurrent",
+            inputs=("StepInputs", "InitMemories", "StaticInputs",
+                    "Captured", "CapturedNoGrad"),
+            outputs=("Outs",), attrs={"is_dynamic": False},
+            diff_inputs=("StepInputs", "InitMemories", "StaticInputs",
+                         "Captured"),
+            diff_outputs=("Outs",))(dynamic_rnn)

@@ -106,37 +106,42 @@ def conv2d_transpose(ctx, ins, attrs):
     return {"Output": out}
 
 
-def _pool2d(x, attrs):
-    ptype = attrs.get("pooling_type", "max")
-    k = _pair(attrs.get("ksize", [2, 2]))
-    s = _pair(attrs.get("strides", [1, 1]))
-    p = _pair(attrs.get("paddings", [0, 0]))
+def _pool_window(x, attrs, rank):
+    """(window, strides, pads) for an N-spatial-dim pool; channels-last
+    supported for rank 2 via data_format."""
+    k = _pair(attrs.get("ksize", [2] * rank), rank)
+    s = _pair(attrs.get("strides", [1] * rank), rank)
+    p = _pair(attrs.get("paddings", [0] * rank), rank)
     nhwc = attrs.get("data_format", "NCHW") == "NHWC"
-    h_ax, w_ax = (1, 2) if nhwc else (2, 3)
+    sp_axes = (tuple(range(1, 1 + rank)) if nhwc
+               else tuple(range(2, 2 + rank)))
     if attrs.get("global_pooling"):
-        k = (x.shape[h_ax], x.shape[w_ax])
-        s, p = (1, 1), (0, 0)
+        k = tuple(x.shape[a] for a in sp_axes)
+        s, p = (1,) * rank, (0,) * rank
+    sp_pads = tuple((pi, pi) for pi in p)
     if nhwc:
-        window = (1,) + k + (1,)
-        strides = (1,) + s + (1,)
-        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
-    else:
-        window = (1, 1) + k
-        strides = (1, 1) + s
-        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+        return (1,) + k + (1,), (1,) + s + (1,), \
+            ((0, 0),) + sp_pads + ((0, 0),)
+    return (1, 1) + k, (1, 1) + s, ((0, 0), (0, 0)) + sp_pads
+
+
+def _pool(x, attrs, rank):
+    ptype = attrs.get("pooling_type", "max")
+    window, strides, pads = _pool_window(x, attrs, rank)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
-        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
-                                    pads)
-    else:
-        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add,
-                                     window, strides, pads)
-        ones = jnp.ones_like(x)
-        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
-                                    pads)
-        out = ssum / cnt
-    return out
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
+                                     pads)
+    ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                 pads)
+    cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                window, strides, pads)
+    return ssum / cnt
+
+
+def _pool2d(x, attrs):
+    return _pool(x, attrs, 2)
 
 
 @register_op("pool2d", inputs=("X",), outputs=("Out",),
@@ -154,17 +159,13 @@ def pool2d(ctx, ins, attrs):
                     "global_pooling": False},
              diff_outputs=("Out",))
 def max_pool2d_with_index(ctx, ins, attrs):
+    """Max pool + flat-spatial argmax per window in one variadic pass
+    (reference pool_with_index); int32 iota so indices stay exact."""
     x = data_of(one(ins, "X"))
-    out = _pool2d(x, {**attrs, "pooling_type": "max"})
-    # flat spatial argmax index per window (reference pool_with_index)
-    n, c, h, w = x.shape
-    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    h, w = x.shape[2:]
+    flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
     flat_idx = jnp.broadcast_to(flat_idx, x.shape)
-    k = _pair(attrs.get("ksize", [2, 2]))
-    s = _pair(attrs.get("strides", [1, 1]))
-    p = _pair(attrs.get("paddings", [0, 0]))
-    if attrs.get("global_pooling"):
-        k, s, p = (h, w), (1, 1), (0, 0)
+    window, strides, pads = _pool_window(x, attrs, 2)
 
     def sel(a, b):
         av, ai = a
@@ -172,10 +173,9 @@ def max_pool2d_with_index(ctx, ins, attrs):
         take_b = bv > av
         return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
 
-    vals, idxs = jax.lax.reduce_window(
-        (x, flat_idx), (-jnp.inf, jnp.float32(-1)), sel,
-        (1, 1) + k, (1, 1) + s,
-        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1, jnp.int32))
+    vals, idxs = jax.lax.reduce_window((x, flat_idx), init, sel,
+                                       window, strides, pads)
     return {"Out": vals, "Mask": idxs.astype(jnp.int64)}
 
 
@@ -251,3 +251,70 @@ def row_conv(ctx, ins, attrs):
     if isinstance(xv, LoDTensor):
         return {"Out": LoDTensor(out, xv.lod)}
     return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# 3D pooling + transposed conv3d (reference pool_op.cc REGISTER pool3d,
+# pool_with_index_op.cc max_pool3d_with_index, conv_transpose_op.cc
+# conv3d_transpose)
+# ---------------------------------------------------------------------------
+
+
+@register_op("pool3d", inputs=("X",), outputs=("Out",),
+             attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+                    "strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "global_pooling": False})
+def pool3d(ctx, ins, attrs):
+    return {"Out": _pool(data_of(one(ins, "X")), attrs, 3)}
+
+
+@register_op("max_pool3d_with_index", inputs=("X",),
+             outputs=("Out", "Mask"),
+             attrs={"ksize": [2, 2, 2], "strides": [1, 1, 1],
+                    "paddings": [0, 0, 0], "global_pooling": False},
+             diff_outputs=("Out",))
+def max_pool3d_with_index(ctx, ins, attrs):
+    """Max pool + flat-spatial argmax index per window in ONE variadic
+    reduce_window pass (reference pool_with_index_op.cc, 3D
+    registration).  The index iota is int32 — float32 iotas collapse
+    above 2^24 voxels."""
+    x = data_of(one(ins, "X"))
+    d, h, w = x.shape[2:]
+    flat = jnp.arange(d * h * w, dtype=jnp.int32).reshape(1, 1, d, h, w)
+    flat = jnp.broadcast_to(flat, x.shape)
+    window, strides, pads = _pool_window(x, attrs, 3)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1, jnp.int32))
+    out, idx = jax.lax.reduce_window((x, flat), init, sel, window, strides,
+                                     pads)
+    return {"Out": out, "Mask": idx}
+
+
+@register_op("conv3d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1]})
+def conv3d_transpose(ctx, ins, attrs):
+    """Gradient-of-conv formulation, 3D (reference conv_transpose_op.cc
+    conv3d_transpose registration); filter layout [C, M, kd, kh, kw]."""
+    x = data_of(one(ins, "Input"))        # [N, C, D, H, W]
+    w = data_of(one(ins, "Filter"))
+    x, w = amp_cast(x, w)
+    s = _pair(attrs["strides"], 3)
+    p = _pair(attrs["paddings"], 3)
+    d = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    ks = w.shape[2:]
+    ek = tuple((ks[i] - 1) * d[i] + 1 for i in range(3))
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1),
+        window_strides=(1, 1, 1),
+        padding=[(ek[i] - 1 - p[i], ek[i] - 1 - p[i]) for i in range(3)],
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}

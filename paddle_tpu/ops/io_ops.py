@@ -18,9 +18,10 @@ import json
 import os
 import struct
 
+import jax.numpy as jnp
 import numpy as np
 
-from ..core.execution import many, one
+from ..core.execution import data_of, many, one
 from ..core.lod import LoDTensor
 from ..core.registry import register_op
 
@@ -178,3 +179,138 @@ def load_combine_lower(ctx, ins, attrs):
             f"{len(out_names)}"
         )
     return {"Out": order[: len(out_names)]}
+
+
+# ---------------------------------------------------------------------------
+# C++-side reader pipeline + feed/fetch ops (reference framework/reader.h
+# ReaderBase/DecoratedReader, operators/create_reader_op.cc, read_op.cc,
+# feed_op.cc, fetch_op.cc).  Readers are host objects held in scope vars;
+# decorators wrap them like the reference's DecoratedReader chain.  The
+# executor normally feeds/fetches directly (no injected ops), but the ops
+# exist for program-level parity with reference-generated programs.
+# ---------------------------------------------------------------------------
+
+
+class _RandomDataReader:
+    """Uniform-random reader (create_random_data_generator_op.cc)."""
+
+    def __init__(self, shapes, low, high, seed=0):
+        self.shapes = shapes
+        self.low, self.high = low, high
+        self.rng = np.random.RandomState(seed)
+
+    def read_next(self):
+        return [self.rng.uniform(self.low, self.high, s).astype(np.float32)
+                for s in self.shapes]
+
+    def reset(self):
+        pass
+
+
+class _ShuffleReader:
+    def __init__(self, reader, buffer_size, seed=0):
+        self.reader = reader
+        self.buffer_size = buffer_size
+        self.rng = np.random.RandomState(seed)
+        self._buf = []
+
+    def read_next(self):
+        if not self._buf:
+            for _ in range(self.buffer_size):
+                item = self.reader.read_next()
+                if item is None:
+                    break
+                self._buf.append(item)
+            order = self.rng.permutation(len(self._buf))
+            self._buf = [self._buf[i] for i in order]
+        return self._buf.pop() if self._buf else None
+
+    def reset(self):
+        self._buf = []
+        self.reader.reset()
+
+
+class _BatchReader:
+    def __init__(self, reader, batch_size):
+        self.reader = reader
+        self.batch_size = batch_size
+
+    def read_next(self):
+        rows = []
+        for _ in range(self.batch_size):
+            item = self.reader.read_next()
+            if item is None:
+                break
+            rows.append(item)
+        if not rows:
+            return None
+        return [np.stack([r[i] for r in rows]) for i in range(len(rows[0]))]
+
+    def reset(self):
+        self.reader.reset()
+
+
+def _split_shapes(attrs):
+    concat = list(attrs["shape_concat"])
+    ranks = list(attrs["ranks"])
+    shapes, off = [], 0
+    for r in ranks:
+        shapes.append(tuple(int(d) for d in concat[off:off + r]))
+        off += r
+    return shapes
+
+
+@register_op("create_random_data_generator", inputs=(), outputs=("Out",),
+             attrs={"shape_concat": [], "ranks": [], "lod_levels": [],
+                    "min": 0.0, "max": 1.0, "seed": 0},
+             not_differentiable=True, host=True)
+def create_random_data_generator(ctx, ins, attrs):
+    return {"Out": _RandomDataReader(_split_shapes(attrs), attrs["min"],
+                                     attrs["max"], attrs.get("seed", 0))}
+
+
+@register_op("create_shuffle_reader", inputs=("UnderlyingReader",),
+             outputs=("Out",), attrs={"buffer_size": 64},
+             not_differentiable=True, host=True)
+def create_shuffle_reader(ctx, ins, attrs):
+    return {"Out": _ShuffleReader(one(ins, "UnderlyingReader"),
+                                  attrs["buffer_size"])}
+
+
+@register_op("create_batch_reader", inputs=("UnderlyingReader",),
+             outputs=("Out",), attrs={"batch_size": 1},
+             not_differentiable=True, host=True)
+def create_batch_reader(ctx, ins, attrs):
+    return {"Out": _BatchReader(one(ins, "UnderlyingReader"),
+                                attrs["batch_size"])}
+
+
+@register_op("read", inputs=("Reader",), outputs=("Out",),
+             not_differentiable=True, host=True)
+def read(ctx, ins, attrs):
+    """Pull the next item from a reader into the output vars
+    (reference read_op.cc).  Exhaustion raises EOFError — catchable by
+    drivers without PEP-479 StopIteration/generator interference."""
+    item = one(ins, "Reader").read_next()
+    if item is None:
+        raise EOFError("reader exhausted")
+    return {"Out": [jnp.asarray(x) for x in item]}
+
+
+@register_op("feed", inputs=("X",), outputs=("Out",),
+             attrs={"col": 0}, not_differentiable=True, host=True)
+def feed(ctx, ins, attrs):
+    """Copy feed-list column `col` into the output var (reference
+    feed_op.cc; the executor's direct feed path normally replaces this)."""
+    item = one(ins, "X")
+    if isinstance(item, (list, tuple)):
+        item = item[attrs.get("col", 0)]
+    return {"Out": item}
+
+
+@register_op("fetch", inputs=("X",), outputs=("Out",),
+             attrs={"col": 0}, not_differentiable=True, host=True)
+def fetch(ctx, ins, attrs):
+    """Copy a var into the fetch list, LoD intact (reference fetch_op.cc
+    copies the full LoDTensor)."""
+    return {"Out": one(ins, "X")}

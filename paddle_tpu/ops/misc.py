@@ -34,6 +34,9 @@ def is_empty(ctx, ins, attrs):
     return {"Out": jnp.asarray(x.size == 0)}
 
 
+_PRINT_COUNTS: dict = {}
+
+
 @register_op("print", inputs=("In",), outputs=("Out",),
              attrs={"first_n": -1, "message": "", "summarize": 20,
                     "print_tensor_name": True, "print_tensor_type": True,
@@ -41,8 +44,19 @@ def is_empty(ctx, ins, attrs):
                     "print_phase": "BOTH"},
              not_differentiable=True, host=True)
 def print_op(ctx, ins, attrs):
-    """Debug print (reference print_op.cc); identity pass-through."""
+    """Debug print (reference print_op.cc); identity pass-through.
+    `first_n` > 0 prints only the first n executions of this op instance;
+    `print_phase` BACKWARD suppresses forward printing (there is no
+    separate backward print here — the op is not differentiated)."""
     v = one(ins, "In")
+    if attrs.get("print_phase", "BOTH").upper() == "BACKWARD":
+        return {"Out": v}
+    first_n = int(attrs.get("first_n", -1))
+    if first_n > 0:
+        key = id(ctx.op)
+        _PRINT_COUNTS[key] = _PRINT_COUNTS.get(key, 0) + 1
+        if _PRINT_COUNTS[key] > first_n:
+            return {"Out": v}
     x = np.asarray(data_of(v))
     parts = [attrs.get("message") or ""]
     if attrs.get("print_tensor_name", True):
@@ -58,3 +72,131 @@ def print_op(ctx, ins, attrs):
     data = flat if (n < 0 or flat.size <= n) else flat[:n]
     print(" ".join(p for p in parts if p), "data:", data)
     return {"Out": v}
+
+
+# ---------------------------------------------------------------------------
+# small parity ops (reference fill_op.cc, sign_op.cc, minus_op.cc,
+# label_smooth_op.cc/.h, multiplex_op.cc/.h, rnn_memory_helper_op.cc,
+# get_places_op.cc, cond_op.cc, split_selected_rows_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sign", inputs=("X",), outputs=("Out",))
+def sign(ctx, ins, attrs):
+    xv = one(ins, "X")
+    return {"Out": with_lod_of(xv, jnp.sign(data_of(xv)))}
+
+
+@register_op("minus", inputs=("X", "Y"), outputs=("Out",))
+def minus(ctx, ins, attrs):
+    """Out = X - Y (reference minus_op.cc; no broadcast, unlike
+    elementwise_sub)."""
+    xv = one(ins, "X")
+    return {"Out": with_lod_of(xv, data_of(xv) - data_of(one(ins, "Y")))}
+
+
+@register_op("fill", inputs=(), outputs=("Out",),
+             attrs={"shape": [], "value": [], "dtype": "float32",
+                    "force_cpu": False},
+             not_differentiable=True)
+def fill(ctx, ins, attrs):
+    """Fill Out with the flat `value` list reshaped to `shape`
+    (reference fill_op.cc — the data-carrying cousin of fill_constant)."""
+    from ..core.types import np_dtype
+
+    data = np.asarray(attrs["value"], np_dtype(attrs.get("dtype",
+                                                         "float32")))
+    return {"Out": jnp.asarray(data.reshape(attrs["shape"]))}
+
+
+@register_op("label_smooth", inputs=("X", "PriorDist"), outputs=("Out",),
+             attrs={"epsilon": 0.0}, diff_inputs=("X",))
+def label_smooth(ctx, ins, attrs):
+    """(1-eps)*X + eps*prior (uniform 1/num_classes when PriorDist is
+    absent) — reference label_smooth_op.h:26-46."""
+    from ..core.execution import many
+
+    xv = one(ins, "X")
+    x = data_of(xv)
+    eps = attrs["epsilon"]
+    prior = many(ins, "PriorDist")
+    if prior:
+        out = (1.0 - eps) * x + eps * data_of(prior[0]).reshape(
+            (1,) * (x.ndim - 1) + (-1,))
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    return {"Out": with_lod_of(xv, out)}
+
+
+@register_op("multiplex", inputs=("Ids", "X"), outputs=("Out",),
+             diff_inputs=("X",))
+def multiplex(ctx, ins, attrs):
+    """Out[i] = X[Ids[i]][i] — per-row gather across candidate tensors
+    (reference multiplex_op.h)."""
+    from ..core.execution import many
+
+    ids = data_of(one(ins, "Ids")).reshape(-1).astype(jnp.int32)
+    xs = jnp.stack([data_of(x) for x in many(ins, "X")])  # [K, N, ...]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("rnn_memory_helper", inputs=("X",), outputs=("Out",))
+def rnn_memory_helper(ctx, ins, attrs):
+    """Identity pass-through (reference rnn_memory_helper_op.cc — exists
+    so RNN memories always have a grad slot; the generic VJP gives the
+    identity grad here for free)."""
+    return {"Out": data_of(one(ins, "X"))}
+
+
+@register_op("get_places", inputs=(), outputs=("Out",),
+             attrs={"device_count": 0, "device_type": ""},
+             not_differentiable=True, host=True)
+def get_places_op(ctx, ins, attrs):
+    """Materialize the device list as a host value (reference
+    get_places_op.cc)."""
+    from ..parallel.mesh import get_places
+
+    n = attrs.get("device_count") or None
+    return {"Out": get_places(n)}
+
+
+@register_op("cond", inputs=("Cond",), outputs=(),
+             not_differentiable=True, host=True)
+def cond(ctx, ins, attrs):
+    """Scalar-condition branch: run `sub_block` when Cond is true, else
+    `else_block` if given (reference cond_op.cc, the scope-based
+    predecessor of conditional_block)."""
+    from .control_flow import _truthy
+    from ..core.execution import run_op as _run_op
+
+    take = _truthy(one(ins, "Cond"))
+    sub = ctx.op.sub_block("sub_block" if take else "else_block")
+    if sub is None:
+        return {}
+    for op_ in sub.ops:
+        _run_op(ctx.root, op_, ctx.env)
+    return {}
+
+
+@register_op("split_selected_rows", inputs=("X",), outputs=("Out",),
+             attrs={"height_sections": []},
+             not_differentiable=True, host=True)
+def split_selected_rows(ctx, ins, attrs):
+    """Route SelectedRows rows into per-section outputs by row range
+    (reference split_selected_rows_op.h FindOutIdx) — the sparse-grad
+    sharding step of the pserver transpiler."""
+    from ..core.lod import SelectedRows
+
+    x = one(ins, "X")
+    sections = [int(s) for s in attrs["height_sections"]]
+    rows = np.asarray(x.rows).reshape(-1)
+    value = np.asarray(x.value)
+    offsets = np.cumsum([0] + sections)
+    outs = []
+    for k, h in enumerate(sections):
+        m = (rows >= offsets[k]) & (rows < offsets[k] + h)
+        outs.append(SelectedRows(
+            jnp.asarray(rows[m] - offsets[k]),
+            jnp.asarray(value[m]), h))
+    return {"Out": outs}

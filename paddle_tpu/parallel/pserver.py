@@ -240,7 +240,7 @@ class VariableServer:
 
 class VariableClient:
     def __init__(self, endpoint: str, client_id: str = "",
-                 connect_timeout: float = 60.0):
+                 connect_timeout: float = 180.0):
         import os
         import time
         import uuid

@@ -20,11 +20,15 @@ def test_launch_dist_fit_a_line(monkeypatch):
     terminated by the caller once trainers exit — the launcher main()'s
     contract."""
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # subprocesses don't need the conftest's 8 virtual devices — 1 device
+    # keeps the 4 fresh jax imports cheap under full-suite load
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=1")
     procs = launch_pserver_cluster(
         os.path.join(REPO, "examples", "dist_fit_a_line.py"), [],
         n_pservers=2, n_trainers=2)
     try:
-        rcs = [p.wait(timeout=240) for role, p in procs
+        rcs = [p.wait(timeout=480) for role, p in procs
                if role == "trainer"]
         assert all(rc == 0 for rc in rcs), rcs
     finally:
