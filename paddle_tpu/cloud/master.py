@@ -125,10 +125,12 @@ class MasterClient:
     Reconnects on socket failure — a trainer may outlive a restarted master
     (whose state comes back from its snapshot)."""
 
-    def __init__(self, addr: str, retry_interval: float = 0.2):
+    def __init__(self, addr: str, retry_interval: float = 0.2,
+                 timeout: float = 30.0):
         self.host, port = addr.rsplit(":", 1)
         self.port = int(port)
         self.retry_interval = retry_interval
+        self.timeout = timeout
         self._sock = None
         self._f = None
 
@@ -136,7 +138,7 @@ class MasterClient:
         if self._sock is not None:
             return
         self._sock = socket.create_connection(
-            (self.host, self.port), timeout=30
+            (self.host, self.port), timeout=self.timeout
         )
         self._f = self._sock.makefile("rw", newline="\n")
 

@@ -308,6 +308,10 @@ class Executor:
             return True
         if info.host:
             return True
+        if op.attrs.get("force_cpu"):
+            # init_on_cpu(): keep the op out of compiled device programs
+            # (its numpy result stays in host memory)
+            return True
         sub = op.sub_block() if "sub_block" in op.attrs else None
         return sub is not None and self._has_host_ops(sub)
 

@@ -6,9 +6,12 @@ uniform_random / gaussian_random ops into the startup block).
 """
 from __future__ import annotations
 
+import contextlib
 import math
 
 __all__ = [
+    "force_init_on_cpu",
+    "init_on_cpu",
     "Constant",
     "Uniform",
     "Normal",
@@ -35,7 +38,8 @@ class ConstantInitializer(Initializer):
         block.append_op(
             "fill_constant", {}, {"Out": [var.name]},
             {"shape": list(var.shape), "dtype": var.dtype,
-             "value": self.value})
+             "value": self.value,
+             "force_cpu": force_init_on_cpu()})
 
 
 class UniformInitializer(Initializer):
@@ -46,7 +50,8 @@ class UniformInitializer(Initializer):
         block.append_op(
             "uniform_random", {}, {"Out": [var.name]},
             {"shape": list(var.shape), "dtype": var.dtype,
-             "min": self.low, "max": self.high, "seed": self.seed})
+             "min": self.low, "max": self.high, "seed": self.seed,
+             "force_cpu": force_init_on_cpu()})
 
 
 class NormalInitializer(Initializer):
@@ -57,7 +62,8 @@ class NormalInitializer(Initializer):
         block.append_op(
             "gaussian_random", {}, {"Out": [var.name]},
             {"shape": list(var.shape), "dtype": var.dtype,
-             "mean": self.loc, "std": self.scale, "seed": self.seed})
+             "mean": self.loc, "std": self.scale, "seed": self.seed,
+             "force_cpu": force_init_on_cpu()})
 
 
 def _fan_in_out(var):
@@ -113,3 +119,29 @@ Uniform = UniformInitializer
 Normal = NormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
+
+
+# ---------------------------------------------------------------------------
+# init-on-cpu context (reference initializer.py:24-46).  On TPU the flag
+# marks init ops to run host-side (the interpreter path) — useful for huge
+# embeddings initialized once and sharded onto the mesh afterwards.
+# ---------------------------------------------------------------------------
+
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu() -> bool:
+    return _force_init_on_cpu_
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """`with init_on_cpu():` — initializer ops created inside carry
+    force_cpu=True (reference initializer.py init_on_cpu)."""
+    global _force_init_on_cpu_
+    pre_state = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = pre_state

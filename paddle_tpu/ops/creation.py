@@ -18,10 +18,16 @@ from ..core.types import np_dtype
 
 
 @register_op("fill_constant", outputs=("Out",),
-             attrs={"shape": [1], "value": 0.0, "dtype": "float32"},
+             attrs={"shape": [1], "value": 0.0, "dtype": "float32",
+                    "force_cpu": False},
              not_differentiable=True)
 def fill_constant(ctx, ins, attrs):
     dt = np_dtype(attrs["dtype"])
+    if attrs.get("force_cpu"):
+        # init_on_cpu(): materialize in host memory (numpy); the value
+        # moves to device only when a consumer needs it
+        return {"Out": np.full(tuple(attrs["shape"]), attrs["value"],
+                               dtype=dt)}
     return {"Out": jnp.full(tuple(attrs["shape"]), attrs["value"], dtype=dt)}
 
 
@@ -75,11 +81,17 @@ def increment(ctx, ins, attrs):
 
 @register_op("uniform_random", outputs=("Out",),
              attrs={"shape": [1], "min": -1.0, "max": 1.0, "seed": 0,
-                    "dtype": "float32"},
+                    "dtype": "float32", "force_cpu": False},
              random=True, not_differentiable=True)
 def uniform_random(ctx, ins, attrs):
-    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
     dt = np_dtype(attrs["dtype"])
+    if attrs.get("force_cpu"):
+        # init_on_cpu(): host numpy RNG (seeded) — keeps huge inits out of
+        # device memory; note the stream differs from the jax PRNG path
+        rng = np.random.RandomState(attrs.get("seed") or 0)
+        return {"Out": rng.uniform(attrs["min"], attrs["max"],
+                                   tuple(attrs["shape"])).astype(dt)}
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
     return {"Out": jax.random.uniform(
         key, tuple(attrs["shape"]), dtype=jnp.float32,
         minval=attrs["min"], maxval=attrs["max"]).astype(dt)}
@@ -87,11 +99,15 @@ def uniform_random(ctx, ins, attrs):
 
 @register_op("gaussian_random", outputs=("Out",),
              attrs={"shape": [1], "mean": 0.0, "std": 1.0, "seed": 0,
-                    "dtype": "float32"},
+                    "dtype": "float32", "force_cpu": False},
              random=True, not_differentiable=True)
 def gaussian_random(ctx, ins, attrs):
-    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
     dt = np_dtype(attrs["dtype"])
+    if attrs.get("force_cpu"):
+        rng = np.random.RandomState(attrs.get("seed") or 0)
+        return {"Out": (rng.standard_normal(tuple(attrs["shape"]))
+                        * attrs["std"] + attrs["mean"]).astype(dt)}
+    key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
     sample = jax.random.normal(key, tuple(attrs["shape"]), dtype=jnp.float32)
     return {"Out": (sample * attrs["std"] + attrs["mean"]).astype(dt)}
 

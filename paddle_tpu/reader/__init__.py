@@ -23,8 +23,12 @@ __all__ = [
     "cache",
     "batch",
     "native_pipeline",
+    "PipeReader",
     "ComposeNotAligned",
 ]
+
+
+from . import creator  # noqa: E402,F401
 
 
 class ComposeNotAligned(ValueError):
@@ -273,3 +277,58 @@ def native_pipeline(reader, slots, batch_size, shuffle_buf=0, seed=0,
 
     batch_reader.loader = loader
     return batch_reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (reference
+    decorator.py:337 PipeReader) — `cat file`, `curl url`,
+    `hadoop fs -cat ...`; file_type="gzip" decompresses inline."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import codecs
+        import shlex
+        import subprocess
+        import zlib
+
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError(f"file_type must be plain/gzip, got {file_type}")
+        self.file_type = file_type
+        if file_type == "gzip":
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        self.bufsize = bufsize
+        # incremental decoder: a multibyte char split across read chunks
+        # must not raise
+        self._decoder = codecs.getincrementaldecoder("utf-8")()
+        self.process = subprocess.Popen(
+            shlex.split(command), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def close(self):
+        """Terminate the child (early-stopping consumers must call this,
+        or the child blocks forever on a full pipe)."""
+        if self.process.poll() is None:
+            self.process.terminate()
+        self.process.wait()
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                buff = self.dec.decompress(buff)
+            decomp_buff = self._decoder.decode(buff)
+            if cut_lines:
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop(-1)
+                yield from lines
+            else:
+                yield decomp_buff
+        remained += self._decoder.decode(b"", final=True)
+        if remained:
+            yield remained
+        rc = self.process.wait()
+        if rc != 0:
+            raise RuntimeError(f"PipeReader command failed with exit {rc}")

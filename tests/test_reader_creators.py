@@ -1,0 +1,110 @@
+"""reader.creator (np_array/text_file/recordio/cloud_reader), PipeReader,
+and initializer.init_on_cpu.
+
+Reference analogues: python/paddle/v2/reader/creator.py + tests,
+decorator.py PipeReader, fluid/initializer.py init_on_cpu.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.reader import PipeReader, creator
+
+
+def test_np_array_and_text_file(tmp_path):
+    x = np.arange(6).reshape(3, 2)
+    rows = list(creator.np_array(x)())
+    assert len(rows) == 3 and (rows[1] == [2, 3]).all()
+    p = tmp_path / "t.txt"
+    p.write_text("a\nbb\nccc\n")
+    assert list(creator.text_file(str(p))()) == ["a", "bb", "ccc"]
+
+
+def test_recordio_roundtrip(tmp_path):
+    recs = [{"i": i, "x": list(range(i))} for i in range(10)]
+    path = str(tmp_path / "part-0")
+    assert creator.write_recordio(path, recs) == 10
+    back = list(creator.recordio(path)())
+    assert back == recs
+    # glob over shards
+    creator.write_recordio(str(tmp_path / "part-1"), recs[:3])
+    allb = list(creator.recordio(str(tmp_path / "part-*"))())
+    assert len(allb) == 13
+
+
+def test_cloud_reader_via_master(tmp_path):
+    """Chunks sharded by the native master; reader drains one pass
+    (reference cloud_reader over etcd/master)."""
+    from paddle_tpu.cloud.master import Master
+
+    paths = []
+    for k in range(3):
+        p = str(tmp_path / f"chunk-{k}")
+        creator.write_recordio(p, [(k, i) for i in range(4)])
+        paths.append(p)
+    m = Master(failure_max=2, timeout_s=30.0)
+    port = m.serve(0)
+    reader = creator.cloud_reader(str(tmp_path / "chunk-*"),
+                                  f"127.0.0.1:{port}")
+    got = sorted(list(reader()))
+    assert got == sorted((k, i) for k in range(3) for i in range(4))
+    reader.master_client.close()
+    m.stop()
+
+
+def test_pipe_reader_plain():
+    pr = PipeReader("printf 'a\\nbb\\nccc'")
+    assert list(pr.get_line()) == ["a", "bb", "ccc"]
+
+
+def test_init_on_cpu_flag():
+    from paddle_tpu import initializer
+
+    assert not initializer.force_init_on_cpu()
+    with initializer.init_on_cpu():
+        assert initializer.force_init_on_cpu()
+        with initializer.init_on_cpu():
+            assert initializer.force_init_on_cpu()
+        assert initializer.force_init_on_cpu()
+    assert not initializer.force_init_on_cpu()
+
+
+def test_pipe_reader_multibyte_and_errors(tmp_path):
+    p = tmp_path / "utf8.txt"
+    p.write_bytes(("a" * 15 + "\u00e9\nline2").encode("utf-8"))
+    pr = PipeReader(f'cat "{p}"', bufsize=16)
+    assert list(pr.get_line()) == ["a" * 15 + "\u00e9", "line2"]
+    # failing command surfaces its exit status
+    pr2 = PipeReader(f'cat "{tmp_path}/missing.txt"')
+    try:
+        list(pr2.get_line())
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+    # early stop: close() terminates the child
+    pr3 = PipeReader("yes")
+    g = pr3.get_line()
+    next(g)
+    pr3.close()
+    assert pr3.process.poll() is not None
+
+
+def test_init_on_cpu_materializes_on_host():
+    from paddle_tpu import initializer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with initializer.init_on_cpu():
+            w = fluid.layers.create_parameter(
+                shape=[4, 4], dtype="float32",
+                default_initializer=initializer.Uniform(-1, 1)) \
+                if hasattr(fluid.layers, "create_parameter") else None
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+    # the startup init ops inside the guard carry force_cpu
+    flagged = [op for op in startup.global_block().ops
+               if op.attrs.get("force_cpu")]
+    assert flagged, "no force_cpu init ops recorded"
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)  # host-segment execution works
+    del w, y
