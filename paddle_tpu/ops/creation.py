@@ -17,6 +17,20 @@ from ..core.registry import register_op
 from ..core.types import np_dtype
 
 
+def _host_seed(ctx, attrs) -> int:
+    """Seed for the force_cpu numpy RNG path: a seed=0 attr means "fresh
+    per op", so fold the (unique) output var name — otherwise every
+    unseeded init would draw an identical stream and all same-shape
+    params would come out bit-identical."""
+    import zlib
+
+    explicit = attrs.get("seed") or 0
+    if explicit:
+        return int(explicit)
+    name = ctx.op.output("Out")[0]
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
+
+
 @register_op("fill_constant", outputs=("Out",),
              attrs={"shape": [1], "value": 0.0, "dtype": "float32",
                     "force_cpu": False},
@@ -86,9 +100,9 @@ def increment(ctx, ins, attrs):
 def uniform_random(ctx, ins, attrs):
     dt = np_dtype(attrs["dtype"])
     if attrs.get("force_cpu"):
-        # init_on_cpu(): host numpy RNG (seeded) — keeps huge inits out of
-        # device memory; note the stream differs from the jax PRNG path
-        rng = np.random.RandomState(attrs.get("seed") or 0)
+        # init_on_cpu(): host numpy RNG — keeps huge inits out of device
+        # memory; the stream differs from the jax PRNG path
+        rng = np.random.RandomState(_host_seed(ctx, attrs))
         return {"Out": rng.uniform(attrs["min"], attrs["max"],
                                    tuple(attrs["shape"])).astype(dt)}
     key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())
@@ -104,7 +118,7 @@ def uniform_random(ctx, ins, attrs):
 def gaussian_random(ctx, ins, attrs):
     dt = np_dtype(attrs["dtype"])
     if attrs.get("force_cpu"):
-        rng = np.random.RandomState(attrs.get("seed") or 0)
+        rng = np.random.RandomState(_host_seed(ctx, attrs))
         return {"Out": (rng.standard_normal(tuple(attrs["shape"]))
                         * attrs["std"] + attrs["mean"]).astype(dt)}
     key = (jax.random.key(attrs["seed"]) if attrs.get("seed") else ctx.rng())

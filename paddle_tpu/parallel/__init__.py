@@ -17,6 +17,7 @@ from .mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from .moe import moe_ffn, moe_gate  # noqa: F401
 from .pipeline import (  # noqa: F401
     microbatch,
     spmd_pipeline,

@@ -1,0 +1,96 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+No reference analogue — the reference's closest machinery is the sparse
+remote embedding (SURVEY.md §2.5: rows live on pservers, prefetched by
+id).
+
+Design (Switch/GShard-style top-1 routing):
+  * static capacity per expert (`capacity_factor`) keeps shapes static
+    under jit; overflow tokens are dropped (their output is 0, the
+    residual path carries them), underflow is padding.
+  * gating and the dispatch/combine einsums run REPLICATED (the [T,E,C]
+    routing tensors are materialized on every device — cheap at these
+    contraction sizes); only the expert FFNs are sharded: shard_map
+    slices the [E,C,D] expert buffer over the 'ep' axis and the XLA
+    partitioner inserts the resulting collectives.
+  * differentiable end-to-end: routing uses one-hot matmuls (no gather
+    on the bwd path); an auxiliary load-balancing loss is returned.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["moe_ffn", "moe_gate"]
+
+
+def moe_gate(x, gate_w, num_experts: int, capacity: int):
+    """Top-1 (switch) gating.  x: [T, D]; gate_w: [D, E].
+
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights,
+    aux_loss scalar) — the GShard dispatch/combine tensor formulation,
+    fully differentiable."""
+    logits = x @ gate_w                                  # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)              # [T]
+    expert_1h = jax.nn.one_hot(expert_idx, num_experts,
+                               dtype=jnp.float32)        # [T, E]
+    gate_val = jnp.sum(probs * expert_1h, axis=-1)       # [T]
+
+    # position of each token within its expert's capacity buffer
+    pos_in_expert = (jnp.cumsum(expert_1h, axis=0) - 1.0) * expert_1h
+    pos = jnp.sum(pos_in_expert, axis=-1)                # [T]
+    keep = (pos < capacity).astype(jnp.float32)          # overflow -> drop
+    pos_1h = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)           # [T, C]
+
+    dispatch = expert_1h[:, :, None] * pos_1h[:, None, :] * \
+        keep[:, None, None]                              # [T, E, C]
+    combine = dispatch * gate_val[:, None, None]
+
+    # load-balance aux loss (Switch Transformer eq. 4): E * sum_e f_e * p_e
+    frac_tokens = jnp.mean(expert_1h, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, gate_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
+            capacity_factor: float = 1.25,
+            activation=jax.nn.relu) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel FFN layer.
+
+    x: [T, D] tokens (T divisible by nothing in particular),
+    gate_w: [D, E], w_in: [E, D, H], w_out: [E, H, D] with E divisible by
+    the 'ep' axis size.  Experts live sharded over `axis`; tokens are
+    dispatched with all_to_all and return the same way.
+
+    Returns (y [T, D], aux_loss)."""
+    E = gate_w.shape[1]
+    n = mesh.shape[axis]
+    assert E % n == 0, f"experts {E} must divide ep axis {n}"
+    T = x.shape[0]
+    capacity = max(1, int(capacity_factor * T / E))
+
+    dispatch, combine, aux = moe_gate(x, gate_w, E, capacity)
+    # expert inputs: [E, C, D] (one-hot contraction — differentiable)
+    expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                           dispatch).astype(x.dtype)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis))
+    def _experts(inp, wi, wo):
+        # inp: [E/n, C, D]; batched dense matmuls -> MXU
+        h = activation(jnp.einsum("ecd,edh->ech", inp, wi))
+        return jnp.einsum("ech,ehd->ecd", h, wo)
+
+    expert_out = _experts(expert_in, w_in, w_out)        # [E, C, D]
+    y = jnp.einsum("ecd,tec->td", expert_out.astype(jnp.float32),
+                   combine).astype(x.dtype)
+    return y, aux

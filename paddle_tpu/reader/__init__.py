@@ -311,6 +311,21 @@ class PipeReader:
             self.process.terminate()
         self.process.wait()
 
+    def _gunzip(self, buff):
+        """Decompress, restarting the decompressor at gzip member
+        boundaries — concatenated .gz parts (`cat a.gz b.gz`) must not
+        silently truncate after the first member."""
+        import zlib
+
+        out = b""
+        while buff:
+            out += self.dec.decompress(buff)
+            if not self.dec.eof:
+                break
+            buff = self.dec.unused_data
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        return out
+
     def get_line(self, cut_lines=True, line_break="\n"):
         remained = ""
         while True:
@@ -318,7 +333,7 @@ class PipeReader:
             if not buff:
                 break
             if self.file_type == "gzip":
-                buff = self.dec.decompress(buff)
+                buff = self._gunzip(buff)
             decomp_buff = self._decoder.decode(buff)
             if cut_lines:
                 lines = (remained + decomp_buff).split(line_break)

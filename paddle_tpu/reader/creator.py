@@ -63,16 +63,23 @@ def _read_recordio_file(path):
             yield pickle.loads(f.read(n))
 
 
-def recordio(paths, buf_size=100):
-    """Reader over recordio file paths — comma-separated string, glob
-    patterns supported (reference creator.py:60)."""
-    from . import buffered
-
+def _expand_paths(paths):
+    """Comma-separated string or list -> concrete file list (glob
+    patterns expanded; non-matching entries kept verbatim)."""
     if isinstance(paths, str):
         paths = paths.split(",")
     files = []
     for p in paths:
         files.extend(sorted(glob.glob(p)) or [p])
+    return files
+
+
+def recordio(paths, buf_size=100):
+    """Reader over recordio file paths — comma-separated string, glob
+    patterns supported (reference creator.py:60)."""
+    from . import buffered
+
+    files = _expand_paths(paths)
 
     def reader():
         for path in files:
@@ -89,11 +96,7 @@ def cloud_reader(paths, master_endpoint, timeout_sec=5, buf_size=64):
     from ..cloud.master import MasterClient, task_record_reader
     from . import buffered
 
-    if isinstance(paths, str):
-        paths = paths.split(",")
-    files = []
-    for p in paths:
-        files.extend(sorted(glob.glob(p)) or [p])
+    files = _expand_paths(paths)
     client = MasterClient(master_endpoint, timeout=timeout_sec)
     client.set_dataset(files)
 
