@@ -68,6 +68,7 @@ from .concurrency import (  # noqa: F401
     make_channel,
 )
 from .data_feeder import DataFeeder  # noqa: F401
+from .parameters import Parameters  # noqa: F401
 from .memory_optimization_transpiler import memory_optimize  # noqa: F401
 from .parallel.executor import (  # noqa: F401
     DistributeTranspiler,

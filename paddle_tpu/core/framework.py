@@ -165,6 +165,9 @@ class Parameter(Variable):
         self.regularizer = kw.pop("regularizer", None)
         self.gradient_clip_attr = kw.pop("gradient_clip_attr", None)
         self.do_model_average = kw.pop("do_model_average", None)
+        # [{"type": "pruning", "sparsity_ratio": r}, ...] — reference
+        # ParameterUpdaterHook.cpp (ParameterConfig.update_hooks)
+        self.update_hooks = kw.pop("update_hooks", None)
         super().__init__(
             block, name, shape=shape, dtype=dtype, persistable=True, **kw
         )

@@ -84,6 +84,8 @@ class LayerHelper:
             regularizer=attr.get("regularizer"),
             gradient_clip_attr=attr.get("gradient_clip_attr"),
             optimize_attr={"learning_rate": attr.get("learning_rate", 1.0)},
+            update_hooks=attr.get("update_hooks"),
+            do_model_average=attr.get("do_model_average"),
         )
         # mirror into startup program + emit its init op there
         sb = self.startup_program.global_block()

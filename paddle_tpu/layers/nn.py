@@ -26,6 +26,7 @@ __all__ = [
     "warpctc",
     "ctc_align",
     "nce",
+    "hsigmoid",
     "chunk_eval",
     "conv2d",
     "conv2d_transpose",
@@ -314,6 +315,32 @@ def nce(input, label, num_total_classes, sample_weight=None,
          "num_neg_samples": int(num_neg_samples)})
     cost.shape = (-1, 1)
     return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None):
+    """Hierarchical sigmoid cost, [batch, 1] (reference
+    gserver/layers/HierarchicalSigmoidLayer.cpp — the one sampled-softmax
+    variant the reference keeps legacy-only)."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr, [num_classes - 1, dim],
+                                input.dtype, suffix="w")
+    b = None
+    if bias_attr is not False:
+        ba = {} if bias_attr in (None, True) else dict(bias_attr)
+        b = helper.create_parameter(ba, [num_classes - 1], input.dtype,
+                                    is_bias=True, suffix="b")
+    out = helper.create_tmp_variable(input.dtype)
+    pre_out = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    inputs = {"X": [input.name], "Label": [label.name], "W": [w.name]}
+    if b is not None:
+        inputs["Bias"] = [b.name]
+    helper.append_op("hsigmoid", inputs,
+                     {"Out": [out.name], "PreOut": [pre_out.name]},
+                     {"num_classes": int(num_classes)})
+    out.shape = (-1, 1)
+    return out
 
 
 def auc(input, label, curve="ROC", num_thresholds=200):

@@ -200,3 +200,18 @@ def split_selected_rows(ctx, ins, attrs):
             jnp.asarray(rows[m] - offsets[k]),
             jnp.asarray(value[m]), h))
     return {"Out": outs}
+
+
+@register_op("pruning_mask", inputs=("Param",), outputs=("Mask",),
+             attrs={"sparsity_ratio": 0.6}, not_differentiable=True)
+def pruning_mask(ctx, ins, attrs):
+    """0/1 mask keeping the largest-magnitude (1-ratio) fraction of the
+    parameter (reference parameter/ParameterUpdaterHook.cpp
+    StaticPruningHook::generateMask — sorts |param| and zeroes the bottom
+    sparsity_ratio quantile)."""
+    p = data_of(one(ins, "Param"))
+    ratio = float(attrs["sparsity_ratio"])
+    a = jnp.abs(p.astype(jnp.float32)).reshape(-1)
+    thr = jnp.quantile(a, jnp.clip(ratio, 0.0, 1.0))
+    return {"Mask": (jnp.abs(p.astype(jnp.float32)) >= thr)
+            .astype(p.dtype).reshape(p.shape)}

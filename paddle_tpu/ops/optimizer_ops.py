@@ -253,3 +253,60 @@ def proximal_adagrad(ctx, ins, attrs):
     p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
              / (1.0 + lr_t * l2))
     return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("average_accumulates",
+             inputs=("Param", "InSum1", "InSum2", "InSum3",
+                     "InNumAccumulates", "InOldNumAccumulates",
+                     "InNumUpdates"),
+             outputs=("OutSum1", "OutSum2", "OutSum3",
+                      "OutNumAccumulates", "OutOldNumAccumulates",
+                      "OutNumUpdates"),
+             attrs={"average_window": 0.15, "min_average_window": 10000,
+                    "max_average_window": 10000},
+             inplace={"OutSum1": "InSum1", "OutSum2": "InSum2",
+                      "OutSum3": "InSum3",
+                      "OutNumAccumulates": "InNumAccumulates",
+                      "OutOldNumAccumulates": "InOldNumAccumulates",
+                      "OutNumUpdates": "InNumUpdates"},
+             not_differentiable=True)
+def average_accumulates(ctx, ins, attrs):
+    """Windowed parameter-sum accumulation for Polyak averaging.
+
+    Reference semantics: paddle/parameter/AverageOptimizer.cpp (legacy
+    AverageOptimizer windowing — kMaxNumAccumulates chunked sums, window =
+    min(max_average_window, num_updates * average_window) once past
+    min_average_window).  All branch logic is jnp.where on scalars, so the
+    op stays a single fused XLA kernel per parameter.
+    """
+    k_max_chunk = 16384
+    p = data_of(one(ins, "Param"))
+    s1 = data_of(one(ins, "InSum1"))
+    s2 = data_of(one(ins, "InSum2"))
+    s3 = data_of(one(ins, "InSum3"))
+    num_acc = data_of(one(ins, "InNumAccumulates")).reshape(())
+    old_num = data_of(one(ins, "InOldNumAccumulates")).reshape(())
+    num_upd = data_of(one(ins, "InNumUpdates")).reshape(())
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+    # fold a full chunk of step-sums into sum_2 to bound fp error growth
+    fold = (num_upd % k_max_chunk) == 0
+    s2 = jnp.where(fold, s2 + s1, s2)
+    s1 = jnp.where(fold, jnp.zeros_like(s1), s1)
+    # window rollover: snapshot the finished window into sum_3
+    window = jnp.minimum(
+        jnp.asarray(float(attrs["max_average_window"]), jnp.float32),
+        num_upd.astype(jnp.float32) * float(attrs["average_window"]))
+    roll = ((num_acc >= int(attrs["min_average_window"]))
+            & (num_acc.astype(jnp.float32) >= window))
+    s3 = jnp.where(roll, s1 + s2, s3)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(roll, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(roll, num_acc, old_num)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    return {"OutSum1": s1, "OutSum2": s2, "OutSum3": s3,
+            "OutNumAccumulates": num_acc.reshape(1),
+            "OutOldNumAccumulates": old_num.reshape(1),
+            "OutNumUpdates": num_upd.reshape(1)}

@@ -19,6 +19,56 @@ from ..core.execution import data_of, one
 from ..core.registry import register_op
 
 
+@register_op("hsigmoid",
+             inputs=("X", "W", "Label", "Bias"),
+             outputs=("Out", "PreOut"),
+             attrs={"num_classes": 2},
+             diff_inputs=("X", "W", "Bias"),
+             diff_outputs=("Out",))
+def hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over a complete binary tree of classes.
+
+    Reference: /root/reference/paddle/gserver/layers/HierarchicalSigmoidLayer.cpp
+    and paddle/math/MatrixBitCode.cpp (SimpleCode: c = label + num_classes;
+    node index at bit j = (c >> (j+1)) - 1; branch bit = (c >> j) & 1; path
+    length = bit_length(c) - 1).  Per-sample cost is the sum of
+    sigmoid-cross-entropies along the label's root-to-leaf path:
+        cost = Σ_j softplus(pre_j) - bit_j · pre_j,  pre clipped to ±40.
+    Unlike the reference (which also softplus-es zero-padded lanes, adding a
+    constant log 2 per padding lane), padding lanes are fully masked out.
+
+    The whole path is gathered at once (W[idx] is one XLA gather feeding a
+    batched dot), so the tree walk costs two MXU-friendly ops, not a scalar
+    loop; grads (scatter-add into W) come from the generic VJP.
+    """
+    x = data_of(one(ins, "X"))                  # [B, D]
+    w = data_of(one(ins, "W"))                  # [K-1, D]
+    label = data_of(one(ins, "Label")).reshape(-1)  # [B] int
+    bias = one(ins, "Bias")
+    K = int(attrs["num_classes"])
+    max_len = max((K - 1).bit_length(), 1)
+
+    c = label.astype(jnp.int32) + K             # codes in [K, 2K)
+    j = jnp.arange(max_len, dtype=jnp.int32)    # [L]
+    idx = (c[:, None] >> (j + 1)) - 1           # [B, L] internal-node ids
+    bit = ((c[:, None] >> j) & 1).astype(x.dtype)
+    # path length = bit_length(c) - 1, computed without float log2
+    length = jnp.zeros_like(c)
+    for k in range(1, (2 * K).bit_length() + 1):
+        length = length + (c >= (1 << k)).astype(c.dtype)
+    valid = (j[None, :] < length[:, None])      # [B, L]
+    idx = jnp.clip(idx, 0, K - 2)
+
+    pre = jnp.einsum("bd,bld->bl", x, w[idx])   # [B, L]
+    if bias is not None:
+        pre = pre + data_of(bias).reshape(-1)[idx]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    pre = jnp.where(valid, pre, 0.0)
+    cost = jnp.sum(jnp.where(valid, jax.nn.softplus(pre) - bit * pre, 0.0),
+                   axis=1)
+    return {"Out": cost[:, None], "PreOut": pre}
+
+
 @register_op("nce",
              inputs=("Input", "Label", "Weight", "Bias", "SampleWeight"),
              outputs=("Cost", "SampleLogits", "SampleLabels"),

@@ -38,6 +38,7 @@ __all__ = [
     "RMSPropOptimizer",
     "Ftrl",
     "FtrlOptimizer",
+    "ModelAverage",
 ]
 
 
@@ -94,6 +95,36 @@ class Optimizer:
     def _get_accumulator(self, name, param):
         return self._accumulators[name][param.name]
 
+    def _append_update_hooks(self, block, param):
+        """Parameter update hooks (reference
+        parameter/ParameterUpdaterHook.cpp, attached via ParameterConfig
+        update_hooks).  'pruning': a static mask from the initial weight
+        magnitudes re-applied after every optimizer step."""
+        for hook in getattr(param, "update_hooks", None) or ():
+            if hook.get("type") != "pruning":
+                raise ValueError(f"unknown update hook {hook!r}")
+            mask_name = f"{param.name}_prune_mask"
+            gb = block.program.global_block()
+            if not gb.has_var(mask_name):
+                gb.create_var(name=mask_name, shape=list(param.shape),
+                              dtype=param.dtype, persistable=True,
+                              stop_gradient=True)
+                sb = (self._startup_program or
+                      default_startup_program()).global_block()
+                sb.create_var(name=mask_name, shape=list(param.shape),
+                              dtype=param.dtype, persistable=True)
+                sb.append_op("pruning_mask", {"Param": [param.name]},
+                             {"Mask": [mask_name]},
+                             {"sparsity_ratio":
+                              float(hook.get("sparsity_ratio", 0.6))})
+                # static pruning starts from a pruned net
+                sb.append_op("elementwise_mul",
+                             {"X": [param.name], "Y": [mask_name]},
+                             {"Out": [param.name]}, {"axis": -1})
+            block.append_op("elementwise_mul",
+                            {"X": [param.name], "Y": [mask_name]},
+                            {"Out": [param.name]}, {"axis": -1})
+
     # -- hooks ---------------------------------------------------------------
     def _create_accumulators(self, block, parameters):
         pass
@@ -122,6 +153,7 @@ class Optimizer:
             if g is None:
                 continue
             self._append_optimize_op(block, (p, g))
+            self._append_update_hooks(block, p)
         self._finish_update(block)
         if self._global_step is not None:
             block.append_op("increment",
@@ -365,6 +397,124 @@ class FtrlOptimizer(Optimizer):
             {"ParamOut": [p.name], "SquaredAccumOut": [sq.name],
              "LinearAccumOut": [lin.name]},
             {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class ModelAverage(Optimizer):
+    """Polyak/windowed parameter averaging for evaluation.
+
+    Reference: paddle/parameter/AverageOptimizer.cpp (legacy
+    `AverageOptimizer`/`AverageSparseOptimizer`, enabled by the
+    `average_window` setting in trainer configs).  Appends one
+    `average_accumulates` op per parameter to the main program (run after
+    the optimizer update ops), then `apply()` temporarily swaps parameters
+    for their window average and `restore()` puts the trained values back.
+
+        model_average = fluid.optimizer.ModelAverage(0.15)
+        ... train ...
+        with model_average.apply(exe):
+            evaluate()
+    """
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, program=None,
+                 startup_program=None, **kw):
+        super().__init__(0.0, **kw)
+        from .core.framework import Parameter, default_main_program
+
+        self._avg_window = float(average_window_rate)
+        self._min_window = int(min_average_window)
+        self._max_window = int(max_average_window)
+        program = program or default_main_program()
+        self._program = program
+        self._startup_program = startup_program
+        gb = program.global_block()
+        self._params = sorted(
+            (v for v in gb.vars.values() if isinstance(v, Parameter)
+             and getattr(v, "do_model_average", None) is not False),
+            key=lambda v: v.name)
+        self._restore_backup = None
+        for p in self._params:
+            self._add_accumulator("sum_1", p, dtype="float32")
+            self._add_accumulator("sum_2", p, dtype="float32")
+            self._add_accumulator("sum_3", p, dtype="float32")
+            for cname in ("num_accumulates", "old_num_accumulates",
+                          "num_updates"):
+                self._add_accumulator(cname, p, shape=[1], dtype="int32")
+            gb.append_op(
+                "average_accumulates",
+                {"Param": [p.name],
+                 "InSum1": [self._get_accumulator("sum_1", p).name],
+                 "InSum2": [self._get_accumulator("sum_2", p).name],
+                 "InSum3": [self._get_accumulator("sum_3", p).name],
+                 "InNumAccumulates":
+                     [self._get_accumulator("num_accumulates", p).name],
+                 "InOldNumAccumulates":
+                     [self._get_accumulator("old_num_accumulates", p).name],
+                 "InNumUpdates":
+                     [self._get_accumulator("num_updates", p).name]},
+                {"OutSum1": [self._get_accumulator("sum_1", p).name],
+                 "OutSum2": [self._get_accumulator("sum_2", p).name],
+                 "OutSum3": [self._get_accumulator("sum_3", p).name],
+                 "OutNumAccumulates":
+                     [self._get_accumulator("num_accumulates", p).name],
+                 "OutOldNumAccumulates":
+                     [self._get_accumulator("old_num_accumulates", p).name],
+                 "OutNumUpdates":
+                     [self._get_accumulator("num_updates", p).name]},
+                {"average_window": self._avg_window,
+                 "min_average_window": self._min_window,
+                 "max_average_window": self._max_window})
+        program.bump_version()
+
+    def _averaged_value(self, p, scope):
+        import numpy as np
+
+        s = sum(np.asarray(
+            scope.find_var(self._get_accumulator(n, p).name),
+            dtype=np.float64) for n in ("sum_1", "sum_2", "sum_3"))
+        cnt = sum(int(np.asarray(
+            scope.find_var(self._get_accumulator(n, p).name)).reshape(()))
+            for n in ("num_accumulates", "old_num_accumulates"))
+        if cnt == 0:
+            return None
+        return (s / cnt).astype(p.dtype)
+
+    def apply(self, executor=None, need_restore=True, scope=None):
+        """Context manager: params <- window average inside, original
+        values back on exit (when need_restore)."""
+        import contextlib
+
+        import numpy as np
+
+        from .core.executor import global_scope
+
+        scope = scope or global_scope()
+
+        @contextlib.contextmanager
+        def _ctx():
+            backup = {}
+            for p in self._params:
+                avg = self._averaged_value(p, scope)
+                if avg is None:
+                    continue
+                backup[p.name] = np.asarray(scope.find_var(p.name)).copy()
+                scope.set_var(p.name, avg)
+            self._restore_backup = backup
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor, scope=scope)
+
+        return _ctx()
+
+    def restore(self, executor=None, scope=None):
+        from .core.executor import global_scope
+
+        scope = scope or global_scope()
+        for name, value in (self._restore_backup or {}).items():
+            scope.set_var(name, value)
+        self._restore_backup = None
 
 
 SGD = SGDOptimizer

@@ -1,0 +1,33 @@
+"""Endpoint-assignment policies for pserver-mode transpiling.
+
+Reference: /root/reference/python/paddle/v2/fluid/distributed_spliter.py
+(hash_name :17, round_robin :34).  Each policy maps a list of variables to
+one pserver endpoint per variable.
+"""
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["hash_name", "round_robin"]
+
+
+def _stable_hash(name: str) -> int:
+    # python's builtin hash() is salted per-process; trainers and pservers
+    # must agree on placement across processes, so hash the name stably
+    return int.from_bytes(hashlib.md5(name.encode()).digest()[:8], "little")
+
+
+def hash_name(varlist, pserver_endpoints):
+    """Assign each var to endpoint[stable_hash(var.name) % n]."""
+    return [pserver_endpoints[_stable_hash(v.name) % len(pserver_endpoints)]
+            for v in varlist]
+
+
+def round_robin(varlist, pserver_endpoints):
+    """Cycle endpoints in var order (reference round_robin :34)."""
+    eps = []
+    i = 0
+    for _ in varlist:
+        eps.append(pserver_endpoints[i])
+        i = (i + 1) % len(pserver_endpoints)
+    return eps

@@ -205,7 +205,8 @@ class DistributeTranspiler:
                   trainers=1, pservers: str = "", program=None,
                   startup_program=None,
                   mesh_axes: Optional[Dict[str, int]] = None,
-                  shard_optimizer_states: bool = True):
+                  shard_optimizer_states: bool = True,
+                  split_method=None):
         from ..core.framework import default_main_program
 
         self._program = program or default_main_program()
@@ -220,18 +221,22 @@ class DistributeTranspiler:
         self._optimize_ops = list(optimize_ops or [])
         self._trainers = trainers
         if self._endpoints and params_grads:
-            self._transpile_pserver(list(params_grads))
+            self._transpile_pserver(list(params_grads), split_method)
 
     # -- real pserver mode (multi-process CPU clusters / host-side path) ----
-    def _transpile_pserver(self, params_grads):
+    def _transpile_pserver(self, params_grads, split_method=None):
         """Rewrite the trainer program: optimizer ops out, send ops in
-        (reference distribute_transpiler.py:134-231; whole-param
-        round-robin placement as in distribute_transpiler_simple.py +
-        distributed_spliter.round_robin)."""
+        (reference distribute_transpiler.py:134-231; whole-param placement
+        per a distributed_spliter policy, default round_robin as in
+        distribute_transpiler_simple.py)."""
+        from . import distributed_spliter
+
+        if split_method is None:
+            split_method = distributed_spliter.round_robin
         eps = self._endpoints
         self._pairs_by_ep = {ep: [] for ep in eps}
-        for i, (p, g) in enumerate(params_grads):
-            ep = eps[i % len(eps)]
+        placement = split_method([p for p, _ in params_grads], eps)
+        for (p, g), ep in zip(params_grads, placement):
             self._assign[p.name] = ep
             self._pairs_by_ep[ep].append((p, g))
 

@@ -66,8 +66,10 @@ def moe_ffn(x, gate_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
 
     x: [T, D] tokens (T divisible by nothing in particular),
     gate_w: [D, E], w_in: [E, D, H], w_out: [E, H, D] with E divisible by
-    the 'ep' axis size.  Experts live sharded over `axis`; tokens are
-    dispatched with all_to_all and return the same way.
+    the 'ep' axis size.  Only the expert FFNs are sharded (over `axis`);
+    gating and the [T,E,C] dispatch/combine einsums run replicated, and
+    XLA's partitioner inserts the ep-axis collectives around the expert
+    matmuls (see the module docstring for the sizing implications).
 
     Returns (y [T, D], aux_loss)."""
     E = gate_w.shape[1]

@@ -1,0 +1,128 @@
+"""v2-style ``Parameters`` facade: name-addressed access to a model's
+parameters plus single-file tar round-tripping.
+
+Reference: /root/reference/python/paddle/v2/parameters.py (keys :116,
+get/set :200-239, to_tar :242, from_tar :274, init_from_tar :300).  The
+reference stores each parameter as a ParameterConfig proto + raw bytes in a
+tar; here each member is a ``.npy`` (dtype+shape self-describing) plus a
+``meta.json`` manifest, and values live in a Scope instead of the gserver
+GradientMachine.
+"""
+from __future__ import annotations
+
+import io as _io
+import json
+import tarfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .core.framework import Parameter, Program, default_main_program
+from .core.executor import global_scope
+from .core.scope import Scope
+
+__all__ = ["Parameters"]
+
+
+class Parameters:
+    """Dict-like view over the parameter variables of a ``Program``.
+
+    Values are read/written through a ``Scope`` (the runtime store), so a
+    ``Parameters`` handle stays live: mutations made by training are visible
+    through ``get`` and ``set`` writes feed subsequent runs.
+    """
+
+    def __init__(self, program: Optional[Program] = None,
+                 scope: Optional[Scope] = None):
+        self._program = program or default_main_program()
+        self._scope = scope or global_scope()
+
+    # -- introspection ----------------------------------------------------
+    def _param_vars(self) -> Dict[str, object]:
+        out = {}
+        for block in self._program.blocks:
+            for var in block.vars.values():
+                if isinstance(var, Parameter):
+                    out.setdefault(var.name, var)
+        return out
+
+    def names(self) -> List[str]:
+        return sorted(self._param_vars())
+
+    keys = names
+
+    def has_key(self, name: str) -> bool:
+        return name in self._param_vars()
+
+    def __contains__(self, name: str) -> bool:
+        return self.has_key(name)
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __len__(self):
+        return len(self._param_vars())
+
+    def get_shape(self, name: str):
+        var = self._param_vars()[name]
+        return tuple(int(d) for d in var.shape)
+
+    # -- value access -----------------------------------------------------
+    def get(self, name: str) -> np.ndarray:
+        var = self._scope.find_var(name)
+        if var is None:
+            raise KeyError(f"parameter '{name}' has no value in scope "
+                           "(run the startup program first)")
+        return np.asarray(var)
+
+    __getitem__ = get
+
+    def set(self, name: str, value) -> None:
+        if name not in self._param_vars():
+            raise KeyError(f"'{name}' is not a parameter of the program")
+        value = np.asarray(value)
+        shape = self.get_shape(name)
+        if tuple(value.shape) != shape:
+            raise ValueError(
+                f"shape mismatch for '{name}': got {value.shape}, "
+                f"parameter is {shape}")
+        self._scope.set_var(name, value)
+
+    __setitem__ = set
+
+    # -- serialization ----------------------------------------------------
+    def to_tar(self, f) -> None:
+        """Write every parameter into one tar stream (v2 to_tar parity)."""
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            meta = {}
+            for name in self.names():
+                arr = self.get(name)
+                meta[name] = {"shape": list(arr.shape),
+                              "dtype": str(arr.dtype)}
+                buf = _io.BytesIO()
+                np.save(buf, arr)
+                data = buf.getvalue()
+                ti = tarfile.TarInfo(name=name + ".npy")
+                ti.size = len(data)
+                tar.addfile(ti, _io.BytesIO(data))
+            mbytes = json.dumps(meta, indent=1, sort_keys=True).encode()
+            ti = tarfile.TarInfo(name="meta.json")
+            ti.size = len(mbytes)
+            tar.addfile(ti, _io.BytesIO(mbytes))
+
+    def init_from_tar(self, f) -> None:
+        """Load values for parameters present in BOTH tar and program
+        (v2 init_from_tar semantics: extra tar entries are ignored)."""
+        own = self._param_vars()
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                if not member.name.endswith(".npy"):
+                    continue
+                name = member.name[:-len(".npy")]
+                if name not in own:
+                    continue
+                arr = np.load(_io.BytesIO(tar.extractfile(member).read()),
+                              allow_pickle=False)
+                self.set(name, arr)
+
+    from_tar = init_from_tar

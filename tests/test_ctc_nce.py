@@ -9,6 +9,7 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.core.lod import LoDTensor
+from op_test import OpTest
 
 
 def _exe():
@@ -175,3 +176,70 @@ def test_warpctc_all_empty_labels():
     want = -logp[:, 0].sum()
     np.testing.assert_allclose(np.asarray(out).reshape(-1), [want],
                                rtol=1e-5)
+
+
+def _np_hsigmoid(x, w, label, bias, num_classes):
+    """Numpy reference of the bit-code path walk
+    (MatrixBitCode.cpp SimpleCode)."""
+    B = x.shape[0]
+    max_len = max((num_classes - 1).bit_length(), 1)
+    out = np.zeros((B, 1), np.float64)
+    pre = np.zeros((B, max_len), np.float64)
+    for i in range(B):
+        c = int(label[i, 0]) + num_classes
+        length = c.bit_length() - 1
+        s = 0.0
+        for j in range(min(length, max_len)):
+            idx = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            p = float(x[i] @ w[idx])
+            if bias is not None:
+                p += float(bias[idx])
+            p = np.clip(p, -40.0, 40.0)
+            pre[i, j] = p
+            s += np.log1p(np.exp(p)) - bit * p
+        out[i, 0] = s
+    return out.astype(x.dtype), pre.astype(x.dtype)
+
+
+class TestHSigmoid(OpTest):
+    op_type = "hsigmoid"
+
+    def setUp(self):
+        r = np.random.RandomState(7)
+        K, B, D = 10, 6, 8          # K not a power of two: ragged path lens
+        x = r.uniform(-1, 1, (B, D)).astype(np.float32)
+        w = r.uniform(-1, 1, (K - 1, D)).astype(np.float32)
+        bias = r.uniform(-1, 1, (K - 1,)).astype(np.float32)
+        label = r.randint(0, K, (B, 1)).astype(np.int64)
+        out, pre = _np_hsigmoid(x, w, label, bias, K)
+        self.inputs = {"X": x, "W": w, "Label": label, "Bias": bias}
+        self.attrs = {"num_classes": K}
+        self.outputs = {"Out": out, "PreOut": pre}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "W", "Bias"], output_names=["Out"])
+
+
+def test_hsigmoid_layer_trains():
+    """layers.hsigmoid end-to-end: the mean path cost must drop under SGD."""
+    r = np.random.RandomState(0)
+    K, B, D = 8, 16, 4
+    xs = r.uniform(-1, 1, (B, D)).astype(np.float32)
+    ys = r.randint(0, K, (B, 1)).astype(np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.hsigmoid(input=x, label=y, num_classes=K)
+        avg = fluid.layers.mean(cost)
+        fluid.SGD(learning_rate=0.5).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                 fetch_list=[avg])[0]).item()
+              for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.7, losses

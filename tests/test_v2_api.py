@@ -1,0 +1,237 @@
+"""v2-API conveniences: Parameters facade + distributed_spliter policies.
+
+Reference: python/paddle/v2/tests/test_parameters.py (tar round-trip) and
+python/paddle/v2/fluid/distributed_spliter.py.
+"""
+import io
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import distributed_spliter
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=3, act="relu")
+        fluid.layers.fc(input=h, size=2)
+    return main, startup
+
+
+def test_parameters_names_get_set():
+    main, startup = _build()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    p = fluid.Parameters(main, scope)
+    assert len(p) == 4 and all(n in p for n in p.names())
+    name = "fc_0.w_0"
+    assert p.get_shape(name) == (4, 3)
+    p.set(name, np.ones((4, 3), np.float32))
+    np.testing.assert_array_equal(p[name], np.ones((4, 3)))
+    try:
+        p.set(name, np.ones((2, 2), np.float32))
+        raise AssertionError("shape mismatch not caught")
+    except ValueError:
+        pass
+
+
+def test_parameters_tar_round_trip():
+    main, startup = _build()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    p = fluid.Parameters(main, scope)
+    before = {n: p[n].copy() for n in p}
+    buf = io.BytesIO()
+    p.to_tar(buf)
+    buf.seek(0)
+    for n in p:
+        p.set(n, np.zeros_like(before[n]))
+    p.init_from_tar(buf)
+    for n in p:
+        np.testing.assert_array_equal(p[n], before[n])
+
+
+def test_parameters_init_from_tar_ignores_unknown():
+    main, startup = _build()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    p = fluid.Parameters(main, scope)
+    buf = io.BytesIO()
+    p.to_tar(buf)
+    buf.seek(0)
+    # a smaller model loads the subset it shares with the tar
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=3)
+    scope2 = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup2, scope=scope2)
+    p2 = fluid.Parameters(main2, scope2)
+    p2.init_from_tar(buf)
+    # the smaller model's params are freshly named (global uniquing), so
+    # nothing from the tar matches — init_from_tar must be a silent no-op
+    for n in p2:
+        assert n not in p.names()
+
+
+class _V:
+    def __init__(self, name):
+        self.name = name
+
+
+def test_round_robin_cycles():
+    vs = [_V(f"p{i}") for i in range(5)]
+    eps = ["a:1", "b:2"]
+    assert distributed_spliter.round_robin(vs, eps) == \
+        ["a:1", "b:2", "a:1", "b:2", "a:1"]
+
+
+def test_hash_name_stable_and_total():
+    vs = [_V(f"w{i}") for i in range(20)]
+    eps = ["a:1", "b:2", "c:3"]
+    got = distributed_spliter.hash_name(vs, eps)
+    assert got == distributed_spliter.hash_name(vs, eps)
+    assert set(got) <= set(eps) and len(set(got)) > 1
+
+
+def test_transpiler_accepts_split_method():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt_ops, pg = fluid.SGD(learning_rate=0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(optimize_ops=opt_ops, params_grads=pg, trainers=1,
+                pservers="127.0.0.1:6174,127.0.0.1:6175", program=main,
+                startup_program=startup,
+                split_method=distributed_spliter.hash_name)
+    # every param got exactly one endpoint, from the given set
+    assert set(t._assign) == {p.name for p, _ in pg}
+    assert set(t._assign.values()) <= {"127.0.0.1:6174", "127.0.0.1:6175"}
+
+
+def test_model_average_apply_restore():
+    """ModelAverage (legacy AverageOptimizer parity): params swap to the
+    window average under apply() and return on exit."""
+    r = np.random.RandomState(3)
+    xs = r.rand(8, 4).astype(np.float32)
+    ys = (xs @ np.array([[1.], [2.], [3.], [4.]], np.float32))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.SGD(learning_rate=0.05).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            average_window_rate=0.5, min_average_window=2,
+            max_average_window=4)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    w_name = "fc_0.w_0"
+    history = []
+    for _ in range(6):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                scope=scope)
+        history.append(np.asarray(scope.find_var(w_name)).copy())
+    trained = np.asarray(scope.find_var(w_name)).copy()
+    with ma.apply(exe, scope=scope):
+        averaged = np.asarray(scope.find_var(w_name)).copy()
+        # the average lies inside the convex hull of visited params and
+        # differs from the final value
+        assert not np.allclose(averaged, trained)
+        lo = np.min(np.stack(history), axis=0) - 1e-6
+        hi = np.max(np.stack(history), axis=0) + 1e-6
+        assert ((averaged >= lo) & (averaged <= hi)).all()
+    np.testing.assert_array_equal(np.asarray(scope.find_var(w_name)),
+                                  trained)
+
+
+def test_static_pruning_hook():
+    """param_attr update_hooks pruning (ParameterUpdaterHook parity): the
+    bottom-|w| fraction stays zero through training."""
+    r = np.random.RandomState(5)
+    xs = r.rand(16, 8).astype(np.float32)
+    ys = r.rand(16, 1).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(
+            input=x, size=4, bias_attr=False,
+            param_attr={"update_hooks": [
+                {"type": "pruning", "sparsity_ratio": 0.5}]})
+        out = fluid.layers.fc(input=pred, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(out, y))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.find_var("fc_0.w_0"))
+    zeros0 = (w == 0)
+    assert zeros0.sum() >= w.size // 2  # pruned at init
+    for _ in range(5):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                scope=scope)
+    w = np.asarray(scope.find_var("fc_0.w_0"))
+    assert (w[zeros0] == 0).all()       # mask holds through updates
+    assert (w[~zeros0] != 0).any()      # the rest still trains
+
+
+def test_average_accumulates_windowing():
+    """Numpy state-machine reference for the average_accumulates op:
+    rollover must snapshot sum_1+sum_2 into sum_3, zero the running sums,
+    and swap the accumulate counters."""
+    main, startup = fluid.Program(), fluid.Program()
+    names = ["p", "s1", "s2", "s3", "na", "ona", "nu"]
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        for n in names:
+            blk.create_var(name=n, dtype="float32" if n[0] in "ps"
+                           else "int32", persistable=True)
+        blk.append_op(
+            "average_accumulates",
+            {"Param": ["p"], "InSum1": ["s1"], "InSum2": ["s2"],
+             "InSum3": ["s3"], "InNumAccumulates": ["na"],
+             "InOldNumAccumulates": ["ona"], "InNumUpdates": ["nu"]},
+            {"OutSum1": ["s1"], "OutSum2": ["s2"], "OutSum3": ["s3"],
+             "OutNumAccumulates": ["na"], "OutOldNumAccumulates": ["ona"],
+             "OutNumUpdates": ["nu"]},
+            {"average_window": 1.0, "min_average_window": 3,
+             "max_average_window": 3})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    state = {"s1": np.zeros(2, np.float32), "s2": np.zeros(2, np.float32),
+             "s3": np.zeros(2, np.float32),
+             "na": np.zeros(1, np.int32), "ona": np.zeros(1, np.int32),
+             "nu": np.zeros(1, np.int32)}
+    for k, v in state.items():
+        scope.set_var(k, v)
+    ref = {k: v.astype(np.float64) for k, v in state.items()}
+    r = np.random.RandomState(0)
+    for step in range(8):
+        p = r.rand(2).astype(np.float32)
+        scope.set_var("p", p)
+        exe.run(main, scope=scope)
+        # numpy reference (window = min(max_window, nu*rate), rate=1)
+        ref["nu"] += 1
+        ref["na"] += 1
+        ref["s1"] = ref["s1"] + p
+        if ref["na"][0] >= 3 and ref["na"][0] >= min(3, ref["nu"][0]):
+            ref["s3"] = ref["s1"] + ref["s2"]
+            ref["s1"] = np.zeros(2)
+            ref["s2"] = np.zeros(2)
+            ref["ona"] = ref["na"].copy()
+            ref["na"] = np.zeros(1)
+        for k in ("s1", "s2", "s3"):
+            np.testing.assert_allclose(np.asarray(scope.find_var(k)),
+                                       ref[k], rtol=1e-6, err_msg=f"{k}@{step}")
+        for k in ("na", "ona", "nu"):
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(k)).reshape(-1),
+                ref[k].astype(np.int32), err_msg=f"{k}@{step}")
