@@ -1,0 +1,152 @@
+"""seq2seq training tokens/sec — the BASELINE.json headline's second
+metric ("ResNet-50 images/sec/chip + seq2seq tokens/sec").
+
+Two models:
+  * `--model transformer` (default): encoder-decoder transformer
+    translator (models/transformer.py), the modern seq2seq; bf16 by
+    default so attention + FFN matmuls ride the MXU.
+  * `--model rnn`: the reference-era seq2seq — the book/08
+    machine-translation shape (embedding + scan-based GRU encoder-decoder
+    with attention, built from the same layers the book test uses).
+
+The reference has no published seq2seq throughput number (its NMT
+benchmark tables were left unfilled, reference benchmark/cluster/README.md
+:33-74), so tokens/sec here stands alone; `vs_baseline` is null.
+
+Usage:  python benchmark/run_seq2seq.py [--model transformer] [--batch 32]
+        [--src-len 128] [--tgt-len 128] [--iters 20] [--dtype bfloat16]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from harness import time_program  # noqa: E402  (benchmark/ on path via bench.py)
+
+SRC_VOCAB = 30000
+TGT_VOCAB = 30000
+
+
+def build_transformer(batch, src_len, tgt_len, dtype):
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import transformer_translate
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[src_len], dtype="int64")
+        tgt = fluid.layers.data(name="tgt", shape=[tgt_len], dtype="int64")
+        lbl = fluid.layers.data(name="lbl", shape=[tgt_len, 1],
+                                dtype="int64")
+        probs = transformer_translate(
+            src, tgt, SRC_VOCAB, TGT_VOCAB, d_model=512, n_heads=8,
+            n_layers=6, dropout_rate=0.0, is_test=False)
+        probs2d = fluid.layers.reshape(probs, shape=[-1, TGT_VOCAB])
+        lbl2d = fluid.layers.reshape(lbl, shape=[-1, 1])
+        cost = fluid.layers.cross_entropy(input=probs2d, label=lbl2d)
+        avg = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=1e-4).minimize(avg)
+    return main, startup, avg
+
+
+def build_rnn(batch, src_len, tgt_len, dtype):
+    """Reference-era seq2seq at bench scale: the book/08 training shape
+    (LoD sequences, LSTM encoder -> last state -> LSTM decoder;
+    reference tests/book/test_machine_translation.py:24-49 — the
+    reference's book model has no attention, SURVEY.md §5.7)."""
+    import paddle_tpu as fluid
+
+    hidden = 512
+    emb_dim = 512
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data(name="tgt", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        src_emb = fluid.layers.embedding(input=src,
+                                         size=[SRC_VOCAB, emb_dim])
+        enc_in = fluid.layers.fc(input=src_emb, size=hidden * 4,
+                                 act="tanh")
+        enc, _ = fluid.layers.dynamic_lstm(input=enc_in, size=hidden * 4,
+                                           use_peepholes=False)
+        context = fluid.layers.sequence_last_step(input=enc)
+        tgt_emb = fluid.layers.embedding(input=tgt,
+                                         size=[TGT_VOCAB, emb_dim])
+        ctx_exp = fluid.layers.sequence_expand(x=context, y=tgt_emb)
+        dec_in = fluid.layers.concat([tgt_emb, ctx_exp], axis=1)
+        dec_proj = fluid.layers.fc(input=dec_in, size=hidden * 4,
+                                   act="tanh")
+        dec, _ = fluid.layers.dynamic_lstm(input=dec_proj,
+                                           size=hidden * 4,
+                                           use_peepholes=False)
+        probs = fluid.layers.fc(input=dec, size=TGT_VOCAB, act="softmax")
+        cost = fluid.layers.cross_entropy(input=probs, label=lbl)
+        avg = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=1e-4).minimize(avg)
+    return main, startup, avg
+
+
+def run_one(model, batch, src_len, tgt_len, iters, dtype):
+    import paddle_tpu as fluid
+
+    if dtype == "bfloat16":
+        # f32 master weights, bf16 compute on the MXU ops (amp.py)
+        fluid.amp.enable_bf16()
+    build = build_transformer if model == "transformer" else build_rnn
+    main, startup, avg = build(batch, src_len, tgt_len, dtype)
+    r = np.random.RandomState(0)
+    if model == "transformer":
+        feeds = {
+            "src": r.randint(0, SRC_VOCAB,
+                             (batch, src_len)).astype(np.int32),
+            "tgt": r.randint(0, TGT_VOCAB,
+                             (batch, tgt_len)).astype(np.int32),
+            "lbl": r.randint(0, TGT_VOCAB,
+                             (batch, tgt_len, 1)).astype(np.int32),
+        }
+    else:
+        from paddle_tpu.core.lod import LoDTensor, lod_from_seq_lens
+
+        def seq(vocab, length):
+            return LoDTensor(
+                r.randint(0, vocab,
+                          (batch * length, 1)).astype(np.int32),
+                [lod_from_seq_lens([length] * batch)])
+
+        feeds = {"src": seq(SRC_VOCAB, src_len),
+                 "tgt": seq(TGT_VOCAB, tgt_len),
+                 "lbl": seq(TGT_VOCAB, tgt_len)}
+    ms = time_program(main, startup, feeds, avg.name, iters)
+    tokens = batch * (src_len + tgt_len)
+    print(json.dumps({
+        "model": f"seq2seq_{model}", "batch": batch,
+        "src_len": src_len, "tgt_len": tgt_len, "dtype": dtype,
+        "ms_per_batch": round(ms, 2),
+        "tokens_per_sec": round(tokens / ms * 1000, 1),
+        "vs_baseline": None,   # reference published no seq2seq throughput
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="transformer",
+                    choices=["transformer", "rnn"])
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--src-len", type=int, default=128)
+    ap.add_argument("--tgt-len", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dtype", default="bfloat16")
+    a = ap.parse_args()
+    run_one(a.model, a.batch, a.src_len, a.tgt_len, a.iters, a.dtype)
+
+
+if __name__ == "__main__":
+    main()

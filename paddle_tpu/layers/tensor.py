@@ -99,6 +99,14 @@ def concat(input, axis=0):
     out = helper.create_tmp_variable(dtype=input[0].dtype)
     helper.append_op("concat", {"X": [v.name for v in input]},
                      {"Out": [out.name]}, {"axis": axis})
+    shapes = [v.shape for v in input]
+    if all(s is not None for s in shapes):
+        ax = axis if axis >= 0 else axis + len(shapes[0])
+        dims = list(shapes[0])
+        dims[ax] = (-1 if any(int(s[ax]) < 0 for s in shapes)
+                    else sum(int(s[ax]) for s in shapes))
+        out.shape = tuple(dims)
+    out.lod_level = max(getattr(v, "lod_level", 0) for v in input)
     return out
 
 

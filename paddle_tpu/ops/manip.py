@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.execution import data_of, many, one, with_lod_of
+from ..core.lod import LoDTensor
 from ..core.registry import register_op
 
 
@@ -37,8 +38,15 @@ def transpose(ctx, ins, attrs):
 @register_op("concat", inputs=("X",), outputs=("Out",),
              attrs={"axis": 0})
 def concat(ctx, ins, attrs):
-    xs = [data_of(v) for v in many(ins, "X")]
-    return {"Out": jnp.concatenate(xs, axis=attrs["axis"])}
+    vs = many(ins, "X")
+    out = jnp.concatenate([data_of(v) for v in vs], axis=attrs["axis"])
+    if attrs["axis"] != 0:
+        # feature-axis concat keeps the row structure: propagate the first
+        # input's LoD (reference concat_op.cc shares Ins[0]'s lod)
+        for v in vs:
+            if isinstance(v, LoDTensor):
+                return {"Out": LoDTensor(out, list(v.lod))}
+    return {"Out": out}
 
 
 @register_op("split", inputs=("X",), outputs=("Out",),
