@@ -1,0 +1,250 @@
+"""`python -m paddle_tpu.cli` — the legacy trainer command line.
+
+Reference: /root/reference/paddle/trainer/TrainerMain.cpp:24-60 (`paddle
+train --config=... --job=train|test|checkgrad|time`, plus ParamUtil save
+dirs / --start_pass resume) and paddle/scripts (`paddle train` wrapper).
+The `merge` job is the MergeModel utility (trainer/MergeModel.cpp): fold
+config + trained parameters into one deployable inference file.
+
+Config contract (the config_parser.py analogue — a plain Python file):
+
+    # config.py
+    import paddle_tpu as fluid
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        def reader():          # yields feed dicts
+            while True:
+                yield {"x": ..., "y": ...}
+        return {
+            "loss": loss,                         # required
+            "reader": reader,                     # required for train/test/time
+            "optimizer": fluid.SGD(0.01),         # default SGD(0.01)
+            "test_reader": reader,                # default: reader
+            "infer_targets": [pred],              # required for --job=merge
+            "feed_order": ["x", "y"],             # optional (dict feeds don't need it)
+        }
+
+`build()` is called inside a fresh `program_guard`, so the config only
+describes the network — program bookkeeping is the CLI's job.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _load_config(path):
+    spec = importlib.util.spec_from_file_location("paddle_cli_config",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "build"):
+        raise SystemExit(f"config {path!r} must define build()")
+    return mod
+
+
+def _build(mod):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cfg = mod.build()
+    if "loss" not in cfg:
+        raise SystemExit("build() must return a dict with 'loss'")
+    cfg["main"], cfg["startup"] = main, startup
+    return cfg
+
+
+def _place(use_tpu):
+    import paddle_tpu as fluid
+
+    return fluid.TPUPlace() if use_tpu else fluid.CPUPlace()
+
+
+def _run_startup_or_load(exe, cfg, args):
+    import paddle_tpu as fluid
+
+    exe.run(cfg["startup"])
+    if args.init_model_path:
+        fluid.io.load_persistables(exe, args.init_model_path,
+                                   main_program=cfg["main"])
+
+
+def job_train(cfg, args):
+    import paddle_tpu as fluid
+
+    loss = cfg["loss"]
+    opt = cfg.get("optimizer") or fluid.SGD(learning_rate=0.01)
+    with fluid.program_guard(cfg["main"], cfg["startup"]):
+        opt.minimize(loss)
+    exe = fluid.Executor(_place(args.use_tpu))
+    _run_startup_or_load(exe, cfg, args)
+    reader = cfg["reader"]
+    for pass_id in range(args.num_passes):
+        costs = []
+        for batch_id, feed in enumerate(reader()):
+            if args.batches_per_pass and batch_id >= args.batches_per_pass:
+                break
+            out, = exe.run(cfg["main"], feed=feed, fetch_list=[loss])
+            costs.append(float(np.asarray(out).reshape(-1)[0]))
+            if args.log_period and batch_id % args.log_period == 0:
+                print(f"pass {pass_id} batch {batch_id} "
+                      f"cost {costs[-1]:.6f}")
+        print(f"pass {pass_id} done, avg cost "
+              f"{np.mean(costs) if costs else float('nan'):.6f}")
+        if args.save_dir:
+            d = os.path.join(args.save_dir, f"pass-{pass_id:05d}")
+            os.makedirs(d, exist_ok=True)
+            fluid.io.save_persistables(exe, d, main_program=cfg["main"])
+            print(f"saved parameters to {d}")
+
+
+def job_test(cfg, args):
+    import paddle_tpu as fluid
+
+    loss = cfg["loss"]
+    test_prog = cfg["main"].clone(for_test=True)
+    exe = fluid.Executor(_place(args.use_tpu))
+    _run_startup_or_load(exe, cfg, args)
+    reader = cfg.get("test_reader") or cfg["reader"]
+    costs = []
+    for batch_id, feed in enumerate(reader()):
+        if args.batches_per_pass and batch_id >= args.batches_per_pass:
+            break
+        out, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        costs.append(float(np.asarray(out).reshape(-1)[0]))
+    print(f"test: {len(costs)} batches, avg cost {np.mean(costs):.6f}")
+
+
+def job_time(cfg, args):
+    """`--job=time` (reference benchmark mode: paddle train --job=time,
+    benchmark/paddle/image/run.sh)."""
+    import paddle_tpu as fluid
+
+    loss = cfg["loss"]
+    opt = cfg.get("optimizer") or fluid.SGD(learning_rate=0.01)
+    with fluid.program_guard(cfg["main"], cfg["startup"]):
+        opt.minimize(loss)
+    exe = fluid.Executor(_place(args.use_tpu))
+    _run_startup_or_load(exe, cfg, args)
+    it = cfg["reader"]()
+    feed = next(iter(it))
+    exe.run(cfg["main"], feed=feed, fetch_list=[loss])   # compile+warmup
+    n = args.batches_per_pass or 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out, = exe.run(cfg["main"], feed=feed, fetch_list=[loss])
+    np.asarray(out)
+    ms = (time.perf_counter() - t0) / n * 1000
+    print(f"time: {ms:.2f} ms/batch over {n} batches")
+
+
+def job_checkgrad(cfg, args):
+    """Central finite-difference check of d(loss)/d(param) (reference
+    --job=checkgrad, trainer/tests + gserver test_LayerGrad machinery)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.executor import global_scope
+
+    loss = cfg["loss"]
+    main = cfg["main"]
+    params = main.global_block().all_parameters()
+    with fluid.program_guard(main, cfg["startup"]):
+        grads = fluid.calc_gradient(loss, params)
+    exe = fluid.Executor(_place(args.use_tpu))
+    _run_startup_or_load(exe, cfg, args)
+    feed = next(iter(cfg["reader"]()))
+    scope = global_scope()
+    fetched = exe.run(main, feed=feed, fetch_list=[loss] + list(grads))
+    analytic = {p.name: np.asarray(g) for p, g in zip(params, fetched[1:])}
+
+    delta = args.checkgrad_eps
+    rng = np.random.RandomState(0)
+    worst = 0.0
+    for p in params:
+        val = np.asarray(scope.find_var(p.name)).copy()
+        flat = val.reshape(-1)
+        k = min(args.checkgrad_samples, flat.size)
+        idxs = rng.choice(flat.size, size=k, replace=False)
+        num = np.zeros(k)
+        for j, i in enumerate(idxs):
+            for sgn in (+1, -1):
+                flat2 = flat.copy()
+                flat2[i] += sgn * delta
+                scope.set_var(p.name, flat2.reshape(val.shape))
+                out, = exe.run(main, feed=feed, fetch_list=[loss])
+                num[j] += sgn * float(np.asarray(out).reshape(-1)[0])
+            num[j] /= 2 * delta
+        scope.set_var(p.name, val)
+        ana = analytic[p.name].reshape(-1)[idxs]
+        denom = np.maximum(np.abs(num) + np.abs(ana), 1e-6)
+        err = float(np.max(np.abs(num - ana) / denom))
+        worst = max(worst, err)
+        status = "OK" if err < args.checkgrad_tol else "FAIL"
+        print(f"checkgrad {p.name}: max rel err {err:.3e} [{status}]")
+    if worst >= args.checkgrad_tol:
+        raise SystemExit(f"checkgrad FAILED (worst {worst:.3e} >= "
+                         f"{args.checkgrad_tol})")
+    print(f"checkgrad passed (worst {worst:.3e})")
+
+
+def job_merge(cfg, args):
+    """MergeModel: config + params -> single-file inference model."""
+    import paddle_tpu as fluid
+
+    targets = cfg.get("infer_targets")
+    if not targets:
+        raise SystemExit("--job=merge needs 'infer_targets' from build()")
+    exe = fluid.Executor(_place(args.use_tpu))
+    _run_startup_or_load(exe, cfg, args)
+    feed_names = cfg.get("feed_order")
+    if not feed_names:
+        raise SystemExit("--job=merge needs 'feed_order' from build()")
+    out = args.save_dir or "merged_model"
+    fluid.io.save_inference_model(
+        out, feed_names, targets, exe, main_program=cfg["main"],
+        model_filename="__model__", params_filename="__params__")
+    print(f"merged model written to {out}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli",
+        description="legacy `paddle train` workflow over Program/Executor")
+    ap.add_argument("--config", required=True, help="python config file "
+                    "defining build()")
+    ap.add_argument("--job", default="train",
+                    choices=["train", "test", "checkgrad", "time", "merge"])
+    ap.add_argument("--use_tpu", type=int, default=1,
+                    help="1: default device (TPU when present); 0: CPU "
+                    "interpreter-capable place (reference --use_gpu)")
+    ap.add_argument("--num_passes", type=int, default=1)
+    ap.add_argument("--batches_per_pass", type=int, default=0,
+                    help="0 = drain the reader")
+    ap.add_argument("--log_period", type=int, default=100)
+    ap.add_argument("--save_dir", default="",
+                    help="per-pass param dirs (ParamUtil) / merge output")
+    ap.add_argument("--init_model_path", default="",
+                    help="load persistables before the job (--start_pass "
+                    "resume analogue)")
+    ap.add_argument("--checkgrad_eps", type=float, default=1e-3)
+    ap.add_argument("--checkgrad_samples", type=int, default=8)
+    ap.add_argument("--checkgrad_tol", type=float, default=1e-2)
+    args = ap.parse_args(argv)
+
+    mod = _load_config(args.config)
+    cfg = _build(mod)
+    {"train": job_train, "test": job_test, "time": job_time,
+     "checkgrad": job_checkgrad, "merge": job_merge}[args.job](cfg, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""The legacy `paddle train` CLI (reference trainer/TrainerMain.cpp:24-60:
+--job=train|test|checkgrad|time; MergeModel.cpp for merge) driven
+end-to-end in-process.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = """
+import numpy as np
+import paddle_tpu as fluid
+
+def build():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+    def reader():
+        r = np.random.RandomState(0)
+        w = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        for _ in range(8):
+            xb = r.rand(16, 4).astype(np.float32)
+            yield {"x": xb, "y": xb @ w}
+
+    return {"loss": loss, "reader": reader,
+            "optimizer": fluid.SGD(learning_rate=0.1),
+            "infer_targets": [pred], "feed_order": ["x", "y"]}
+"""
+
+
+@pytest.fixture(scope="module")
+def config_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("cli") / "config.py"
+    p.write_text(CONFIG)
+    return str(p)
+
+
+def _run(args, cwd=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli"] + args,
+        capture_output=True, text=True, env=env, cwd=cwd or REPO,
+        timeout=600)
+
+
+def test_cli_train_and_save(config_path, tmp_path):
+    save = str(tmp_path / "out")
+    r = _run(["--config", config_path, "--job", "train", "--use_tpu", "0",
+              "--num_passes", "2", "--log_period", "4",
+              "--save_dir", save])
+    assert r.returncode == 0, r.stderr
+    assert "pass 1 done" in r.stdout
+    assert os.path.isdir(os.path.join(save, "pass-00001"))
+    # cost falls between passes
+    lines = [ln for ln in r.stdout.splitlines() if "done, avg cost" in ln]
+    c0, c1 = (float(ln.rsplit(None, 1)[-1]) for ln in lines)
+    assert c1 < c0
+
+
+def test_cli_test_job_with_init_model(config_path, tmp_path):
+    save = str(tmp_path / "m")
+    r = _run(["--config", config_path, "--job", "train", "--use_tpu", "0",
+              "--num_passes", "1", "--save_dir", save])
+    assert r.returncode == 0, r.stderr
+    r = _run(["--config", config_path, "--job", "test", "--use_tpu", "0",
+              "--init_model_path", os.path.join(save, "pass-00000")])
+    assert r.returncode == 0, r.stderr
+    assert "avg cost" in r.stdout
+
+
+def test_cli_time_job(config_path):
+    r = _run(["--config", config_path, "--job", "time", "--use_tpu", "0",
+              "--batches_per_pass", "3"])
+    assert r.returncode == 0, r.stderr
+    assert "ms/batch" in r.stdout
+
+
+def test_cli_checkgrad_job(config_path):
+    r = _run(["--config", config_path, "--job", "checkgrad",
+              "--use_tpu", "0"])
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "checkgrad passed" in r.stdout
+
+
+def test_cli_merge_job(config_path, tmp_path):
+    out = str(tmp_path / "merged")
+    r = _run(["--config", config_path, "--job", "merge", "--use_tpu", "0",
+              "--save_dir", out])
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(os.path.join(out, "__model__"))
+    assert os.path.exists(os.path.join(out, "__params__"))
+    # merged model loads and serves
+    import paddle_tpu as fluid
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog, feeds, fetches = fluid.io.load_inference_model(
+        out, exe, model_filename="__model__", params_filename="__params__")
+    assert feeds == ["x", "y"]
+    got, = exe.run(prog, feed={"x": np.zeros((2, 4), np.float32),
+                               "y": np.zeros((2, 1), np.float32)},
+                   fetch_list=fetches)
+    assert np.asarray(got).shape == (2, 1)
