@@ -7,7 +7,11 @@ from .collective import (  # noqa: F401
     sharded_embedding_grad,
     sharded_embedding_lookup,
 )
-from .executor import DistributeTranspiler, ParallelExecutor  # noqa: F401
+from .executor import (  # noqa: F401
+    DistributeTranspiler,
+    ParallelExecutor,
+    SimpleDistributeTranspiler,
+)
 from .mesh import (  # noqa: F401
     NamedSharding,
     PartitionSpec,

@@ -39,7 +39,8 @@ def _amp_enabled() -> bool:
     from ..amp import is_bf16_enabled
     return is_bf16_enabled()
 
-__all__ = ["ParallelExecutor", "DistributeTranspiler"]
+__all__ = ["ParallelExecutor", "DistributeTranspiler",
+           "SimpleDistributeTranspiler"]
 
 
 class ParallelExecutor:
@@ -310,3 +311,10 @@ class DistributeTranspiler:
             self._program, feed_names, fetch_list,
             mesh=self._mesh_axes, startup_program=startup_program,
             shard_optimizer_states=self._shard_opt, **kw)
+
+
+class SimpleDistributeTranspiler(DistributeTranspiler):
+    """Whole-variable placement variant (reference
+    distribute_transpiler_simple.py:1-256).  The base class already places
+    whole params (no block splitting), so this is the same transpiler under
+    the reference's other name — kept so both entry points exist."""

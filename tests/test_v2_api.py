@@ -235,3 +235,25 @@ def test_average_accumulates_windowing():
             np.testing.assert_array_equal(
                 np.asarray(scope.find_var(k)).reshape(-1),
                 ref[k].astype(np.int32), err_msg=f"{k}@{step}")
+
+
+def test_float16_interchange_dtype():
+    """fp16 as an interchange dtype (reference math/float16.h + design
+    doc/design/float16.md): fp16 feeds/params flow through layers; cast
+    converts fp16<->fp32."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float16")
+        h = fluid.layers.fc(input=x, size=3)
+        out = fluid.layers.cast(h, "float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    assert np.asarray(scope.find_var("fc_1.w_0")
+                      if scope.has_var("fc_1.w_0")
+                      else scope.find_var(
+                          [n for n in scope.local_names()
+                           if n.endswith(".w_0")][0])).dtype == np.float16
+    got, = exe.run(main, feed={"x": np.ones((2, 4), np.float16)},
+                   fetch_list=[out], scope=scope)
+    assert np.asarray(got).dtype == np.float32
