@@ -66,12 +66,19 @@ def precision_recall(ctx, ins, attrs):
     c = attrs["class_number"]
     idx = data_of(one(ins, "Indices")).reshape(-1)
     labels = data_of(one(ins, "Labels")).reshape(-1)
+    wv = one(ins, "Weights")
+    w = (jnp.ones(idx.shape[0], jnp.float32) if wv is None
+         else data_of(wv).reshape(-1).astype(jnp.float32))
     onehot_pred = jnp.eye(c, dtype=jnp.float32)[idx]
     onehot_lbl = jnp.eye(c, dtype=jnp.float32)[labels]
-    tp = jnp.sum(onehot_pred * onehot_lbl, axis=0)
-    fp = jnp.sum(onehot_pred * (1 - onehot_lbl), axis=0)
-    fn = jnp.sum((1 - onehot_pred) * onehot_lbl, axis=0)
-    states = jnp.stack([tp, fp, jnp.zeros_like(tp), fn], axis=1)
+    tp = jnp.sum(w[:, None] * onehot_pred * onehot_lbl, axis=0)
+    fp = jnp.sum(w[:, None] * onehot_pred * (1 - onehot_lbl), axis=0)
+    fn = jnp.sum(w[:, None] * (1 - onehot_pred) * onehot_lbl, axis=0)
+    # TN per class = weight of samples that neither predicted nor carried
+    # the class (reference precision_recall_op.h:71-81 increments all
+    # classes then subtracts the predicted/true ones)
+    tn = jnp.sum(w) - tp - fp - fn
+    states = jnp.stack([tp, fp, tn, fn], axis=1)
     prev = one(ins, "StatesInfo")
     acc = states if prev is None else states + data_of(prev)
 

@@ -199,7 +199,7 @@ def sequence_erase(ctx, ins, attrs):
 
 
 @register_op("sequence_slice", inputs=("X", "Offset", "Length"),
-             outputs=("Out",), diff_inputs=("X",))
+             outputs=("Out",), diff_inputs=("X",), host=True)
 def sequence_slice(ctx, ins, attrs):
     xv = one(ins, "X")
     off = np.asarray(data_of(one(ins, "Offset"))).reshape(-1)
@@ -311,7 +311,7 @@ def sequence_pad(ctx, ins, attrs):
 
 
 @register_op("sequence_unpad", inputs=("X", "Length"), outputs=("Out",),
-             diff_inputs=("X",))
+             diff_inputs=("X",), host=True)
 def sequence_unpad(ctx, ins, attrs):
     x = data_of(one(ins, "X"))  # [B, T, ...]
     lens = [int(v) for v in np.asarray(data_of(one(ins, "Length")))]

@@ -1,0 +1,551 @@
+"""Per-op numpy-reference sweep over ops without a dedicated test file —
+completing the reference's op-test backbone (SURVEY.md §4.1: ~190
+test_*_op.py files; reference formulas cited per case).
+
+Forward checks run in BOTH executor modes via OpTest.check_output;
+gradient checks (central finite differences) cover one representative per
+family — the generic-VJP machinery is shared, so a per-family probe plus
+the family-wide forward checks pin the lowering.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _r(seed=0):
+    return np.random.RandomState(seed)
+
+
+# ---------------------------------------------------------------------------
+# activations (reference activation_op.h functor table)
+# ---------------------------------------------------------------------------
+
+X_ACT = _r(1).uniform(-3, 3, (3, 4)).astype(np.float32)
+
+ACT_CASES = {
+    "brelu": ({"t_min": -1.0, "t_max": 1.5},
+              lambda x, a: np.clip(x, a["t_min"], a["t_max"])),
+    "ceil": ({}, lambda x, a: np.ceil(x)),
+    "floor": ({}, lambda x, a: np.floor(x)),
+    "leaky_relu": ({"alpha": 0.1},
+                   lambda x, a: np.where(x > 0, x, a["alpha"] * x)),
+    "logsigmoid": ({}, lambda x, a: -np.log1p(np.exp(-x))),
+    "hard_shrink": ({"threshold": 0.5},
+                    lambda x, a: x * (np.abs(x) > a["threshold"])),
+    "hard_sigmoid": ({"slope": 0.2, "offset": 0.5},
+                     lambda x, a: np.clip(a["slope"] * x + a["offset"],
+                                          0.0, 1.0)),
+    "relu6": ({"threshold": 6.0}, lambda x, a: np.clip(x, 0.0, 6.0)),
+    "soft_relu": ({"threshold": 40.0},
+                  lambda x, a: np.log1p(np.exp(np.clip(x, -40.0, 40.0)))),
+    "softshrink": ({"lambda": 0.5},
+                   lambda x, a: np.where(
+                       x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0))),
+    "stanh": ({"scale_a": 2.0 / 3.0, "scale_b": 1.7159},
+              lambda x, a: a["scale_b"] * np.tanh(a["scale_a"] * x)),
+    "swish": ({"beta": 1.0},
+              lambda x, a: x / (1.0 + np.exp(-a["beta"] * x))),
+    "tanh_shrink": ({}, lambda x, a: x - np.tanh(x)),
+    "thresholded_relu": ({"threshold": 1.0},
+                         lambda x, a: x * (x > a["threshold"])),
+}
+
+
+@pytest.mark.parametrize("op", sorted(ACT_CASES))
+def test_activation_forward(op):
+    attrs, ref = ACT_CASES[op]
+
+    class T(OpTest):
+        op_type = op
+
+        def setUp(self):
+            self.inputs = {"X": X_ACT}
+            self.attrs = dict(attrs)
+            self.outputs = {"Out": ref(X_ACT.astype(np.float64),
+                                       attrs).astype(np.float32)}
+
+    T().check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_swish_grad():
+    class T(OpTest):
+        op_type = "swish"
+
+        def setUp(self):
+            self.inputs = {"X": X_ACT}
+            self.attrs = {"beta": 1.0}
+            self.outputs = {"Out": X_ACT / (1 + np.exp(-X_ACT))}
+
+    T().check_grad(["X"])
+
+
+def test_prelu():
+    x = _r(2).uniform(-2, 2, (3, 4)).astype(np.float32)
+    alpha = np.array([0.25], np.float32)
+
+    class T(OpTest):
+        op_type = "prelu"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Alpha": alpha}
+            self.outputs = {"Out": np.where(x > 0, x, 0.25 * x)}
+
+    T().check_output()
+    T().check_grad(["X", "Alpha"])
+
+
+# ---------------------------------------------------------------------------
+# elementwise with broadcast axis (reference elementwise_op_function.h)
+# ---------------------------------------------------------------------------
+
+EW_CASES = {
+    "elementwise_sub": lambda x, y: x - y,
+    "elementwise_mul": lambda x, y: x * y,
+    "elementwise_max": np.maximum,
+    "elementwise_min": np.minimum,
+    "elementwise_pow": np.power,
+}
+
+
+@pytest.mark.parametrize("op", sorted(EW_CASES))
+def test_elementwise_forward(op):
+    r = _r(3)
+    x = r.uniform(0.5, 2.0, (2, 3, 4)).astype(np.float32)
+    y = r.uniform(0.5, 2.0, (2, 3, 4)).astype(np.float32)
+
+    class T(OpTest):
+        op_type = op
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": EW_CASES[op](
+                x.astype(np.float64), y.astype(np.float64))
+                .astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+
+
+def test_elementwise_mul_broadcast_axis():
+    """Y broadcast along `axis` (reference: Y's dims align to X dims
+    starting at axis)."""
+    r = _r(4)
+    x = r.rand(2, 3, 4).astype(np.float32)
+    y = r.rand(3).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "elementwise_mul"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.attrs = {"axis": 1}
+            self.outputs = {"Out": x * y[None, :, None]}
+
+    T().check_output()
+    T().check_grad(["X", "Y"])
+
+
+# ---------------------------------------------------------------------------
+# reductions / norms (reference reduce_op.cc, cumsum, l1/l2 norm ops)
+# ---------------------------------------------------------------------------
+
+def _reduce_case(op, npfn):
+    r = _r(5)
+    x = r.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+
+    class T(OpTest):
+        op_type = op
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"dim": [1], "keep_dim": False,
+                          "reduce_all": False}
+            self.outputs = {"Out": npfn(x.astype(np.float64), axis=1)
+                            .astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+
+
+@pytest.mark.parametrize("op,npfn", [
+    ("reduce_max", np.max), ("reduce_min", np.min),
+    ("reduce_mean", np.mean), ("reduce_prod", np.prod)])
+def test_reduce_forward(op, npfn):
+    _reduce_case(op, npfn)
+
+
+def test_cumsum():
+    x = _r(6).rand(3, 4).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "cumsum"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"axis": 1, "exclusive": False, "reverse": False}
+            self.outputs = {"Out": np.cumsum(x, axis=1)}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_l1_and_squared_l2_norm():
+    x = _r(7).uniform(-1, 1, (3, 4)).astype(np.float32)
+
+    class L1(OpTest):
+        op_type = "l1_norm"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.array(
+                [np.abs(x).sum()], np.float32).reshape(())}
+
+    class L2(OpTest):
+        op_type = "squared_l2_norm"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.array(
+                [(x.astype(np.float64) ** 2).sum()],
+                np.float32).reshape(())}
+
+    # scalar-vs-[1] shape tolerance: compare by value
+    for cls in (L1, L2):
+        t = cls()
+        t.setUp()
+        main, startup, feed, _, out_entries = t._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed=feed, fetch_list=["Out"])
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(-1),
+            np.asarray(t.outputs["Out"]).reshape(-1), rtol=1e-5)
+
+
+def test_squared_l2_distance():
+    r = _r(8)
+    x = r.rand(4, 3).astype(np.float32)
+    y = r.rand(4, 3).astype(np.float32)
+    sub = x - y
+
+    class T(OpTest):
+        op_type = "squared_l2_distance"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": (sub ** 2).sum(1, keepdims=True),
+                            "sub_result": sub}
+
+    T().check_output(rtol=1e-4)
+    T().check_grad(["X", "Y"])
+
+
+# ---------------------------------------------------------------------------
+# losses (reference formulas confirmed from the op headers)
+# ---------------------------------------------------------------------------
+
+def test_hinge_loss():
+    """hinge_loss_op.h:36: l = max(0, 1 - x*(2y-1))."""
+    r = _r(9)
+    x = r.uniform(-2, 2, (6, 1)).astype(np.float32)
+    y = r.randint(0, 2, (6, 1)).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "hinge_loss"
+
+        def setUp(self):
+            self.inputs = {"Logits": x, "Labels": y}
+            self.outputs = {"Loss": np.maximum(
+                0.0, 1.0 - x * (2 * y - 1)).astype(np.float32)}
+
+    T().check_output()
+
+
+def test_huber_loss():
+    """huber_loss_op.h: r = y - x; 0.5 r^2 inside delta, linear outside."""
+    r = _r(10)
+    x = r.uniform(-2, 2, (6, 1)).astype(np.float32)
+    y = r.uniform(-2, 2, (6, 1)).astype(np.float32)
+    d = 1.0
+    res = y - x
+    out = np.where(np.abs(res) <= d, 0.5 * res ** 2,
+                   d * (np.abs(res) - 0.5 * d))
+
+    class T(OpTest):
+        op_type = "huber_loss"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.attrs = {"delta": d}
+            self.outputs = {"Residual": res, "Out": out}
+
+    T().check_output()
+    T().check_grad(["X", "Y"])
+
+
+def test_log_loss():
+    r = _r(11)
+    p = r.uniform(0.05, 0.95, (6, 1)).astype(np.float32)
+    y = r.randint(0, 2, (6, 1)).astype(np.float32)
+    eps = 1e-4
+    out = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+
+    class T(OpTest):
+        op_type = "log_loss"
+
+        def setUp(self):
+            self.inputs = {"Predicted": p, "Labels": y}
+            self.attrs = {"epsilon": eps}
+            self.outputs = {"Loss": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+
+
+def test_margin_rank_loss():
+    r = _r(12)
+    x1 = r.uniform(-1, 1, (6, 1)).astype(np.float32)
+    x2 = r.uniform(-1, 1, (6, 1)).astype(np.float32)
+    lab = np.where(r.rand(6, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+    m = 0.1
+    out = np.maximum(0.0, -lab * (x1 - x2) + m)
+
+    class T(OpTest):
+        op_type = "margin_rank_loss"
+
+        def setUp(self):
+            self.inputs = {"X1": x1, "X2": x2, "Label": lab}
+            self.attrs = {"margin": m}
+            self.outputs = {"Out": out.astype(np.float32),
+                            "Activated": (out > 0).astype(np.float32)}
+
+    T().check_output()
+
+
+def test_modified_huber_loss():
+    """modified_huber_loss_op.h:38: z = x(2y-1); -4z | (1-z)^2 | 0."""
+    r = _r(13)
+    x = r.uniform(-2, 2, (8, 1)).astype(np.float32)
+    y = r.randint(0, 2, (8, 1)).astype(np.float32)
+    z = x * (2 * y - 1)
+    out = np.where(z < -1, -4 * z,
+                   np.where(z < 1, (1 - z) ** 2, 0.0))
+
+    class T(OpTest):
+        op_type = "modified_huber_loss"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"IntermediateVal": z.astype(np.float32),
+                            "Out": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+
+
+def test_rank_loss():
+    """rank_loss_op.h:40: C = log(1+exp(o)) - label*o, o = left-right."""
+    r = _r(14)
+    left = r.uniform(-1, 1, (6, 1)).astype(np.float32)
+    right = r.uniform(-1, 1, (6, 1)).astype(np.float32)
+    lab = r.randint(0, 2, (6, 1)).astype(np.float32)
+    o = left - right
+    out = np.log1p(np.exp(o)) - lab * o
+
+    class T(OpTest):
+        op_type = "rank_loss"
+
+        def setUp(self):
+            self.inputs = {"Label": lab, "Left": left, "Right": right}
+            self.outputs = {"Out": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+    T().check_grad(["Left", "Right"])
+
+
+def test_smooth_l1_loss():
+    """smooth_l1_loss_op.h: d = iw*(x-y); per-row sum of smooth-l1(d)
+    scaled by ow; sigma^2 switch point."""
+    r = _r(15)
+    x = r.uniform(-1, 1, (4, 3)).astype(np.float32)
+    y = r.uniform(-1, 1, (4, 3)).astype(np.float32)
+    sigma = 2.0
+    s2 = sigma * sigma
+    d = x - y
+    val = np.where(np.abs(d) < 1.0 / s2, 0.5 * s2 * d * d,
+                   np.abs(d) - 0.5 / s2)
+    out = val.sum(1, keepdims=True)
+
+    class T(OpTest):
+        op_type = "smooth_l1_loss"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.attrs = {"sigma": sigma}
+            self.outputs = {"Diff": d, "Out": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation / creation
+# ---------------------------------------------------------------------------
+
+def test_gather():
+    x = _r(16).rand(5, 3).astype(np.float32)
+    idx = np.array([0, 3, 1], np.int32)
+
+    class T(OpTest):
+        op_type = "gather"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Index": idx}
+            self.outputs = {"Out": x[idx]}
+
+    T().check_output()
+    T().check_grad(["X"])
+
+
+def test_one_hot():
+    ids = np.array([[1], [0], [3]], np.int64)
+
+    class T(OpTest):
+        op_type = "one_hot"
+
+        def setUp(self):
+            self.inputs = {"X": ids}
+            self.attrs = {"depth": 4, "dtype": "float32"}
+            self.outputs = {"Out": np.eye(4, dtype=np.float32)[
+                ids.reshape(-1)]}
+
+    T().check_output()
+
+
+def test_slice_squeeze_unsqueeze():
+    x = _r(17).rand(3, 1, 4).astype(np.float32)
+
+    class S(OpTest):
+        op_type = "slice"
+
+        def setUp(self):
+            self.inputs = {"Input": x}
+            self.attrs = {"axes": [0, 2], "starts": [1, 0], "ends": [3, 2]}
+            self.outputs = {"Out": x[1:3, :, 0:2]}
+
+    class Sq(OpTest):
+        op_type = "squeeze"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"axes": [1]}
+            self.outputs = {"Out": x.squeeze(1)}
+
+    class Un(OpTest):
+        op_type = "unsqueeze"
+
+        def setUp(self):
+            self.inputs = {"X": x.squeeze(1)}
+            self.attrs = {"axes": [1]}
+            self.outputs = {"Out": x}
+
+    S().check_output()
+    Sq().check_output()
+    Un().check_output()
+
+
+def test_fill_zeros_like_and_batch_size_like():
+    x = _r(18).rand(4, 3).astype(np.float32)
+
+    class Z(OpTest):
+        op_type = "fill_zeros_like"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.zeros_like(x)}
+
+    class B(OpTest):
+        op_type = "fill_constant_batch_size_like"
+
+        def setUp(self):
+            self.inputs = {"Input": x}
+            self.attrs = {"shape": [1, 7], "value": 2.5,
+                          "dtype": "float32", "input_dim_idx": 0,
+                          "output_dim_idx": 0}
+            self.outputs = {"Out": np.full((4, 7), 2.5, np.float32)}
+
+    Z().check_output()
+    B().check_output()
+
+
+def test_random_ops_statistics():
+    """uniform_random / gaussian_random: bounds + moments (reference
+    test_uniform_random_op.py / test_gaussian_random_op.py check the
+    same statistics)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        for name in ("u", "g"):
+            blk.create_var(name=name, dtype="float32")
+        blk.append_op("uniform_random", {}, {"Out": ["u"]},
+                      {"shape": [1000, 10], "min": -2.0, "max": 2.0,
+                       "seed": 1, "dtype": "float32"})
+        blk.append_op("gaussian_random", {}, {"Out": ["g"]},
+                      {"shape": [1000, 10], "mean": 1.0, "std": 2.0,
+                       "seed": 1, "dtype": "float32"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    u, g = (np.asarray(v) for v in exe.run(main, fetch_list=["u", "g"]))
+    assert u.shape == (1000, 10) and g.shape == (1000, 10)
+    assert u.min() >= -2.0 and u.max() <= 2.0
+    np.testing.assert_allclose(u.mean(), 0.0, atol=0.05)
+    np.testing.assert_allclose(g.mean(), 1.0, atol=0.05)
+    np.testing.assert_allclose(g.std(), 2.0, atol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# compare / logical
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op,npfn", [
+    ("less_equal", np.less_equal), ("greater_than", np.greater),
+    ("greater_equal", np.greater_equal), ("not_equal", np.not_equal)])
+def test_compare_ops(op, npfn):
+    r = _r(19)
+    x = r.randint(0, 3, (3, 4)).astype(np.float32)
+    y = r.randint(0, 3, (3, 4)).astype(np.float32)
+
+    class T(OpTest):
+        op_type = op
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": npfn(x, y)}
+
+    T().check_output()
+
+
+@pytest.mark.parametrize("op,npfn", [
+    ("logical_and", np.logical_and), ("logical_or", np.logical_or),
+    ("logical_xor", np.logical_xor)])
+def test_logical_ops(op, npfn):
+    r = _r(20)
+    x = r.rand(3, 4) > 0.5
+    y = r.rand(3, 4) > 0.5
+
+    class T(OpTest):
+        op_type = op
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": npfn(x, y)}
+
+    T().check_output()
+
+
+def test_logical_not():
+    x = _r(21).rand(3, 4) > 0.5
+
+    class T(OpTest):
+        op_type = "logical_not"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.logical_not(x)}
+
+    T().check_output()

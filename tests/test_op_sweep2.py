@@ -1,0 +1,566 @@
+"""Per-op numpy-reference sweep, part 2: optimizer update ops, pooling
+extras, sequence extras, tensor arrays, precision_recall.
+
+Reference kernels cited per case (SURVEY.md §2.2 optimizer/metrics rows;
+reference python tests test_adagrad_op.py, test_rmsprop_op.py,
+test_ftrl_op.py, test_maxout_op.py, test_lrn_op.py, ... are the models).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+from op_test import OpTest
+
+
+def _r(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _opt_state(seed, shape=(4, 3)):
+    r = _r(seed)
+    return (r.uniform(-1, 1, shape).astype(np.float32),      # param
+            r.uniform(-1, 1, shape).astype(np.float32),      # grad
+            np.array([0.1], np.float32))                     # lr
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops (reference *_op.h formulas)
+# ---------------------------------------------------------------------------
+
+def test_adagrad_step():
+    p, g, lr = _opt_state(1)
+    m = np.abs(_r(2).rand(4, 3)).astype(np.float32)
+    eps = 1e-6
+    m_out = m + g * g
+    p_out = p - lr * g / (np.sqrt(m_out) + eps)
+
+    class T(OpTest):
+        op_type = "adagrad"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                           "LearningRate": lr}
+            self.attrs = {"epsilon": eps}
+            self.outputs = {"ParamOut": p_out, "MomentOut": m_out}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_decayed_adagrad_step():
+    p, g, lr = _opt_state(3)
+    m = np.abs(_r(4).rand(4, 3)).astype(np.float32)
+    decay, eps = 0.95, 1e-6
+    m_out = decay * m + (1 - decay) * g * g
+    p_out = p - lr * g / (np.sqrt(m_out) + eps)
+
+    class T(OpTest):
+        op_type = "decayed_adagrad"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                           "LearningRate": lr}
+            self.attrs = {"decay": decay, "epsilon": eps}
+            self.outputs = {"ParamOut": p_out, "MomentOut": m_out}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_adadelta_step():
+    p, g, _ = _opt_state(5)
+    asg = np.abs(_r(6).rand(4, 3)).astype(np.float32)
+    asu = np.abs(_r(7).rand(4, 3)).astype(np.float32)
+    rho, eps = 0.95, 1e-6
+    asg_out = rho * asg + (1 - rho) * g * g
+    update = -np.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * update * update
+
+    class T(OpTest):
+        op_type = "adadelta"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Grad": g, "AvgSquaredGrad": asg,
+                           "AvgSquaredUpdate": asu}
+            self.attrs = {"rho": rho, "epsilon": eps}
+            self.outputs = {"ParamOut": p + update,
+                            "AvgSquaredGradOut": asg_out,
+                            "AvgSquaredUpdateOut": asu_out}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_adamax_step():
+    p, g, lr = _opt_state(8)
+    m = _r(9).uniform(-1, 1, (4, 3)).astype(np.float32)
+    inf = np.abs(_r(10).rand(4, 3)).astype(np.float32) + 0.5
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.array([b1 ** 3], np.float32)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = np.maximum(b2 * inf, np.abs(g) + eps)
+    p_out = p - (lr / (1 - b1p)) * (m_out / inf_out)
+
+    class T(OpTest):
+        op_type = "adamax"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                           "InfNorm": inf, "LearningRate": lr,
+                           "Beta1Pow": b1p}
+            self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+            self.outputs = {"ParamOut": p_out, "MomentOut": m_out,
+                            "InfNormOut": inf_out}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_rmsprop_step():
+    p, g, lr = _opt_state(11)
+    ms = np.abs(_r(12).rand(4, 3)).astype(np.float32)
+    mom = _r(13).uniform(-0.1, 0.1, (4, 3)).astype(np.float32)
+    decay, mu, eps = 0.9, 0.8, 1e-10
+    ms_out = decay * ms + (1 - decay) * g * g
+    mom_out = mu * mom + lr * g / np.sqrt(ms_out + eps)
+    p_out = p - mom_out
+
+    class T(OpTest):
+        op_type = "rmsprop"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Grad": g, "MeanSquare": ms,
+                           "Moment": mom, "LearningRate": lr}
+            self.attrs = {"decay": decay, "momentum": mu, "epsilon": eps}
+            self.outputs = {"ParamOut": p_out, "MeanSquareOut": ms_out,
+                            "MomentOut": mom_out}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_ftrl_step():
+    """ftrl_op.h: sigma fold of the lr schedule into the linear
+    accumulator, soft-threshold shrink."""
+    p, g, lr = _opt_state(14)
+    sq = np.abs(_r(15).rand(4, 3)).astype(np.float32) + 0.1
+    lin = _r(16).uniform(-2, 2, (4, 3)).astype(np.float32)
+    l1, l2, power = 0.5, 0.1, -0.5
+    sq_out = sq + g * g
+    sigma = (sq_out ** -power - sq ** -power) / lr
+    lin_out = lin + g - sigma * p
+    x = l1 * np.sign(lin_out) - lin_out
+    y = sq_out ** -power / lr + 2 * l2
+    p_out = np.where(np.abs(lin_out) > l1, x / y, 0.0).astype(np.float32)
+
+    class T(OpTest):
+        op_type = "ftrl"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "SquaredAccumulator": sq,
+                           "LinearAccumulator": lin, "Grad": g,
+                           "LearningRate": lr}
+            self.attrs = {"l1": l1, "l2": l2, "lr_power": power}
+            self.outputs = {"ParamOut": p_out, "SquaredAccumOut": sq_out,
+                            "LinearAccumOut": lin_out}
+
+    T().check_output(rtol=1e-4)
+
+
+def test_proximal_gd_and_adagrad_step():
+    p, g, lr = _opt_state(17)
+    l1, l2 = 0.05, 0.1
+    prox = p - lr * g
+    pg_out = (np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0.0)
+              / (1 + lr * l2))
+
+    class PG(OpTest):
+        op_type = "proximal_gd"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+            self.attrs = {"l1": l1, "l2": l2}
+            self.outputs = {"ParamOut": pg_out}
+
+    PG().check_output(rtol=1e-5)
+
+    m = np.abs(_r(18).rand(4, 3)).astype(np.float32)
+    m_out = m + g * g
+    lr_t = lr / np.sqrt(m_out)
+    prox = p - lr_t * g
+    pa_out = (np.sign(prox) * np.maximum(np.abs(prox) - lr_t * l1, 0.0)
+              / (1 + lr_t * l2))
+
+    class PA(OpTest):
+        op_type = "proximal_adagrad"
+
+        def setUp(self):
+            self.inputs = {"Param": p, "Moment": m, "Grad": g,
+                           "LearningRate": lr}
+            self.attrs = {"l1": l1, "l2": l2}
+            self.outputs = {"ParamOut": pa_out, "MomentOut": m_out}
+
+    PA().check_output(rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pooling / vision extras
+# ---------------------------------------------------------------------------
+
+def test_maxout():
+    """maxout_op: [N, C, H, W], C split into groups, max over group."""
+    x = _r(20).rand(2, 6, 2, 2).astype(np.float32)
+    out = x.reshape(2, 3, 2, 2, 2).max(axis=2)
+
+    class T(OpTest):
+        op_type = "maxout"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"groups": 2}
+            self.outputs = {"Out": out}
+
+    T().check_output()
+
+
+def test_lrn():
+    """lrn_op.cc: cross-channel local response normalization."""
+    x = _r(21).rand(2, 5, 3, 3).astype(np.float32)
+    n, k, alpha, beta = 3, 2.0, 1e-2, 0.75
+    mid = np.full_like(x, k)
+    for c in range(5):
+        lo, hi = max(0, c - n // 2), min(5, c + n // 2 + 1)
+        mid[:, c] += alpha * (x[:, lo:hi] ** 2).sum(axis=1)
+    out = x / mid ** beta
+
+    class T(OpTest):
+        op_type = "lrn"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+            self.outputs = {"Out": out.astype(np.float32),
+                            "MidOut": mid.astype(np.float32)}
+
+    T().check_output(rtol=1e-4, no_check_set=("MidOut",))
+
+
+def test_max_pool2d_with_index_and_unpool():
+    x = np.array([[[[1, 2, 5, 6],
+                    [3, 4, 7, 8],
+                    [9, 10, 13, 14],
+                    [11, 12, 15, 16]]]], np.float32)
+    out = np.array([[[[4, 8], [12, 16]]]], np.float32)
+    # flat indices within each feature map
+    mask = np.array([[[[5, 7], [13, 15]]]])
+
+    class P(OpTest):
+        op_type = "max_pool2d_with_index"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                          "paddings": [0, 0]}
+            self.outputs = {"Out": out, "Mask": mask}
+
+    P().check_output()
+
+    up = np.zeros((1, 1, 4, 4), np.float32)
+    up.reshape(1, 1, -1)[0, 0, mask.reshape(-1)] = out.reshape(-1)
+
+    class U(OpTest):
+        op_type = "unpool"
+
+        def setUp(self):
+            self.inputs = {"X": out, "Indices": mask}
+            self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                          "paddings": [0, 0], "unpooling_type": "max"}
+            self.outputs = {"Out": up}
+
+    U().check_output()
+
+
+def test_conv_shift():
+    """conv_shift_op.cc: circular correlation of x [B,M] with y [B,N]."""
+    r = _r(22)
+    B, M, N = 2, 5, 3
+    x = r.rand(B, M).astype(np.float32)
+    y = r.rand(B, N).astype(np.float32)
+    out = np.zeros_like(x)
+    half = N // 2
+    for b in range(B):
+        for i in range(M):
+            for j in range(N):
+                out[b, i] += x[b, (i + j - half) % M] * y[b, j]
+
+    class T(OpTest):
+        op_type = "conv_shift"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": out}
+
+    T().check_output(rtol=1e-4)
+
+
+def test_bilinear_tensor_product():
+    """bilinear_tensor_product_op: out[:, k] = x W_k y^T + b_k."""
+    r = _r(23)
+    B, dx, dy, K = 3, 4, 5, 2
+    x = r.rand(B, dx).astype(np.float32)
+    y = r.rand(B, dy).astype(np.float32)
+    w = r.rand(K, dx, dy).astype(np.float32)
+    b = r.rand(1, K).astype(np.float32)
+    out = np.einsum("bi,kij,bj->bk", x, w, y) + b
+
+    class T(OpTest):
+        op_type = "bilinear_tensor_product"
+
+        def setUp(self):
+            self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+            self.outputs = {"Out": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+    T().check_grad(["X", "Y", "Weight"], max_relative_error=1e-2)
+
+
+def test_spp():
+    """spp_op: pyramid levels concat of [1x1, 2x2] max pools."""
+    x = _r(24).rand(2, 3, 4, 4).astype(np.float32)
+    lvl0 = x.max(axis=(2, 3)).reshape(2, -1)                  # 1 bin
+    lvl1 = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)) \
+        .reshape(2, -1)                                       # 4 bins
+    out = np.concatenate([lvl0, lvl1], axis=1)
+
+    class T(OpTest):
+        op_type = "spp"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+            self.outputs = {"Out": out}
+
+    T().check_output()
+
+
+# ---------------------------------------------------------------------------
+# sequence extras (LoD)
+# ---------------------------------------------------------------------------
+
+def test_sequence_concat():
+    """sequence_concat_op: join same-sequence rows from each input."""
+    a = np.arange(6, dtype=np.float32).reshape(3, 2)
+    b = np.arange(10, 18, dtype=np.float32).reshape(4, 2)
+    lod_a, lod_b = [0, 1, 3], [0, 2, 4]
+    out = np.concatenate([a[0:1], b[0:2], a[1:3], b[2:4]])
+
+    class T(OpTest):
+        op_type = "sequence_concat"
+
+        def setUp(self):
+            self.inputs = {"X": [("a", (a, [lod_a])), ("b", (b, [lod_b]))]}
+            self.outputs = {"Out": (out, [[0, 3, 7]])}
+
+    T().check_output()
+
+
+def test_sequence_erase():
+    x = np.array([[1], [2], [3], [2], [5], [2]], np.int64)
+    lod = [0, 3, 6]
+    out = np.array([[1], [3], [5]], np.int64)
+
+    class T(OpTest):
+        op_type = "sequence_erase"
+
+        def setUp(self):
+            self.inputs = {"X": (x, [lod])}
+            self.attrs = {"tokens": [2]}
+            self.outputs = {"Out": (out, [[0, 2, 3]])}
+
+    T().check_output()
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lod = [0, 2, 5]
+    padded = np.zeros((2, 3, 2), np.float32)
+    padded[0, :2] = x[0:2]
+    padded[1, :3] = x[2:5]
+    lengths = np.array([2, 3], np.int64)
+
+    class P(OpTest):
+        op_type = "sequence_pad"
+
+        def setUp(self):
+            self.inputs = {"X": (x, [lod])}
+            self.attrs = {"pad_value": 0.0}
+            self.outputs = {"Out": padded, "Length": lengths}
+
+    P().check_output()
+
+    class U(OpTest):
+        op_type = "sequence_unpad"
+
+        def setUp(self):
+            self.inputs = {"X": padded, "Length": lengths}
+            self.outputs = {"Out": (x, [lod])}
+
+    U().check_output()
+
+
+def test_sequence_slice():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lod = [0, 3, 6]
+    offset = np.array([[1], [0]], np.int64)
+    length = np.array([[2], [1]], np.int64)
+    out = np.concatenate([x[1:3], x[3:4]])
+
+    class T(OpTest):
+        op_type = "sequence_slice"
+
+        def setUp(self):
+            self.inputs = {"X": (x, [lod]), "Offset": offset,
+                           "Length": length}
+            self.outputs = {"Out": (out, [[0, 2, 3]])}
+
+    T().check_output()
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (write/read/length — reference tensor_array_read_write_op.cc)
+# ---------------------------------------------------------------------------
+
+def test_tensor_array_write_read_length():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pd = fluid.layers
+        x = pd.data(name="x", shape=[3], dtype="float32")
+        i0 = pd.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = pd.fill_constant(shape=[1], dtype="int64", value=1)
+        arr = pd.create_array("float32")
+        pd.array_write(x, array=arr, i=i0)
+        pd.array_write(x, array=arr, i=i1)
+        n = pd.array_length(arr)
+        back = pd.array_read(array=arr, i=i1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.array([[1, 2, 3]], np.float32)
+    length, got = exe.run(main, feed={"x": xs}, fetch_list=[n, back])
+    assert int(np.asarray(length).reshape(-1)[0]) == 2
+    np.testing.assert_array_equal(np.asarray(got), xs)
+
+
+# ---------------------------------------------------------------------------
+# precision_recall metric op
+# ---------------------------------------------------------------------------
+
+def test_precision_recall():
+    """precision_recall_op.cc: macro-averaged P/R/F1 from top-1
+    predictions, plus running accumulation state."""
+    C = 3
+    idx = np.array([[0], [1], [2], [1], [0], [2]], np.int64)
+    lbl = np.array([[0], [1], [1], [1], [2], [2]], np.int64)
+    probs = np.zeros((6, 1), np.float32)  # MaxProbs (unused by macro calc)
+    states = np.zeros((C, 4), np.float32)
+
+    # per-class tp/fp/tn/fn from scratch
+    stats = np.zeros((C, 4))
+    for i in range(6):
+        p, t = int(idx[i]), int(lbl[i])
+        if p == t:
+            stats[p, 0] += 1
+        else:
+            stats[p, 1] += 1
+            stats[t, 3] += 1
+    for c in range(C):
+        stats[c, 2] = 6 - stats[c, 0] - stats[c, 1] - stats[c, 3]
+
+    def metrics(s):
+        """[macro_p, macro_r, macro_f1, micro_p, micro_r, micro_f1] —
+        macro averages PER-CLASS F1 (precision_recall_op.h)."""
+        precs, recs, f1s = [], [], []
+        for c in range(C):
+            tp, fp, tn, fn = s[c]
+            p = tp / (tp + fp) if tp + fp else 0.0
+            r = tp / (tp + fn) if tp + fn else 0.0
+            precs.append(p)
+            recs.append(r)
+            f1s.append(2 * p * r / (p + r) if p + r else 0.0)
+        tp, fp, _, fn = s.sum(axis=0)
+        mp = tp / (tp + fp) if tp + fp else 0.0
+        mr = tp / (tp + fn) if tp + fn else 0.0
+        mf = 2 * mp * mr / (mp + mr) if mp + mr else 0.0
+        return np.array([np.mean(precs), np.mean(recs), np.mean(f1s),
+                         mp, mr, mf], np.float64)
+
+    batch = metrics(stats)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        for name, dt in (("MaxProbs", "float32"), ("Indices", "int64"),
+                         ("Labels", "int64"), ("StatesInfo", "float32")):
+            blk.create_var(name=name, dtype=dt)
+        for name in ("BatchMetrics", "AccumMetrics", "AccumStatesInfo"):
+            blk.create_var(name=name, dtype="float32")
+        blk.append_op("precision_recall",
+                      {"MaxProbs": ["MaxProbs"], "Indices": ["Indices"],
+                       "Labels": ["Labels"], "StatesInfo": ["StatesInfo"]},
+                      {"BatchMetrics": ["BatchMetrics"],
+                       "AccumMetrics": ["AccumMetrics"],
+                       "AccumStatesInfo": ["AccumStatesInfo"]},
+                      {"class_number": C})
+    exe = fluid.Executor(fluid.CPUPlace())
+    bm, am, acc = exe.run(
+        main, feed={"MaxProbs": probs, "Indices": idx, "Labels": lbl,
+                    "StatesInfo": states},
+        fetch_list=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"])
+    np.testing.assert_allclose(np.asarray(bm, np.float64), batch,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(acc, np.float64), stats,
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# c_* collective ops under shard_map (the NCCL-op-family analogue)
+# ---------------------------------------------------------------------------
+
+def test_collective_ops_under_shard_map():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import parallel
+    from paddle_tpu.core.executor import program_to_fn
+
+    mesh = parallel.make_mesh({"dp": 8})
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        for name in ("x", "ar", "mean", "mx", "ag", "rs"):
+            blk.create_var(name=name, dtype="float32")
+        blk.append_op("c_allreduce_sum", {"X": ["x"]}, {"Out": ["ar"]},
+                      {"ring_id": "dp"})
+        blk.append_op("c_allreduce_mean", {"X": ["x"]}, {"Out": ["mean"]},
+                      {"ring_id": "dp"})
+        blk.append_op("c_allreduce_max", {"X": ["x"]}, {"Out": ["mx"]},
+                      {"ring_id": "dp"})
+        blk.append_op("c_allgather", {"X": ["x"]}, {"Out": ["ag"]},
+                      {"ring_id": "dp", "axis": 0})
+        blk.append_op("c_reducescatter", {"X": ["ag"]}, {"Out": ["rs"]},
+                      {"ring_id": "dp", "axis": 0})
+    fn = program_to_fn(main, ["x"], ["ar", "mean", "mx", "ag", "rs"])
+
+    def local(feeds):
+        fetches, _ = fn(feeds, {}, jax.random.key(0))
+        return tuple(fetches[n] for n in ("ar", "mean", "mx", "ag", "rs"))
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)  # row i on device i
+    sharded = jax.shard_map(
+        lambda xl: local({"x": xl}), mesh=mesh,
+        in_specs=P("dp"), out_specs=(P("dp"), P("dp"), P("dp"), P("dp"),
+                                     P("dp")))
+    ar, mean, mx, ag, rs = sharded(x)
+    np.testing.assert_allclose(np.asarray(ar), np.full((8, 1), 28.0))
+    np.testing.assert_allclose(np.asarray(mean), np.full((8, 1), 3.5))
+    np.testing.assert_allclose(np.asarray(mx), np.full((8, 1), 7.0))
+    # all_gather(tiled) of per-device rows = full x on every device ->
+    # sharded out_spec slices it back: ag == x rows stacked [64, 1] overall
+    assert np.asarray(ag).shape == (64, 1)
+    # reduce_scatter of the gathered copies: device i gets 8 * x[i]
+    np.testing.assert_allclose(np.asarray(rs), 8.0 * x)
