@@ -78,6 +78,14 @@ class LayerHelper:
             init = (ConstantInitializer(0.0) if is_bias
                     else XavierInitializer())
         shape = [int(s) for s in shape]
+        # amp master weights: layers whose input is already bf16 (an amp
+        # intermediate) must still create f32 parameters — bf16 optimizer
+        # state is numerically unsound (amp.py design; the bug shows as
+        # Adam accumulators exploding on a bf16 bias)
+        from .amp import is_bf16_enabled
+
+        if is_bf16_enabled() and str(dtype) == "bfloat16":
+            dtype = "float32"
         main_p = self.main_program.global_block().create_parameter(
             name, shape, dtype,
             trainable=attr.get("trainable", True),

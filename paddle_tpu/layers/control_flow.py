@@ -46,6 +46,7 @@ __all__ = [
     "reorder_lod_tensor_by_rank",
     "beam_search",
     "beam_search_decode",
+    "recompute",
 ]
 
 
@@ -748,3 +749,66 @@ def Print(input, first_n=-1, message=None, summarize=-1,
          "print_tensor_lod": print_tensor_lod,
          "print_phase": print_phase.upper()})
     return out
+
+
+def recompute(fn, name=None):
+    """Build `fn()`'s layers inside a rematerialized segment: activations
+    in the segment are recomputed during backward instead of stored
+    (lowering: jax.checkpoint over the sub-block — see ops/control_flow.py
+    `recompute`).  `fn` takes no arguments, reads enclosing-scope
+    Variables, and returns a Variable or list of Variables.
+
+        h = fluid.layers.recompute(lambda: big_ffn_stack(x))
+
+    No reference analogue; this is the HBM lever of the TPU build plan
+    (SURVEY.md TPU notes) complementing `memory_optimize` (the reference's
+    liveness transpiler).
+    """
+    helper = LayerHelper("recompute", name=name)
+    program = helper.main_program
+    parent = program.current_block
+    sub = program.create_block()
+    try:
+        result = fn()
+    finally:
+        program.rollback()
+    single = not isinstance(result, (list, tuple))
+    out_vars = [result] if single else list(result)
+    for v in out_vars:
+        if v.name not in sub.vars:
+            raise ValueError(
+                f"recompute: output {v.name!r} was not produced inside "
+                "the segment")
+
+    # read-set: names referenced inside (recursively incl. nested
+    # sub-blocks) but defined outside the segment
+    reads, defined = [], set()
+
+    def walk(block):
+        defined.update(block.vars)
+        for op in block.ops:
+            for names in op.inputs.values():
+                for n in names:
+                    if n not in defined and n not in reads:
+                        reads.append(n)
+            for names in op.outputs.values():
+                defined.update(names)
+            for a in op.attrs.values():
+                if isinstance(a, dict) and "__block__" in a:
+                    walk(program.blocks[a["__block__"]])
+
+    walk(sub)
+
+    outs = []
+    for v in out_vars:
+        pv = parent.create_var(name=v.name, shape=v.shape, dtype=v.dtype,
+                               lod_level=getattr(v, "lod_level", 0))
+        pv.stop_gradient = v.stop_gradient
+        outs.append(pv)
+    parent.append_op(
+        "recompute",
+        {"X": reads},
+        {"Out": [v.name for v in outs]},
+        {"sub_block": {"__block__": sub.idx},
+         "output_names": [v.name for v in outs]})
+    return outs[0] if single else outs

@@ -723,3 +723,40 @@ register_op("recurrent",
             diff_inputs=("StepInputs", "InitMemories", "StaticInputs",
                          "Captured"),
             diff_outputs=("Outs",))(dynamic_rnn)
+
+
+# ---------------------------------------------------------------------------
+# recompute (rematerialization) — TPU-native memory/FLOPs trade
+# ---------------------------------------------------------------------------
+
+
+@register_op("recompute", inputs=("X",), outputs=("Out",),
+             attrs={"output_names": []},
+             diff_inputs=("X",), diff_outputs=("Out",))
+def recompute(ctx, ins, attrs):
+    """Run the sub-block under `jax.checkpoint`: activations inside the
+    segment are NOT saved for backward — the segment re-runs during the
+    grad pass.  No reference analogue (its memory tool is the liveness
+    transpiler, memory_optimization_transpiler.py, which this framework
+    also has); this is the HBM-side lever SURVEY.md's TPU notes call for
+    ("use jax.checkpoint / rematerialisation to trade FLOPs for memory").
+
+    Inputs X are every outer var the segment reads (params included, so
+    the generic VJP yields their grads); Out mirrors the sub-block vars
+    named in `output_names`.
+    """
+    sub = ctx.op.sub_block()
+    in_names = list(ctx.op.input("X"))
+    out_names = list(attrs["output_names"])
+    sub_ops = tuple(sub.ops)
+    in_vals = many(ins, "X")
+
+    def fn(*vals):
+        env = DictEnv(dict(zip(in_names, vals)))
+        sctx = ctx.child(0)
+        for op_ in sub_ops:
+            run_op(sctx, op_, env)
+        return tuple(env.get(n) for n in out_names)
+
+    outs = jax.checkpoint(fn)(*in_vals)
+    return {"Out": list(outs)}

@@ -310,3 +310,72 @@ def average_accumulates(ctx, ins, attrs):
             "OutNumAccumulates": num_acc.reshape(1),
             "OutOldNumAccumulates": old_num.reshape(1),
             "OutNumUpdates": num_upd.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# f32 update arithmetic for sub-f32 storage
+# ---------------------------------------------------------------------------
+
+def _wrap_updates_in_f32():
+    """Re-wrap every optimizer-op lowering to compute in float32 and cast
+    results back to each output's stored dtype.
+
+    Half-precision optimizer STATE arithmetic is numerically unsound (the
+    motivating failure: a bf16 bias parameter's Adam state diverged within
+    two steps; bf16 also rounds beta2=0.999 to exactly 1.0, which pins a
+    bf16 beta2_pow accumulator at 1.0 — the beta pows are additionally
+    forced to f32 storage in optimizer.py).  Under amp this never triggers
+    (params/accumulators are f32 master copies), but models built
+    explicitly in bf16/fp16 hit the optimizer ops with half-precision
+    storage; the reference never faces this because its params are always
+    f32 (optimizer.h kernels).
+    """
+    import jax.numpy as _jnp
+
+    from ..core import registry
+    from ..core.lod import SelectedRows as _SR
+
+    def cast_val(v, dt):
+        if v is None:
+            return v
+        if isinstance(v, _SR):
+            if _jnp.issubdtype(_jnp.asarray(v.value).dtype, _jnp.floating):
+                return _SR(v.rows, _jnp.asarray(v.value).astype(dt),
+                           v.height)
+            return v
+        a = _jnp.asarray(v)
+        return a.astype(dt) if _jnp.issubdtype(a.dtype, _jnp.floating) \
+            else v
+
+    def dtype_of(v):
+        if isinstance(v, _SR):
+            return _jnp.asarray(v.value).dtype
+        return _jnp.asarray(v).dtype
+
+    for name in ("sgd", "momentum", "adam", "adamax", "adagrad",
+                 "adadelta", "decayed_adagrad", "rmsprop", "ftrl",
+                 "proximal_gd", "proximal_adagrad"):
+        info = registry.get_op_info(name)
+        orig = info.lower
+
+        def lower(ctx, ins, attrs, _orig=orig, _info=info):
+            in_dtypes = {}
+            cast_ins = {}
+            for slot, vals in ins.items():
+                in_dtypes[slot] = [None if v is None else dtype_of(v)
+                                   for v in vals]
+                cast_ins[slot] = [cast_val(v, _jnp.float32) for v in vals]
+            outs = _orig(ctx, cast_ins, attrs)
+            for oslot, islot in _info.inplace.items():
+                if oslot in outs and islot in in_dtypes \
+                        and in_dtypes[islot] and \
+                        in_dtypes[islot][0] is not None:
+                    dt = in_dtypes[islot][0]
+                    if _jnp.issubdtype(dt, _jnp.floating):
+                        outs[oslot] = cast_val(outs[oslot], dt)
+            return outs
+
+        info.lower = lower
+
+
+_wrap_updates_in_f32()

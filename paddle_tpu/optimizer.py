@@ -240,11 +240,16 @@ class AdamOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator("moment1", p)
             self._add_accumulator("moment2", p)
-        # global beta powers (reference optimizer.py AdamOptimizer)
+        # global beta powers (reference optimizer.py AdamOptimizer).
+        # ALWAYS f32: with bf16 params, a bf16 beta2_pow rounds 0.999 to
+        # 1.0 and stays there (the scale-op update is outside the f32
+        # optimizer-arithmetic wrapper), making lr_t exactly 0.
         self._beta1_pow = self._add_accumulator(
-            "beta1_pow", parameters[0], fill_value=self._beta1, shape=[1])
+            "beta1_pow", parameters[0], fill_value=self._beta1, shape=[1],
+            dtype="float32")
         self._beta2_pow = self._add_accumulator(
-            "beta2_pow", parameters[0], fill_value=self._beta2, shape=[1])
+            "beta2_pow", parameters[0], fill_value=self._beta2, shape=[1],
+            dtype="float32")
 
     def _append_optimize_op(self, block, pg):
         p, g = pg
@@ -281,8 +286,10 @@ class AdamaxOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator("moment", p)
             self._add_accumulator("inf_norm", p)
+        # f32 for the same reason as Adam's beta pows
         self._beta1_pow = self._add_accumulator(
-            "beta1_pow", parameters[0], fill_value=self._beta1, shape=[1])
+            "beta1_pow", parameters[0], fill_value=self._beta1, shape=[1],
+            dtype="float32")
 
     def _append_optimize_op(self, block, pg):
         p, g = pg

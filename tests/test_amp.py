@@ -6,6 +6,7 @@ never shipped AMP training; this is the TPU rebuild's MXU-native mode).
 import numpy as np
 
 import paddle_tpu as fluid
+from paddle_tpu import amp
 
 
 def _convnet():
@@ -74,3 +75,68 @@ def test_amp_off_keeps_f32_and_caches_separately():
     conv_back, = exe.run(main, feed=feed, fetch_list=[conv], scope=scope,
                          return_numpy=False)
     assert str(conv_back.dtype) == "float32"
+
+
+def test_amp_master_weights_adam_converges():
+    """Regression: under amp, a layer whose input is a bf16 intermediate
+    (fc bias off the bf16 matmul output) must still create f32 params —
+    bf16 Adam state explodes within two steps (beta2 rounds to 0.996 in
+    bf16).  Also covers the f32-compute wrapper on optimizer ops."""
+    r = np.random.RandomState(0)
+    V, B = 50, 16
+    xs = r.rand(B, 8).astype(np.float32)
+    ys = r.randint(0, V, (B, 1)).astype(np.int32)
+    amp.enable_bf16()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            p = fluid.layers.fc(input=x, size=V, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=p, label=y))
+            fluid.Adam(learning_rate=1e-3).minimize(loss)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        bias = [n for n in scope.local_names() if n.endswith(".b_0")]
+        assert np.asarray(scope.find_var(bias[0])).dtype == np.float32
+        tr = [np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                 fetch_list=[loss],
+                                 scope=scope)[0]).item()
+              for _ in range(10)]
+        assert tr[-1] < tr[0] and tr[-1] < 5.0, tr
+    finally:
+        amp.disable_bf16()
+
+
+def test_explicit_bf16_adam_actually_trains():
+    """Regression: an explicitly-bf16 model (no amp) under Adam — beta
+    pow accumulators must be f32 (bf16 rounds 0.999 to 1.0, pinning
+    lr_t at 0) and update arithmetic runs in f32."""
+    r = np.random.RandomState(1)
+    xs = r.rand(8, 4).astype(np.float32).astype("bfloat16")
+    ys = r.rand(8, 1).astype(np.float32).astype("bfloat16")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="bfloat16")
+        y = fluid.layers.data(name="y", shape=[1], dtype="bfloat16")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.cast(
+                fluid.layers.square_error_cost(pred, y), "float32"))
+        fluid.Adam(learning_rate=0.05).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    b2p = [n for n in scope.local_names() if "beta2_pow" in n]
+    assert np.asarray(scope.find_var(b2p[0])).dtype == np.float32
+    w0 = np.asarray(scope.find_var("fc_0.w_0"), np.float32).copy()
+    tr = [np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                             fetch_list=[loss], scope=scope)[0])
+          .reshape(-1)[0].item() for _ in range(20)]
+    w1 = np.asarray(scope.find_var("fc_0.w_0"), np.float32)
+    assert not np.allclose(w0, w1), "params frozen"
+    assert tr[-1] < tr[0], tr
+    # beta2_pow actually decays
+    assert np.asarray(scope.find_var(b2p[0])).reshape(-1)[0] < 0.999
