@@ -43,11 +43,15 @@ class ListenAndServ:
         exe.run(main)   # blocks serving until a client sends STOP
     """
 
-    def __init__(self, endpoint, inputs=None, fan_in=1, optimizer_mode=True):
+    def __init__(self, endpoint, inputs=None, fan_in=1, optimizer_mode=True,
+                 sync_mode=True):
         self.endpoint = endpoint
         self.fan_in = fan_in
         self.inputs = inputs or []
         del optimizer_mode  # reference flag; the block is always the program
+        # sync_mode=False: ASGD pserver (grads apply on arrival, no
+        # barrier round — go/pserver semantics)
+        self.sync_mode = sync_mode
         self.sub = None
 
     @contextlib.contextmanager
@@ -65,7 +69,8 @@ class ListenAndServ:
             {},
             {"sub_block": {"__block__": self.sub.idx},
              "endpoint": self.endpoint,
-             "Fanin": self.fan_in})
+             "Fanin": self.fan_in,
+             "sync_mode": self.sync_mode})
 
 
 def Send(endpoint, send_vars, get_vars):

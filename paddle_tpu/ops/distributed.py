@@ -63,7 +63,8 @@ def recv(ctx, ins, attrs):
 
 
 @register_op("listen_and_serv", inputs=("X",), outputs=(),
-             attrs={"endpoint": "127.0.0.1:0", "Fanin": 1},
+             attrs={"endpoint": "127.0.0.1:0", "Fanin": 1,
+                    "sync_mode": True},
              not_differentiable=True, host=True)
 def listen_and_serv(ctx, ins, attrs):
     """Run a VariableServer over this op's sub-block as the optimize
@@ -91,7 +92,8 @@ def listen_and_serv(ctx, ins, attrs):
     scope = getattr(ctx, "scope", None) or global_scope()
     server = VariableServer(prog if sub.ops else None, scope,
                             Executor(CPUPlace()),
-                            fan_in=attrs.get("Fanin", 1))
+                            fan_in=attrs.get("Fanin", 1),
+                            sync=attrs.get("sync_mode", True))
     endpoint = attrs["endpoint"]
     port = int(endpoint.rsplit(":", 1)[1])
     server.serve(port)

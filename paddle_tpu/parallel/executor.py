@@ -207,7 +207,7 @@ class DistributeTranspiler:
                   startup_program=None,
                   mesh_axes: Optional[Dict[str, int]] = None,
                   shard_optimizer_states: bool = True,
-                  split_method=None):
+                  split_method=None, sync_mode: bool = True):
         from ..core.framework import default_main_program
 
         self._program = program or default_main_program()
@@ -221,6 +221,7 @@ class DistributeTranspiler:
                            if e.strip()]
         self._optimize_ops = list(optimize_ops or [])
         self._trainers = trainers
+        self._sync_mode = sync_mode
         if self._endpoints and params_grads:
             self._transpile_pserver(list(params_grads), split_method)
 
@@ -274,7 +275,9 @@ class DistributeTranspiler:
         mine = {p.name for p, _ in pairs}
         prog, startup = Program(), Program()
         with program_guard(prog, startup):
-            serv = ListenAndServ(endpoint, fan_in=self._trainers)
+            serv = ListenAndServ(endpoint, fan_in=self._trainers,
+                                 sync_mode=getattr(self, "_sync_mode",
+                                                   True))
             with serv.do():
                 sub = prog.current_block
                 for op in self._optimize_ops:

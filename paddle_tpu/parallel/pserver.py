@@ -101,11 +101,17 @@ class VariableServer:
     runs in the server scope and blocked GETs are released.
     """
 
-    def __init__(self, optimize_program, scope, executor, fan_in: int = 1):
+    def __init__(self, optimize_program, scope, executor, fan_in: int = 1,
+                 sync: bool = True):
         self.program = optimize_program
         self.scope = scope
         self.exe = executor
         self.fan_in = fan_in
+        # sync=False: ASGD — each received grad applies immediately, no
+        # barrier round (reference go/pserver SendGrad semantics /
+        # legacy --async_pserver; sync barriers become no-ops)
+        self.sync = sync
+        self._async_progs: Dict[str, object] = {}
         self._lock = threading.Condition()
         self._barriers = 0
         self._round = 0
@@ -168,12 +174,17 @@ class VariableServer:
                 elif verb == "SEND":
                     tid = self._trainer_id(peer or "anon")
                     value = deserialize_var(payload)
-                    with self._lock:
-                        # per-trainer grad rename (listen_and_serv :82)
-                        self.scope.set_var(f"{name}.trainer_{tid}", value)
+                    if self.sync:
+                        with self._lock:
+                            # per-trainer grad rename (listen_and_serv :82)
+                            self.scope.set_var(f"{name}.trainer_{tid}",
+                                               value)
+                    else:
+                        self._apply_async(name, value)
                     _send_frame(conn, "OK")
                 elif verb == "BARRIER":
-                    self._barrier()
+                    if self.sync:
+                        self._barrier()
                     _send_frame(conn, "OK")
                 elif verb == "GET":
                     val = self._blocking_get(name)
@@ -201,6 +212,40 @@ class VariableServer:
                 rnd = self._round
                 while self._round == rnd and not self._stopping:
                     self._lock.wait(timeout=0.1)
+
+    def _prog_for_grad(self, gname):
+        """Slice the optimize program to the ops (transitively) driven by
+        one grad var — the per-parameter optimizer instance of the
+        reference's async pserver (go/pserver/service.go SendGrad: 'one
+        optimizer per parameter')."""
+        prog = self._async_progs.get(gname)
+        if prog is not None:
+            return prog
+        from ..core.framework import Program
+
+        src = self.program.global_block()
+        prog = Program()
+        blk = prog.global_block()
+        produced = set()
+        for op_ in src.ops:
+            ins = {n for ns in op_.inputs.values() for n in ns}
+            if gname in ins or (produced & ins):
+                for v in src.vars.values():
+                    if not blk.has_var(v.name):
+                        blk.create_var(name=v.name, shape=v.shape,
+                                       dtype=v.dtype, persistable=True)
+                blk.append_op(op_.type, dict(op_.inputs),
+                              dict(op_.outputs), dict(op_.attrs))
+                produced.update(n for ns in op_.outputs.values()
+                                for n in ns)
+        self._async_progs[gname] = prog
+        return prog
+
+    def _apply_async(self, name, value):
+        with self._lock:
+            self.scope.set_var(name, value)
+            if self.program is not None:
+                self.exe.run(self._prog_for_grad(name), scope=self.scope)
 
     def _run_optimize(self):
         # sum per-trainer grads into the canonical grad var, then run the

@@ -228,3 +228,44 @@ def test_distribute_transpiler_pserver_mode():
     for ep in eps:
         VariableClient(ep).stop_server()
     reset_clients()
+
+
+def test_variable_server_async_mode():
+    """sync=False (ASGD, go/pserver SendGrad semantics): each grad applies
+    on arrival — no barrier round needed; the per-grad program slice only
+    updates the matching parameter."""
+    scope = fluid.Scope()
+    scope.set_var("w", np.ones(4, np.float32))
+    scope.set_var("v", np.ones(3, np.float32))
+    scope.set_var("pserver_lr", np.asarray([0.1], np.float32))
+
+    # one optimize program updating two params from their grads
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        for pn, gn, n in (("w", "w@GRAD", 4), ("v", "v@GRAD", 3)):
+            p = blk.create_var(name=pn, shape=[n], dtype="float32",
+                               persistable=True)
+            g = blk.create_var(name=gn, shape=[n], dtype="float32",
+                               persistable=True)
+            blk.append_op("sgd",
+                          {"Param": [pn], "Grad": [gn],
+                           "LearningRate": ["pserver_lr"]},
+                          {"ParamOut": [pn]}, {})
+        blk.create_var(name="pserver_lr", shape=[1], dtype="float32",
+                       persistable=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    server = VariableServer(prog, scope, exe, fan_in=99, sync=False)
+    port = server.serve(0)
+    c = VariableClient(f"127.0.0.1:{port}", client_id="t0")
+    # two async sends for w, one for v — no barriers at all
+    c.send_var("w@GRAD", np.full(4, 1.0, np.float32))
+    c.send_var("w@GRAD", np.full(4, 1.0, np.float32))
+    c.send_var("v@GRAD", np.full(3, 2.0, np.float32))
+    w = np.asarray(c.get_var("w"))
+    v = np.asarray(c.get_var("v"))
+    c.close()
+    server.stop()
+    np.testing.assert_allclose(w, 1.0 - 0.1 * 2.0, rtol=1e-6)  # 2 steps
+    np.testing.assert_allclose(v, 1.0 - 0.1 * 2.0, rtol=1e-6)  # 1 step
