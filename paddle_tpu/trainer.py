@@ -21,6 +21,7 @@ from .core.framework import (
 from .data_feeder import DataFeeder
 
 __all__ = [
+    "infer",
     "BeginPass",
     "EndPass",
     "BeginIteration",
@@ -171,3 +172,27 @@ class Trainer:
         self.start()
         io.save_inference_model(dirname, feeded_var_names, target_vars,
                                 self.exe, main_program=self.main_program)
+
+
+def infer(output, feed, program=None, scope=None, place=None,
+          return_numpy=True):
+    """One-shot inference on trained parameters (reference
+    python/paddle/v2/inference.py `paddle.infer(output_layer=..., input=...)`
+    — here parameters come from the scope instead of a Parameters pack).
+
+        probs = fluid.trainer.infer(predict_var, {"img": batch})
+    """
+    from .io import get_inference_program
+
+    outputs = output if isinstance(output, (list, tuple)) else [output]
+    if program is None and hasattr(outputs[0], "block"):
+        # default to the program that OWNS the output var (the ambient
+        # default program is usually not the one built under program_guard)
+        program = outputs[0].block.program
+    prog = get_inference_program(outputs, program)
+    exe = Executor(place) if place is not None else Executor(CPUPlace())
+    res = exe.run(prog, feed=feed,
+                  fetch_list=[o.name if hasattr(o, "name") else str(o)
+                              for o in outputs],
+                  scope=scope, return_numpy=return_numpy)
+    return res[0] if not isinstance(output, (list, tuple)) else res

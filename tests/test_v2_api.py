@@ -257,3 +257,79 @@ def test_float16_interchange_dtype():
     got, = exe.run(main, feed={"x": np.ones((2, 4), np.float16)},
                    fetch_list=[out], scope=scope)
     assert np.asarray(got).dtype == np.float32
+
+
+def test_infer_convenience():
+    """fluid.trainer.infer (v2 paddle.infer parity): prune to the output
+    var's own program and run on trained params."""
+    from paddle_tpu.trainer import infer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(
+                fluid.layers.fc(input=pred, size=1), y))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+    fluid.Executor(fluid.CPUPlace()).run(startup)
+    out = infer(pred, {"x": np.ones((3, 4), np.float32)})
+    assert np.asarray(out).shape == (3, 2)
+    a, = infer([pred], {"x": np.zeros((2, 4), np.float32)})
+    assert np.asarray(a).shape == (2, 2)
+
+
+def test_config_equivalence_fc_vs_manual():
+    """Two different program constructions of the same math produce
+    identical outputs AND gradients (the reference's config-equivalence
+    discipline: gserver/tests/test_NetworkCompare.cpp, concat_dotmul_a
+    vs _b configs)."""
+    r = np.random.RandomState(9)
+    xs = r.rand(5, 6).astype(np.float32)
+    w = r.rand(6, 3).astype(np.float32)
+    b = r.rand(3).astype(np.float32)
+
+    def run_fc():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[6], dtype="float32",
+                                  stop_gradient=False)
+            h = fluid.layers.fc(input=x, size=3, act="relu",
+                                param_attr={"name": "W1"},
+                                bias_attr={"name": "B1"})
+            loss = fluid.layers.mean(h)
+            fluid.append_backward(loss)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        scope.set_var("W1", w)
+        scope.set_var("B1", b)
+        out, gx = exe.run(main, feed={"x": xs},
+                          fetch_list=[h, "x@GRAD"], scope=scope)
+        return np.asarray(out), np.asarray(gx)
+
+    def run_manual():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[6], dtype="float32",
+                                  stop_gradient=False)
+            wv = fluid.layers.data(name="w", shape=[6, 3],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            bv = fluid.layers.data(name="b", shape=[3], dtype="float32",
+                                   append_batch_size=False)
+            h = fluid.layers.relu(
+                fluid.layers.elementwise_add(
+                    fluid.layers.mul(x, wv), bv, axis=1))
+            loss = fluid.layers.mean(h)
+            fluid.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, gx = exe.run(main, feed={"x": xs, "w": w, "b": b},
+                          fetch_list=[h, "x@GRAD"])
+        return np.asarray(out), np.asarray(gx)
+
+    o1, g1 = run_fc()
+    o2, g2 = run_manual()
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
