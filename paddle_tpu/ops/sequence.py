@@ -296,11 +296,22 @@ def sequence_conv(ctx, ins, attrs):
 
 
 @register_op("sequence_pad", inputs=("X",), outputs=("Out", "Length"),
-             attrs={"pad_value": 0.0}, diff_outputs=("Out",))
+             attrs={"pad_value": 0.0, "padded_length": -1},
+             diff_outputs=("Out",))
 def sequence_pad(ctx, ins, attrs):
+    """padded_length=-1 pads to the batch max (reference
+    sequence_padding.h); a positive value fixes the time axis — the
+    static-shape handle attention-over-padded-states needs under jit."""
     xv = one(ins, "X")
     lod = xv.lod[-1]
     idx, mask = lod_to_padded_index(lod)
+    want = int(attrs.get("padded_length", -1))
+    if want > 0:
+        t = idx.shape[1]
+        assert want >= t, (
+            f"sequence_pad: padded_length {want} < longest sequence {t}")
+        idx = np.pad(idx, ((0, 0), (0, want - t)))
+        mask = np.pad(mask, ((0, 0), (0, want - t)))
     out = jnp.take(xv.data, jnp.asarray(idx).reshape(-1), axis=0)
     out = out.reshape(idx.shape + xv.data.shape[1:])
     m = jnp.asarray(mask).reshape(mask.shape + (1,) * (out.ndim - 2))

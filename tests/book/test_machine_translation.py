@@ -176,3 +176,106 @@ def test_machine_translation_decode():
         words = flat[sent[i]:sent[i + 1]]
         assert 1 <= len(words) <= MAX_LEN + 1
         assert ((words >= 0) & (words < DICT)).all()
+
+
+def _encoder_full_seq():
+    """Like encoder() but returns the full state sequence (LoD) too."""
+    src = fluid.layers.data(name="src_word_id", shape=[1], dtype="int64",
+                            lod_level=1)
+    emb = fluid.layers.embedding(input=src, size=[DICT, WORD_DIM],
+                                 param_attr={"name": "vemb"})
+    fc1 = fluid.layers.fc(input=emb, size=HIDDEN * 4, act="tanh")
+    hidden, _ = fluid.layers.dynamic_lstm(input=fc1, size=HIDDEN * 4,
+                                          use_peepholes=False)
+    return hidden, fluid.layers.sequence_last_step(input=hidden)
+
+
+def decoder_train_attention(enc_seq, context, max_src_len):
+    """Attention decoder (BASELINE.json config 4 'seq2seq+attention'):
+    DynamicRNN over the target sequence; each step attends over the
+    padded encoder states (static inputs) with additive masking for the
+    pad positions.  The reference's own book model predates attention
+    (SURVEY.md §5.7); composition uses the same primitive ops its
+    nets.scaled_dot_product_attention would."""
+    pd = fluid.layers
+    # [B, S, H] padded encoder states + [B, S] validity mask (fed)
+    main = fluid.default_main_program()
+    blk = main.current_block
+    padded = blk.create_var(name="enc_padded", dtype="float32")
+    length = blk.create_var(name="enc_len", dtype="int64",
+                            stop_gradient=True)
+    blk.append_op("sequence_pad", {"X": [enc_seq.name]},
+                  {"Out": [padded.name], "Length": [length.name]},
+                  {"pad_value": 0.0, "padded_length": max_src_len})
+    padded.shape = (-1, max_src_len, HIDDEN)
+    padded.stop_gradient = False
+    mask = pd.data(name="att_mask", shape=[max_src_len], dtype="float32")
+
+    trg = pd.data(name="target_language_word", shape=[1], dtype="int64",
+                  lod_level=1)
+    trg_emb = pd.embedding(input=trg, size=[DICT, WORD_DIM],
+                           param_attr={"name": "vemb"})
+    rnn = pd.DynamicRNN()
+    with rnn.block():
+        word = rnn.step_input(trg_emb)
+        enc_s = rnn.static_input(padded)       # [B, S, H]
+        m = rnn.static_input(mask)             # [B, S]
+        pre_state = rnn.memory(init=context)
+        query = pd.fc(input=pre_state, size=HIDDEN, bias_attr=False)
+        q3 = pd.reshape(query, shape=[-1, HIDDEN, 1])
+        scores = pd.reshape(pd.matmul(enc_s, q3),
+                            shape=[-1, max_src_len])      # [B, S]
+        scores = pd.elementwise_add(
+            scores, pd.scale(m, scale=1e9, bias=-1e9))    # mask pads
+        att = pd.softmax(scores)
+        ctx = pd.reshape(
+            pd.matmul(pd.reshape(att, shape=[-1, 1, max_src_len]), enc_s),
+            shape=[-1, HIDDEN])                           # [B, H]
+        state = pd.fc(input=[word, pre_state, ctx], size=HIDDEN,
+                      act="tanh")
+        score = pd.fc(input=state, size=DICT, act="softmax")
+        rnn.update_memory(pre_state, state)
+        rnn.output(score)
+    return rnn()
+
+
+def test_machine_translation_attention_train():
+    """Attention variant learns the copy task faster than chance and the
+    attention machinery (pad + mask + batched matmul under one scan)
+    holds up on variable-length batches."""
+    MAXS = 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc_seq, context = _encoder_full_seq()
+        rnn_out = decoder_train_attention(enc_seq, context, MAXS)
+        label = fluid.layers.data(name="target_language_next_word",
+                                  shape=[1], dtype="int64", lod_level=1)
+        cost = fluid.layers.cross_entropy(input=rnn_out, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+
+    def with_mask(batch):
+        src, trg, nxt = batch
+        lens = np.diff(src.lod[0])
+        m = np.zeros((len(lens), MAXS), np.float32)
+        for i, ln in enumerate(lens):
+            m[i, :ln] = 1.0
+        return src, trg, nxt, m
+
+    batches = [with_mask(_make_batch(r)) for _ in range(4)]
+    first = last = None
+    for step in range(120):
+        src, trg, nxt, m = batches[step % len(batches)]
+        c, = exe.run(main,
+                     feed={"src_word_id": src,
+                           "target_language_word": trg,
+                           "target_language_next_word": nxt,
+                           "att_mask": m},
+                     fetch_list=[avg_cost])
+        if first is None:
+            first = float(np.asarray(c).reshape(-1)[0])
+        last = float(np.asarray(c).reshape(-1)[0])
+    assert last < first * 0.5, f"attention seq2seq: {first} -> {last}"
