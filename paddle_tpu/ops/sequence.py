@@ -331,3 +331,19 @@ def sequence_unpad(ctx, ins, attrs):
     flat = x.reshape((-1,) + x.shape[2:])
     out = jnp.take(flat, jnp.asarray(flat_idx), axis=0)
     return {"Out": LoDTensor(out, [lod])}
+
+
+@register_op("sequence_mask", inputs=("X",), outputs=("Y",),
+             attrs={"maxlen": -1, "out_dtype": "float32"},
+             not_differentiable=True)
+def sequence_mask(ctx, ins, attrs):
+    """[N] lengths -> [N, maxlen] 0/1 mask (the standard companion of
+    sequence_pad for attention masking; maxlen=-1 uses max(lengths),
+    which requires interpreter mode — pass a static maxlen under jit)."""
+    lens = data_of(one(ins, "X")).reshape(-1)
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        maxlen = int(np.asarray(lens).max())
+    j = jnp.arange(maxlen)
+    return {"Y": (j[None, :] < lens[:, None]).astype(
+        attrs.get("out_dtype", "float32"))}

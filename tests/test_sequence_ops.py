@@ -221,3 +221,22 @@ class TestGRUNumerics(OpTest):
 
     def test_grad(self):
         self.check_grad(["Input", "Weight"], max_relative_error=2e-2)
+
+
+def test_sequence_mask():
+    """lengths -> 0/1 mask (sequence_pad's companion)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        blk.create_var(name="len", dtype="int64")
+        blk.create_var(name="mask", dtype="float32")
+        blk.append_op("sequence_mask", {"X": ["len"]}, {"Y": ["mask"]},
+                      {"maxlen": 5, "out_dtype": "float32"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={"len": np.array([2, 5, 0], np.int64)},
+                   fetch_list=["mask"])
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1], [0, 0, 0, 0, 0]])
