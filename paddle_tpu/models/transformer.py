@@ -26,6 +26,7 @@ __all__ = [
     "transformer_decoder",
     "transformer_lm",
     "transformer_translate",
+    "build_lm_generator",
 ]
 
 
@@ -169,3 +170,76 @@ def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
                               is_test)
     logits = layers.fc(input=dec, size=tgt_vocab, num_flatten_dims=2)
     return layers.softmax(logits)
+
+
+def build_lm_generator(vocab_size, max_len, d_model=256, n_heads=4,
+                       n_layers=2, d_inner=None):
+    """Autoregressive generation for the decoder-only LM, fully on-device.
+
+    Builds the LM Program once at width `max_len`, bridges it to a pure
+    jax function (core/executor.program_to_fn), and wraps the decode loop
+    in `jax.lax.fori_loop` inside ONE jit — the whole generation runs as a
+    single XLA computation (no per-token host round-trips; the causal
+    mask makes positions past the cursor inert, so the fixed-width
+    forward is exact).  The reference's analogue is host-side While +
+    beam_search ops over LoD (book/08 decode); this is the static-shape
+    TPU counterpart for the transformer family.
+
+    Returns (startup_program, generate) where
+      generate(states, prompt_ids [B, P], num_steps,
+               temperature=0.0, seed=0) -> ids [B, max_len]
+    with greedy argmax at temperature 0 and softmax sampling otherwise.
+    `states` is the param dict from the startup program (e.g. via
+    `Parameters` or `_init_states`-style scope reads), so generation uses
+    the same trained values as training.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.framework import Program, program_guard
+    from ..core.executor import program_to_fn
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids_in = layers.data(name="gen_ids", shape=[max_len],
+                             dtype="int64")
+        probs = transformer_lm(ids_in, vocab_size, d_model=d_model,
+                               n_heads=n_heads, n_layers=n_layers,
+                               d_inner=d_inner, max_len=max_len,
+                               is_test=True)
+    fn = program_to_fn(main, ["gen_ids"], [probs.name])
+
+    def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, p = prompt_ids.shape
+        assert p + num_steps <= max_len, "prompt + steps exceeds max_len"
+        ids0 = jnp.zeros((b, max_len), jnp.int32)
+        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
+        key = jax.random.key(seed)
+
+        @jax.jit
+        def run(ids0, states):
+            def body(i, carry):
+                ids, k = carry
+                fetches, _ = fn({"gen_ids": ids}, states, k)
+                pr = fetches[probs.name]          # [B, max_len, V]
+                step_p = jax.lax.dynamic_slice_in_dim(
+                    pr, i - 1, 1, axis=1)[:, 0]   # [B, V] at cursor-1
+                if temperature and temperature > 0.0:
+                    k, sub = jax.random.split(k)
+                    logits = jnp.log(step_p + 1e-9) / temperature
+                    nxt = jax.random.categorical(sub, logits, axis=-1)
+                else:
+                    nxt = jnp.argmax(step_p, axis=-1)
+                ids = jax.lax.dynamic_update_slice(
+                    ids, nxt[:, None].astype(jnp.int32), (0, i))
+                return ids, k
+
+            ids, _ = jax.lax.fori_loop(p, p + num_steps, body,
+                                       (ids0, key))
+            return ids
+
+        return run(ids0, states)
+
+    generate.state_names = list(fn.state_in_names)
+    return startup, generate
