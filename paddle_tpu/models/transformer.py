@@ -27,6 +27,7 @@ __all__ = [
     "transformer_lm",
     "transformer_translate",
     "build_lm_generator",
+    "build_lm_kv_decoder",
 ]
 
 
@@ -242,4 +243,148 @@ def build_lm_generator(vocab_size, max_len, d_model=256, n_heads=4,
         return run(ids0, states)
 
     generate.state_names = list(fn.state_in_names)
+    return startup, generate
+
+
+def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
+                        n_layers=2, d_inner=None):
+    """Incremental (KV-cache) generation for the decoder-only LM.
+
+    `build_lm_generator` re-runs the full fixed-width forward per token
+    (O(L) matmuls per step).  This fast path keeps per-layer K/V caches
+    and computes ONE token per step — the standard serving decode loop —
+    as a hand-rolled jax function over the SAME trained parameters:
+    the LM Program is built once, its parameter names are extracted
+    structurally (op walk, creation order), and the incremental math
+    mirrors nets.scaled_dot_product_attention's feature-major head split.
+    Token-identical greedy decode vs the full forward is pinned by
+    tests/test_transformer.py.
+
+    Returns (startup_program, generate) with the same signature as
+    `build_lm_generator`.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.framework import Program, program_guard
+
+    d_inner = d_inner or 4 * d_model
+    d_head = d_model // n_heads
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids_in = layers.data(name="gen_ids", shape=[max_len],
+                             dtype="int64")
+        transformer_lm(ids_in, vocab_size, d_model=d_model,
+                       n_heads=n_heads, n_layers=n_layers,
+                       d_inner=d_inner, max_len=max_len, is_test=True)
+
+    # -- structural parameter extraction (creation order) -------------------
+    blk = main.global_block()
+    params = {v.name for v in blk.all_parameters()}
+    tok_emb = pos_tab = None
+    lns, weights, biases = [], [], []
+    for op in blk.ops:
+        if op.type == "lookup_table":
+            tok_emb = op.inputs["W"][0]
+        elif op.type == "slice" and op.inputs["Input"][0] in params:
+            pos_tab = op.inputs["Input"][0]
+        elif op.type == "layer_norm":
+            lns.append((op.inputs["Scale"][0], op.inputs["Bias"][0]))
+        elif op.type == "mul":
+            weights.append(op.inputs["Y"][0])
+        elif op.type == "elementwise_add":
+            y = op.inputs.get("Y", [None])[0]
+            if y in params and len(biases) < len(weights):
+                biases.append(y)
+    assert tok_emb and pos_tab, "unexpected LM program structure"
+    assert len(weights) == 6 * n_layers + 1, (len(weights), n_layers)
+    assert len(lns) == 2 * n_layers + 1
+    assert len(biases) == len(weights)
+
+    def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
+        g = {n: jnp.asarray(v) for n, v in states.items()}
+
+        def W(i):
+            return g[weights[i]], g[biases[i]]
+
+        def ln(x, i):
+            s, b = g[lns[i][0]], g[lns[i][1]]
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * s + b
+
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, p = prompt_ids.shape
+        assert p + num_steps <= max_len
+        ids0 = jnp.zeros((b, max_len), jnp.int32)
+        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
+        caches0 = tuple(
+            (jnp.zeros((b, max_len, d_model)),
+             jnp.zeros((b, max_len, d_model))) for _ in range(n_layers))
+        key = jax.random.key(seed)
+        scale = 1.0 / math.sqrt(d_head)
+
+        @jax.jit
+        def run(ids0, caches0):
+            def body(i, carry):
+                ids, caches, k = carry
+                tok = jax.lax.dynamic_slice_in_dim(ids, i, 1, 1)[:, 0]
+                x = g[tok_emb][tok] + g[pos_tab][i]        # [B, D]
+                new_caches = []
+                for l in range(n_layers):
+                    h = ln(x, 2 * l)
+                    wq, bq = W(6 * l + 0)
+                    wk, bk = W(6 * l + 1)
+                    wv, bv = W(6 * l + 2)
+                    wo, bo = W(6 * l + 3)
+                    q = h @ wq + bq
+                    kk = h @ wk + bk
+                    vv = h @ wv + bv
+                    ck, cv = caches[l]
+                    ck = jax.lax.dynamic_update_slice(
+                        ck, kk[:, None, :], (0, i, 0))
+                    cv = jax.lax.dynamic_update_slice(
+                        cv, vv[:, None, :], (0, i, 0))
+                    new_caches.append((ck, cv))
+                    qh = q.reshape(b, n_heads, d_head)
+                    kh = ck.reshape(b, max_len, n_heads, d_head)
+                    vh = cv.reshape(b, max_len, n_heads, d_head)
+                    sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
+                    sc = jnp.where(
+                        (jnp.arange(max_len) <= i)[None, None, :],
+                        sc, -jnp.inf)
+                    w_att = jax.nn.softmax(sc, axis=-1)
+                    ctxh = jnp.einsum("bhs,bshd->bhd", w_att, vh)
+                    x = x + (ctxh.reshape(b, d_model) @ wo + bo)
+                    h2 = ln(x, 2 * l + 1)
+                    w1, b1 = W(6 * l + 4)
+                    w2, b2 = W(6 * l + 5)
+                    x = x + (jax.nn.relu(h2 @ w1 + b1) @ w2 + b2)
+                xf = ln(x, 2 * n_layers)
+                wf, bf = W(6 * n_layers)
+                logits = xf @ wf + bf                       # [B, V]
+                if temperature and temperature > 0.0:
+                    k, sub = jax.random.split(k)
+                    nxt = jax.random.categorical(
+                        sub, logits / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                # past the prompt, the model's token becomes position i+1
+                keep_prompt = (i + 1) < p
+                cur = jax.lax.dynamic_slice_in_dim(ids, i + 1, 1, 1)[:, 0]
+                wr = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
+                ids = jax.lax.dynamic_update_slice(
+                    ids, wr[:, None], (0, i + 1))
+                return ids, tuple(new_caches), k
+
+            ids, _, _ = jax.lax.fori_loop(0, p + num_steps - 1, body,
+                                          (ids0, caches0, key))
+            return ids
+
+        return run(ids0, caches0)
+
+    generate.state_names = sorted(params)
     return startup, generate

@@ -191,3 +191,31 @@ def test_lm_generator_learns_successor_task():
     sampled = np.asarray(generate(states, prompt, num_steps=4,
                                   temperature=1.0, seed=7))
     assert ((sampled >= 0) & (sampled < V)).all()
+
+
+def test_kv_decoder_matches_full_forward():
+    """Incremental KV-cache decode is token-identical with the full
+    fixed-width forward decode on the same trained parameters."""
+    import paddle_tpu.core.framework as fw
+    from paddle_tpu.models.transformer import (build_lm_generator,
+                                               build_lm_kv_decoder)
+
+    V, L = 20, 10
+    fw.reset_unique_names()
+    startup, gen_full = build_lm_generator(V, L, d_model=32, n_heads=2,
+                                           n_layers=2)
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    states = {n: np.asarray(scope.find_var(n))
+              for n in gen_full.state_names}
+
+    fw.reset_unique_names()
+    _, gen_kv = build_lm_kv_decoder(V, L, d_model=32, n_heads=2,
+                                    n_layers=2)
+    assert sorted(gen_kv.state_names) == sorted(gen_full.state_names)
+
+    r = np.random.RandomState(4)
+    prompt = r.randint(0, V, (3, 3)).astype(np.int32)
+    a = np.asarray(gen_full(states, prompt, num_steps=6))
+    b = np.asarray(gen_kv(states, prompt, num_steps=6))
+    np.testing.assert_array_equal(a[:, :9], b[:, :9])
