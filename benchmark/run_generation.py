@@ -1,0 +1,78 @@
+"""Autoregressive decode throughput: full-forward loop vs KV-cache loop.
+
+No reference analogue (the reference's generation path is host-side beam
+search over LoD); this benchmarks the transformer serving path added by
+models/transformer.py (build_lm_generator / build_lm_kv_decoder).
+
+Usage: python benchmark/run_generation.py [--batch 8] [--ctx 512]
+       [--prompt 16] [--d-model 512] [--layers 6] [--heads 8] [--iters 3]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+VOCAB = 32000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--ctx", type=int, default=512)
+    ap.add_argument("--prompt", type=int, default=16)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=3)
+    a = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as fluid
+    import paddle_tpu.core.framework as fw
+    from paddle_tpu.models.transformer import (build_lm_generator,
+                                               build_lm_kv_decoder)
+
+    steps = a.ctx - a.prompt
+    r = np.random.RandomState(0)
+    prompt = r.randint(0, VOCAB, (a.batch, a.prompt)).astype(np.int32)
+
+    results = {}
+    for name, builder in (("full_forward", build_lm_generator),
+                          ("kv_cache", build_lm_kv_decoder)):
+        fw.reset_unique_names()
+        startup, gen = builder(VOCAB, a.ctx, d_model=a.d_model,
+                               n_heads=a.heads, n_layers=a.layers)
+        scope = fluid.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+        states = {n: jax.device_put(np.asarray(scope.find_var(n)))
+                  for n in gen.state_names}
+        out = gen(states, prompt, steps)           # compile + warmup
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(a.iters):
+            out = gen(states, prompt, steps)
+            jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / a.iters
+        tok_s = a.batch * steps / dt
+        results[name] = tok_s
+        print(json.dumps({
+            "bench": "decode", "mode": name, "batch": a.batch,
+            "ctx": a.ctx, "d_model": a.d_model, "layers": a.layers,
+            "decode_tokens_per_sec": round(tok_s, 1),
+            "ms_per_token": round(dt / steps * 1000, 3)}))
+    if len(results) == 2:
+        print(json.dumps({
+            "bench": "decode", "kv_speedup_vs_full":
+            round(results["kv_cache"] / results["full_forward"], 2)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
 """
 from . import (  # noqa: F401
     amp,
+    profiler,
     clip,
     concurrency,
     debugger,
@@ -69,10 +70,15 @@ from .concurrency import (  # noqa: F401
 )
 from .data_feeder import DataFeeder  # noqa: F401
 from .parameters import Parameters  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .core.executor import scope_guard, switch_scope  # noqa: F401
+from .core.framework import Block, Operator  # noqa: F401
+from .core.lod import Tensor  # noqa: F401
 from .memory_optimization_transpiler import memory_optimize  # noqa: F401
 from .parallel.executor import (  # noqa: F401
     DistributeTranspiler,
     ParallelExecutor,
+    SimpleDistributeTranspiler,
 )
 
 __version__ = "0.1.0"

@@ -92,7 +92,8 @@ def _check_nan_inf(env, op):
                     f"Operator {op.type!r} output {n!r} contains "
                     "NaN/Inf (check_nan_inf)")
 
-__all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "Executor", "global_scope"]
+__all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "Executor",
+           "global_scope", "scope_guard", "switch_scope"]
 
 
 # ---------------------------------------------------------------------------
@@ -520,3 +521,29 @@ def program_to_fn(program: Program, feed_names, fetch_names, block_idx=0):
     fn.state_in_names = state_in
     fn.state_out_names = state_out
     return fn
+
+
+def switch_scope(scope: Scope) -> Scope:
+    """Replace the global scope, returning the previous one (reference
+    executor.py switch_scope / pybind _switch_scope)."""
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    return prev
+
+
+class scope_guard:
+    """`with fluid.scope_guard(scope): ...` — run with a different global
+    scope (reference executor.py scope_guard)."""
+
+    def __init__(self, scope: Scope):
+        self._scope = scope
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = switch_scope(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        switch_scope(self._prev)
+        return False

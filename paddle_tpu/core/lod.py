@@ -126,3 +126,27 @@ class TensorArray:
 
     def __repr__(self):
         return f"TensorArray(len={len(self.tensors)})"
+
+
+class Tensor:
+    """Host-side tensor container with the pybind Tensor surface
+    (reference pybind.cc:73 — `t = fluid.Tensor(); t.set(arr, place)`).
+    The runtime's actual tensors are jax arrays; this exists for feed
+    construction parity."""
+
+    def __init__(self):
+        self._value = None
+
+    def set(self, array, place=None):
+        import numpy as np
+
+        del place
+        self._value = np.asarray(array)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def __array__(self, dtype=None):
+        import numpy as np
+
+        return np.asarray(self._value, dtype)

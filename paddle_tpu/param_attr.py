@@ -1,0 +1,48 @@
+"""ParamAttr / WeightNormParamAttr — structured parameter attributes.
+
+Reference: /root/reference/python/paddle/v2/fluid/param_attr.py (ParamAttr
+:1-87, WeightNormParamAttr :90-104).  Layers here accept plain dicts for
+parameter attributes; ParamAttr subclasses dict so both spellings work
+interchangeably.  WeightNormParamAttr triggers the weight-normalization
+reparameterization w = g * v / ||v|| (Salimans & Kingma) in
+LayerHelper.create_parameter, matching the reference's
+_create_weight_normalize (layer_helper.py:107-304).
+"""
+from __future__ import annotations
+
+__all__ = ["ParamAttr", "WeightNormParamAttr"]
+
+
+class ParamAttr(dict):
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, gradient_clip=None,
+                 do_model_average=None, update_hooks=None):
+        super().__init__()
+        if name is not None:
+            self["name"] = name
+        if initializer is not None:
+            self["initializer"] = initializer
+        self["learning_rate"] = learning_rate
+        if regularizer is not None:
+            self["regularizer"] = regularizer
+        self["trainable"] = trainable
+        if gradient_clip is not None:
+            self["gradient_clip_attr"] = gradient_clip
+        if do_model_average is not None:
+            self["do_model_average"] = do_model_average
+        if update_hooks is not None:
+            self["update_hooks"] = update_hooks
+
+
+class WeightNormParamAttr(ParamAttr):
+    """`dim`: the dimension KEPT by the norm (g has shape [shape[dim]];
+    None means one scalar g over the whole tensor), as in the reference."""
+
+    # reparameterized outputs (Variables, not Parameters) — lets
+    # inference serialization find them, reference param_attr.py:100
+    params_with_weight_norm = []
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+        self["weight_norm_dim"] = -1 if dim is None else int(dim)

@@ -333,3 +333,70 @@ def test_config_equivalence_fc_vs_manual():
     o2, g2 = run_manual()
     np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_weight_norm_param_attr():
+    """WeightNormParamAttr: w = g * v/||v|| — g initialized to ||v_init||
+    so the initial w equals v_init; per-column norms track g under
+    training (reference layer_helper.py:107-304)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(
+            input=x, size=3, bias_attr=False,
+            param_attr=fluid.WeightNormParamAttr(dim=1, name="wn"))
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.SGD(learning_rate=0.05).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    v = np.asarray(scope.find_var("wn.w_v"))
+    g = np.asarray(scope.find_var("wn.w_g"))
+    np.testing.assert_allclose(g, np.linalg.norm(v, axis=0), rtol=1e-5)
+
+    r = np.random.RandomState(0)
+    xs = r.rand(16, 4).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    # reconstructed w must equal v at init (g == ||v||)
+    got_h, = exe.run(main, feed={"x": np.eye(4, dtype=np.float32),
+                                 "y": np.zeros((4, 1), np.float32)},
+                     fetch_list=[h], scope=scope)
+    np.testing.assert_allclose(np.asarray(got_h), v, rtol=1e-4,
+                               atol=1e-5)
+    losses = [np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                 fetch_list=[loss],
+                                 scope=scope)[0]).item()
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses
+    # v and g both trained
+    assert not np.allclose(v, np.asarray(scope.find_var("wn.w_v")))
+    assert not np.allclose(g, np.asarray(scope.find_var("wn.w_g")))
+
+
+def test_scope_guard_and_tensor():
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        assert fluid.global_scope() is s
+    assert fluid.global_scope() is not s
+    t = fluid.Tensor()
+    t.set(np.arange(6).reshape(2, 3), fluid.CPUPlace())
+    assert t.shape() == [2, 3]
+    np.testing.assert_array_equal(np.asarray(t),
+                                  np.arange(6).reshape(2, 3))
+
+
+def test_param_attr_spelling():
+    """ParamAttr object == dict spelling (both reach layer_helper)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2,
+                        param_attr=fluid.ParamAttr(
+                            name="pa_w",
+                            initializer=fluid.initializer.Constant(0.5)))
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    np.testing.assert_array_equal(np.asarray(scope.find_var("pa_w")),
+                                  np.full((4, 2), 0.5, np.float32))
