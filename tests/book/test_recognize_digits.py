@@ -69,3 +69,41 @@ def test_recognize_digits_mlp():
 def test_recognize_digits_conv():
     acc = _train(_conv_net, conv=True, steps=40)
     assert acc > 0.9, f"conv digits acc too low: {acc}"
+
+
+def test_recognize_digits_save_load_inference(tmp_path):
+    """Reference book tests all round-trip save/load_inference_model
+    (test_recognize_digits_*.py tail); conv variant here."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        prediction = _conv_net(img)
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.Adam(learning_rate=0.01).minimize(avg)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(1)
+    for _ in range(5):
+        x, y = _make_data(r, conv=True)
+        exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg],
+                scope=scope)
+    x, _ = _make_data(r, n=4, conv=True)
+    from paddle_tpu.trainer import infer
+
+    before = infer(prediction, {"img": x}, program=main, scope=scope)
+    d = str(tmp_path / "digits_model")
+    fluid.io.save_inference_model(d, ["img"], [prediction], exe,
+                                  main_program=main, scope=scope)
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog, feeds, fetches = fluid.io.load_inference_model(
+        d, exe2, scope=scope2)
+    assert feeds == ["img"]
+    after, = exe2.run(prog, feed={"img": x}, fetch_list=fetches,
+                      scope=scope2)
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-6)

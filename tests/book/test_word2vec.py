@@ -49,3 +49,37 @@ def test_word2vec_converges():
             first = float(loss[0])
         last = float(loss[0])
     assert last < 0.3, f"word2vec did not converge: {first} -> {last}"
+
+
+def test_word2vec_save_load_inference(tmp_path):
+    """Inference round trip of the embedding model (reference
+    test_word2vec.py tail: save_inference_model + load + same probs)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(4)]
+        embeds = [fluid.layers.embedding(input=w, size=[DICT, EMB],
+                                         param_attr={"name": "shared_w2"})
+                  for w in words]
+        concat = fluid.layers.concat(input=embeds, axis=1)
+        hidden = fluid.layers.fc(input=concat, size=32, act="sigmoid")
+        predict = fluid.layers.fc(input=hidden, size=DICT, act="softmax")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(2)
+    feed = {f"w{i}": r.randint(0, DICT, (6, 1)).astype(np.int64)
+            for i in range(4)}
+    before, = exe.run(main, feed=feed, fetch_list=[predict], scope=scope)
+    d = str(tmp_path / "w2v_model")
+    fluid.io.save_inference_model(d, [f"w{i}" for i in range(4)],
+                                  [predict], exe, main_program=main,
+                                  scope=scope)
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe2,
+                                                         scope=scope2)
+    assert feeds == [f"w{i}" for i in range(4)]
+    after, = exe2.run(prog, feed=feed, fetch_list=fetches, scope=scope2)
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-7)
