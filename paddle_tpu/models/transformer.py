@@ -305,16 +305,7 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
     assert len(biases) == len(weights)
 
     def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
-        g = {n: jnp.asarray(v) for n, v in states.items()}
-
-        def W(i):
-            return g[weights[i]], g[biases[i]]
-
-        def ln(x, i):
-            s, b = g[lns[i][0]], g[lns[i][1]]
-            mu = x.mean(-1, keepdims=True)
-            var = ((x - mu) ** 2).mean(-1, keepdims=True)
-            return (x - mu) / jnp.sqrt(var + 1e-5) * s + b
+        g_in = {n: jnp.asarray(v) for n, v in states.items()}
 
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         b, p = prompt_ids.shape
@@ -328,7 +319,19 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
         scale = 1.0 / math.sqrt(d_head)
 
         @jax.jit
-        def run(ids0, caches0):
+        def run(ids0, caches0, g):
+            # params enter as ARGUMENTS (not jit-closure constants: baking
+            # the weights into the executable makes XLA treat every matmul
+            # operand as a literal — measured 10x slower on the chip)
+            def W(i):
+                return g[weights[i]], g[biases[i]]
+
+            def ln(x, i):
+                s, b = g[lns[i][0]], g[lns[i][1]]
+                mu = x.mean(-1, keepdims=True)
+                var = ((x - mu) ** 2).mean(-1, keepdims=True)
+                return (x - mu) / jnp.sqrt(var + 1e-5) * s + b
+
             def body(i, carry):
                 ids, caches, k = carry
                 tok = jax.lax.dynamic_slice_in_dim(ids, i, 1, 1)[:, 0]
@@ -384,7 +387,7 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
                                           (ids0, caches0, key))
             return ids
 
-        return run(ids0, caches0)
+        return run(ids0, caches0, g_in)
 
     generate.state_names = sorted(params)
     return startup, generate

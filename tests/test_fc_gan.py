@@ -1,0 +1,101 @@
+"""fc GAN demo as a test (reference tests/demo/fc_gan.py — SURVEY.md
+§4.2): two programs over one shared scope, each optimizer restricted to
+its own sub-network via `parameter_list` — the adversarial-training
+workflow the reference demonstrates.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+NZ = 8
+
+
+def _generator(z):
+    h = fluid.layers.fc(input=z, size=32, act="relu",
+                        param_attr={"name": "g_w1"},
+                        bias_attr={"name": "g_b1"})
+    return fluid.layers.fc(input=h, size=1,
+                           param_attr={"name": "g_w2"},
+                           bias_attr={"name": "g_b2"})
+
+
+def _discriminator(x):
+    h = fluid.layers.fc(input=x, size=32, act="relu",
+                        param_attr={"name": "d_w1"},
+                        bias_attr={"name": "d_b1"})
+    return fluid.layers.fc(input=h, size=1,
+                           param_attr={"name": "d_w2"},
+                           bias_attr={"name": "d_b2"})
+
+
+def test_fc_gan_trains():
+    target_mean = 2.0
+
+    # discriminator program: real/fake samples + labels
+    d_main, d_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(d_main, d_startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="float32")
+        logit = _discriminator(x)
+        d_loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, lbl))
+        d_params = [p for p in d_main.global_block().all_parameters()
+                    if p.name.startswith("d_")]
+        fluid.Adam(learning_rate=1e-2).minimize(
+            d_loss, parameter_list=d_params)
+
+    # generator program: z -> G -> D(frozen) with labels "real"
+    g_main, g_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(g_main, g_startup):
+        z = fluid.layers.data(name="z", shape=[NZ], dtype="float32")
+        fake = _generator(z)
+        fake_logit = _discriminator(fake)
+        ones = fluid.layers.fill_constant_batch_size_like(
+            fake_logit, shape=[-1, 1], value=1.0, dtype="float32")
+        g_loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(fake_logit,
+                                                           ones))
+        g_params = [p for p in g_main.global_block().all_parameters()
+                    if p.name.startswith("g_")]
+        fluid.Adam(learning_rate=2e-2).minimize(
+            g_loss, parameter_list=g_params)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    # d params come from d_startup; g params from g_startup (d_* names in
+    # g_startup are re-initialized, then overwritten by sharing the scope
+    # with d_startup's values — run d_startup last to win)
+    exe.run(g_startup, scope=scope)
+    exe.run(d_startup, scope=scope)
+
+    r = np.random.RandomState(0)
+    B = 64
+    g_mean_first = None
+    means, d_losses = [], []
+    for step in range(500):
+        # one D step on a half-real half-fake batch
+        zb = r.randn(B, NZ).astype(np.float32)
+        fake_x, = exe.run(g_main, feed={"z": zb}, fetch_list=[fake],
+                          scope=scope)
+        real_x = (target_mean
+                  + 0.5 * r.randn(B, 1)).astype(np.float32)
+        xb = np.concatenate([real_x, np.asarray(fake_x)])
+        yb = np.concatenate([np.ones((B, 1)), np.zeros((B, 1))]) \
+            .astype(np.float32)
+        dl, = exe.run(d_main, feed={"x": xb, "lbl": yb},
+                      fetch_list=[d_loss], scope=scope)
+        d_losses.append(float(np.asarray(dl).reshape(-1)[0]))
+        # one G step
+        zb = r.randn(B, NZ).astype(np.float32)
+        _, fx = exe.run(g_main, feed={"z": zb},
+                        fetch_list=[g_loss, fake], scope=scope)
+        if g_mean_first is None:
+            g_mean_first = float(np.asarray(fx).mean())
+        means.append(float(np.asarray(fx).mean()))
+    # adversarial equilibrium: the generator ORBITS the target (single
+    # snapshots swing), so judge the trailing average; D sits near the
+    # log(2) indifference point
+    tail = float(np.mean(means[-100:]))
+    assert abs(tail - target_mean) < 0.8, (
+        f"G mean {g_mean_first} -> avg {tail}, target {target_mean}")
+    assert abs(float(np.mean(d_losses[-100:])) - np.log(2)) < 0.25
