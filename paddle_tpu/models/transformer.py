@@ -28,6 +28,7 @@ __all__ = [
     "transformer_translate",
     "build_lm_generator",
     "build_lm_kv_decoder",
+    "build_translate_generator",
 ]
 
 
@@ -391,3 +392,69 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
 
     generate.state_names = sorted(params)
     return startup, generate
+
+
+def build_translate_generator(src_vocab, tgt_vocab, max_src_len,
+                              max_tgt_len, d_model=256, n_heads=4,
+                              n_layers=2, d_inner=None, bos_id=0,
+                              eos_id=1):
+    """Greedy translation decode for the encoder-decoder transformer,
+    on-device (same single-jit fori_loop design as build_lm_generator:
+    the full fixed-width decoder re-runs per step; the causal mask makes
+    positions past the cursor inert).  The book seq2seq's host-side
+    beam_search ops remain the LoD-era path; this is the static-shape
+    transformer counterpart.
+
+    Returns (startup_program, translate) where
+      translate(states, src_ids [B, max_src_len], num_steps) ->
+          tgt ids [B, max_tgt_len] starting with bos_id; positions after
+          an emitted eos_id keep repeating eos_id.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.framework import Program, program_guard
+    from ..core.executor import program_to_fn
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        src = layers.data(name="gen_src", shape=[max_src_len],
+                          dtype="int64")
+        tgt = layers.data(name="gen_tgt", shape=[max_tgt_len],
+                          dtype="int64")
+        probs = transformer_translate(
+            src, tgt, src_vocab, tgt_vocab, d_model=d_model,
+            n_heads=n_heads, n_layers=n_layers, d_inner=d_inner,
+            max_len=max(max_src_len, max_tgt_len), is_test=True)
+    fn = program_to_fn(main, ["gen_src", "gen_tgt"], [probs.name])
+
+    def translate(states, src_ids, num_steps):
+        src_ids = jnp.asarray(src_ids, jnp.int32)
+        b = src_ids.shape[0]
+        assert num_steps < max_tgt_len
+        tgt0 = jnp.full((b, max_tgt_len), eos_id, jnp.int32)
+        tgt0 = tgt0.at[:, 0].set(bos_id)
+        g = {n: jnp.asarray(v) for n, v in states.items()}
+
+        @jax.jit
+        def run(src_ids, tgt0, g):
+            def body(i, tgt):
+                fetches, _ = fn({"gen_src": src_ids, "gen_tgt": tgt}, g,
+                                jax.random.key(0))
+                pr = fetches[probs.name]              # [B, T, V]
+                step_p = jax.lax.dynamic_slice_in_dim(
+                    pr, i - 1, 1, axis=1)[:, 0]
+                nxt = jnp.argmax(step_p, axis=-1).astype(jnp.int32)
+                # once a row emitted eos, keep emitting eos
+                prev = jax.lax.dynamic_slice_in_dim(
+                    tgt, i - 1, 1, axis=1)[:, 0]
+                nxt = jnp.where(prev == eos_id, eos_id, nxt)
+                return jax.lax.dynamic_update_slice(
+                    tgt, nxt[:, None], (0, i))
+
+            return jax.lax.fori_loop(1, 1 + num_steps, body, tgt0)
+
+        return run(src_ids, tgt0, g)
+
+    translate.state_names = list(fn.state_in_names)
+    return startup, translate
