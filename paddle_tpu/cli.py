@@ -111,6 +111,10 @@ def job_train(cfg, args):
 def job_test(cfg, args):
     import paddle_tpu as fluid
 
+    if not args.init_model_path:
+        raise SystemExit(
+            "--job=test requires --init_model_path (otherwise it would "
+            "evaluate freshly initialized random parameters)")
     loss = cfg["loss"]
     test_prog = cfg["main"].clone(for_test=True)
     exe = fluid.Executor(_place(args.use_tpu))
@@ -203,6 +207,10 @@ def job_merge(cfg, args):
     targets = cfg.get("infer_targets")
     if not targets:
         raise SystemExit("--job=merge needs 'infer_targets' from build()")
+    if not args.init_model_path:
+        raise SystemExit(
+            "--job=merge requires --init_model_path (otherwise it would "
+            "package freshly initialized random parameters)")
     exe = fluid.Executor(_place(args.use_tpu))
     _run_startup_or_load(exe, cfg, args)
     feed_names = cfg.get("feed_order")

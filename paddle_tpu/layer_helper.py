@@ -161,9 +161,11 @@ class LayerHelper:
         w = mb.create_var(name=base, dtype=dtype, shape=shape)
         mb.append_op("elementwise_mul", {"X": [v.name], "Y": [ratio.name]},
                      {"Out": [w.name]}, {"axis": max(dim, 0)})
-        from .param_attr import WeightNormParamAttr
-
-        WeightNormParamAttr.params_with_weight_norm.append(w)
+        # tracked per-Program (a class-level list would pin every past
+        # program in memory for the life of the process)
+        self.main_program.params_with_weight_norm = (
+            getattr(self.main_program, "params_with_weight_norm", []))
+        self.main_program.params_with_weight_norm.append(w)
         return w
 
     # -- common layer plumbing ----------------------------------------------

@@ -211,6 +211,35 @@ def build_lm_generator(vocab_size, max_len, d_model=256, n_heads=4,
                                is_test=True)
     fn = program_to_fn(main, ["gen_ids"], [probs.name])
 
+    # ONE jit for the builder's lifetime: defined here (not inside
+    # generate) so repeated generate() calls hit the executable cache —
+    # a per-call closure would re-trace+compile the whole decode loop
+    # every time.  p/num_steps/temperature are static (re-trace only per
+    # distinct shape/temperature).
+    import functools
+
+    @functools.partial(jax.jit,
+                       static_argnames=("p", "num_steps", "temperature"))
+    def _run(ids0, states, key, p, num_steps, temperature):
+        def body(i, carry):
+            ids, k = carry
+            fetches, _ = fn({"gen_ids": ids}, states, k)
+            pr = fetches[probs.name]              # [B, max_len, V]
+            step_p = jax.lax.dynamic_slice_in_dim(
+                pr, i - 1, 1, axis=1)[:, 0]       # [B, V] at cursor-1
+            if temperature and temperature > 0.0:
+                k, sub = jax.random.split(k)
+                logits = jnp.log(step_p + 1e-9) / temperature
+                nxt = jax.random.categorical(sub, logits, axis=-1)
+            else:
+                nxt = jnp.argmax(step_p, axis=-1)
+            ids = jax.lax.dynamic_update_slice(
+                ids, nxt[:, None].astype(jnp.int32), (0, i))
+            return ids, k
+
+        ids, _ = jax.lax.fori_loop(p, p + num_steps, body, (ids0, key))
+        return ids
+
     def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         b, p = prompt_ids.shape
@@ -218,30 +247,8 @@ def build_lm_generator(vocab_size, max_len, d_model=256, n_heads=4,
         ids0 = jnp.zeros((b, max_len), jnp.int32)
         ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
         key = jax.random.key(seed)
-
-        @jax.jit
-        def run(ids0, states):
-            def body(i, carry):
-                ids, k = carry
-                fetches, _ = fn({"gen_ids": ids}, states, k)
-                pr = fetches[probs.name]          # [B, max_len, V]
-                step_p = jax.lax.dynamic_slice_in_dim(
-                    pr, i - 1, 1, axis=1)[:, 0]   # [B, V] at cursor-1
-                if temperature and temperature > 0.0:
-                    k, sub = jax.random.split(k)
-                    logits = jnp.log(step_p + 1e-9) / temperature
-                    nxt = jax.random.categorical(sub, logits, axis=-1)
-                else:
-                    nxt = jnp.argmax(step_p, axis=-1)
-                ids = jax.lax.dynamic_update_slice(
-                    ids, nxt[:, None].astype(jnp.int32), (0, i))
-                return ids, k
-
-            ids, _ = jax.lax.fori_loop(p, p + num_steps, body,
-                                       (ids0, key))
-            return ids
-
-        return run(ids0, states)
+        return _run(ids0, states, key, p, int(num_steps),
+                    float(temperature))
 
     generate.state_names = list(fn.state_in_names)
     return startup, generate
@@ -305,25 +312,20 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
     assert len(lns) == 2 * n_layers + 1
     assert len(biases) == len(weights)
 
-    def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
-        g_in = {n: jnp.asarray(v) for n, v in states.items()}
+    import functools
 
-        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-        b, p = prompt_ids.shape
-        assert p + num_steps <= max_len
-        ids0 = jnp.zeros((b, max_len), jnp.int32)
-        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
-        caches0 = tuple(
-            (jnp.zeros((b, max_len, d_model)),
-             jnp.zeros((b, max_len, d_model))) for _ in range(n_layers))
-        key = jax.random.key(seed)
-        scale = 1.0 / math.sqrt(d_head)
+    scale = 1.0 / math.sqrt(d_head)
 
-        @jax.jit
-        def run(ids0, caches0, g):
+    # one jit per builder (executable cache survives across generate()
+    # calls; p/num_steps/temperature are static)
+    @functools.partial(jax.jit,
+                       static_argnames=("p", "num_steps", "temperature"))
+    def _run(ids0, caches0, g, key, p, num_steps, temperature):
             # params enter as ARGUMENTS (not jit-closure constants: baking
             # the weights into the executable makes XLA treat every matmul
             # operand as a literal — measured 10x slower on the chip)
+            b = ids0.shape[0]
+
             def W(i):
                 return g[weights[i]], g[biases[i]]
 
@@ -388,7 +390,18 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
                                           (ids0, caches0, key))
             return ids
 
-        return run(ids0, caches0, g_in)
+    def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
+        g_in = {n: jnp.asarray(v) for n, v in states.items()}
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, p = prompt_ids.shape
+        assert p + num_steps <= max_len
+        ids0 = jnp.zeros((b, max_len), jnp.int32)
+        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
+        caches0 = tuple(
+            (jnp.zeros((b, max_len, d_model)),
+             jnp.zeros((b, max_len, d_model))) for _ in range(n_layers))
+        return _run(ids0, caches0, g_in, jax.random.key(seed), p,
+                    int(num_steps), float(temperature))
 
     generate.state_names = sorted(params)
     return startup, generate
@@ -428,6 +441,26 @@ def build_translate_generator(src_vocab, tgt_vocab, max_src_len,
             max_len=max(max_src_len, max_tgt_len), is_test=True)
     fn = program_to_fn(main, ["gen_src", "gen_tgt"], [probs.name])
 
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("num_steps",))
+    def _run(src_ids, tgt0, g, num_steps):
+        def body(i, tgt):
+            fetches, _ = fn({"gen_src": src_ids, "gen_tgt": tgt}, g,
+                            jax.random.key(0))
+            pr = fetches[probs.name]              # [B, T, V]
+            step_p = jax.lax.dynamic_slice_in_dim(
+                pr, i - 1, 1, axis=1)[:, 0]
+            nxt = jnp.argmax(step_p, axis=-1).astype(jnp.int32)
+            # once a row emitted eos, keep emitting eos
+            prev = jax.lax.dynamic_slice_in_dim(
+                tgt, i - 1, 1, axis=1)[:, 0]
+            nxt = jnp.where(prev == eos_id, eos_id, nxt)
+            return jax.lax.dynamic_update_slice(
+                tgt, nxt[:, None], (0, i))
+
+        return jax.lax.fori_loop(1, 1 + num_steps, body, tgt0)
+
     def translate(states, src_ids, num_steps):
         src_ids = jnp.asarray(src_ids, jnp.int32)
         b = src_ids.shape[0]
@@ -435,26 +468,7 @@ def build_translate_generator(src_vocab, tgt_vocab, max_src_len,
         tgt0 = jnp.full((b, max_tgt_len), eos_id, jnp.int32)
         tgt0 = tgt0.at[:, 0].set(bos_id)
         g = {n: jnp.asarray(v) for n, v in states.items()}
-
-        @jax.jit
-        def run(src_ids, tgt0, g):
-            def body(i, tgt):
-                fetches, _ = fn({"gen_src": src_ids, "gen_tgt": tgt}, g,
-                                jax.random.key(0))
-                pr = fetches[probs.name]              # [B, T, V]
-                step_p = jax.lax.dynamic_slice_in_dim(
-                    pr, i - 1, 1, axis=1)[:, 0]
-                nxt = jnp.argmax(step_p, axis=-1).astype(jnp.int32)
-                # once a row emitted eos, keep emitting eos
-                prev = jax.lax.dynamic_slice_in_dim(
-                    tgt, i - 1, 1, axis=1)[:, 0]
-                nxt = jnp.where(prev == eos_id, eos_id, nxt)
-                return jax.lax.dynamic_update_slice(
-                    tgt, nxt[:, None], (0, i))
-
-            return jax.lax.fori_loop(1, 1 + num_steps, body, tgt0)
-
-        return run(src_ids, tgt0, g)
+        return _run(src_ids, tgt0, g, int(num_steps))
 
     translate.state_names = list(fn.state_in_names)
     return startup, translate

@@ -213,39 +213,64 @@ class VariableServer:
                 while self._round == rnd and not self._stopping:
                     self._lock.wait(timeout=0.1)
 
-    def _prog_for_grad(self, gname):
-        """Slice the optimize program to the ops (transitively) driven by
-        one grad var — the per-parameter optimizer instance of the
-        reference's async pserver (go/pserver/service.go SendGrad: 'one
-        optimizer per parameter')."""
-        prog = self._async_progs.get(gname)
-        if prog is not None:
-            return prog
+    def _slice_program(self, keep):
         from ..core.framework import Program
 
         src = self.program.global_block()
         prog = Program()
         blk = prog.global_block()
-        produced = set()
-        for op_ in src.ops:
-            ins = {n for ns in op_.inputs.values() for n in ns}
-            if gname in ins or (produced & ins):
-                for v in src.vars.values():
-                    if not blk.has_var(v.name):
-                        blk.create_var(name=v.name, shape=v.shape,
-                                       dtype=v.dtype, persistable=True)
-                blk.append_op(op_.type, dict(op_.inputs),
-                              dict(op_.outputs), dict(op_.attrs))
-                produced.update(n for ns in op_.outputs.values()
-                                for n in ns)
-        self._async_progs[gname] = prog
+        for op_ in keep:
+            for v in src.vars.values():
+                if not blk.has_var(v.name):
+                    blk.create_var(name=v.name, shape=v.shape,
+                                   dtype=v.dtype, persistable=True)
+            blk.append_op(op_.type, dict(op_.inputs), dict(op_.outputs),
+                          dict(op_.attrs))
         return prog
+
+    def _build_async_slices(self):
+        """Per-grad program slices (the per-parameter optimizer instance
+        of the reference's async pserver, go/pserver/service.go SendGrad)
+        plus the EPILOGUE: ops reachable from no gradient (Adam/Adamax
+        beta-pow scale ops, global-step increment).  The epilogue runs
+        once per full sweep of distinct grads so shared schedule state
+        advances at the sync round rate, not once per SEND."""
+        src = self.program.global_block()
+        grads = {n for op_ in src.ops
+                 for n in op_.inputs.get("Grad", [])}
+        selected = {}
+        claimed = set()
+        for g in sorted(grads):
+            keep, produced = [], set()
+            for op_ in src.ops:
+                ins = {n for ns in op_.inputs.values() for n in ns}
+                if g in ins or (produced & ins):
+                    keep.append(op_)
+                    claimed.add(id(op_))
+                    produced.update(n for ns in op_.outputs.values()
+                                    for n in ns)
+            selected[g] = self._slice_program(keep)
+        epilogue = [op_ for op_ in src.ops if id(op_) not in claimed]
+        self._async_progs = selected
+        self._async_epilogue = (self._slice_program(epilogue)
+                                if epilogue else None)
+        self._async_n_grads = max(len(grads), 1)
+        self._async_applied = 0
 
     def _apply_async(self, name, value):
         with self._lock:
             self.scope.set_var(name, value)
-            if self.program is not None:
-                self.exe.run(self._prog_for_grad(name), scope=self.scope)
+            if self.program is None:
+                return
+            if not self._async_progs:
+                self._build_async_slices()
+            prog = self._async_progs.get(name)
+            if prog is not None:
+                self.exe.run(prog, scope=self.scope)
+            self._async_applied += 1
+            if (self._async_epilogue is not None
+                    and self._async_applied % self._async_n_grads == 0):
+                self.exe.run(self._async_epilogue, scope=self.scope)
 
     def _run_optimize(self):
         # sum per-trainer grads into the canonical grad var, then run the

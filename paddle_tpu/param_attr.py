@@ -38,8 +38,11 @@ class WeightNormParamAttr(ParamAttr):
     """`dim`: the dimension KEPT by the norm (g has shape [shape[dim]];
     None means one scalar g over the whole tensor), as in the reference."""
 
-    # reparameterized outputs (Variables, not Parameters) — lets
-    # inference serialization find them, reference param_attr.py:100
+    # Reference API note (param_attr.py:100): the reference tracks the
+    # reparameterized outputs in this class-level list; here they are
+    # tracked per-Program (`program.params_with_weight_norm`) so old
+    # programs can be garbage-collected.  This list stays for import
+    # compatibility and is intentionally never grown.
     params_with_weight_norm = []
 
     def __init__(self, dim=None, **kwargs):

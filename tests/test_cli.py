@@ -63,6 +63,12 @@ def test_cli_train_and_save(config_path, tmp_path):
     assert c1 < c0
 
 
+def test_cli_test_requires_model_path(config_path):
+    r = _run(["--config", config_path, "--job", "test", "--use_tpu", "0"])
+    assert r.returncode != 0
+    assert "init_model_path" in (r.stderr + r.stdout)
+
+
 def test_cli_test_job_with_init_model(config_path, tmp_path):
     save = str(tmp_path / "m")
     r = _run(["--config", config_path, "--job", "train", "--use_tpu", "0",
@@ -89,8 +95,13 @@ def test_cli_checkgrad_job(config_path):
 
 
 def test_cli_merge_job(config_path, tmp_path):
+    save = str(tmp_path / "trained")
+    r = _run(["--config", config_path, "--job", "train", "--use_tpu", "0",
+              "--num_passes", "1", "--save_dir", save])
+    assert r.returncode == 0, r.stderr
     out = str(tmp_path / "merged")
     r = _run(["--config", config_path, "--job", "merge", "--use_tpu", "0",
+              "--init_model_path", os.path.join(save, "pass-00000"),
               "--save_dir", out])
     assert r.returncode == 0, r.stderr
     assert os.path.exists(os.path.join(out, "__model__"))

@@ -269,3 +269,39 @@ def test_variable_server_async_mode():
     server.stop()
     np.testing.assert_allclose(w, 1.0 - 0.1 * 2.0, rtol=1e-6)  # 2 steps
     np.testing.assert_allclose(v, 1.0 - 0.1 * 2.0, rtol=1e-6)  # 1 step
+
+
+def test_variable_server_async_adam_epilogue():
+    """Async mode must still advance shared schedule state (Adam beta-pow
+    scale ops reachable from no grad): the epilogue slice runs once per
+    full sweep of distinct grads."""
+    scope = fluid.Scope()
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        w = fluid.layers.create_parameter([4], "float32", name="aw",
+                                          default_initializer=
+                                          fluid.initializer.Constant(1.0))
+        g = prog.global_block().create_var(name="aw@GRAD", shape=[4],
+                                           dtype="float32",
+                                           persistable=True)
+        g.stop_gradient = True
+        opt = fluid.Adam(learning_rate=0.1)
+        opt.create_optimization_pass([(w, g)], w)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    b2name = [n for n in scope.local_names() if "beta2_pow" in n][0]
+    b2_0 = float(np.asarray(scope.find_var(b2name)).reshape(-1)[0])
+
+    server = VariableServer(prog, scope, exe, sync=False)
+    port = server.serve(0)
+    c = VariableClient(f"127.0.0.1:{port}", client_id="t0")
+    for _ in range(3):
+        c.send_var("aw@GRAD", np.full(4, 0.5, np.float32))
+    got = np.asarray(c.get_var("aw"))
+    c.close()
+    server.stop()
+    assert not np.allclose(got, 1.0)          # param moved
+    b2_3 = float(np.asarray(scope.find_var(b2name)).reshape(-1)[0])
+    # one grad in the program -> epilogue ran once per send: b2 = b2^4
+    np.testing.assert_allclose(b2_3, b2_0 * 0.999 ** 3, rtol=1e-5)
