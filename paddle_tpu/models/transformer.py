@@ -321,74 +321,74 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
     @functools.partial(jax.jit,
                        static_argnames=("p", "num_steps", "temperature"))
     def _run(ids0, caches0, g, key, p, num_steps, temperature):
-            # params enter as ARGUMENTS (not jit-closure constants: baking
-            # the weights into the executable makes XLA treat every matmul
-            # operand as a literal — measured 10x slower on the chip)
-            b = ids0.shape[0]
+        # params enter as ARGUMENTS (not jit-closure constants: baking
+        # the weights into the executable makes XLA treat every matmul
+        # operand as a literal — measured 10x slower on the chip)
+        b = ids0.shape[0]
 
-            def W(i):
-                return g[weights[i]], g[biases[i]]
+        def W(i):
+            return g[weights[i]], g[biases[i]]
 
-            def ln(x, i):
-                s, b = g[lns[i][0]], g[lns[i][1]]
-                mu = x.mean(-1, keepdims=True)
-                var = ((x - mu) ** 2).mean(-1, keepdims=True)
-                return (x - mu) / jnp.sqrt(var + 1e-5) * s + b
+        def ln(x, i):
+            s, b = g[lns[i][0]], g[lns[i][1]]
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * s + b
 
-            def body(i, carry):
-                ids, caches, k = carry
-                tok = jax.lax.dynamic_slice_in_dim(ids, i, 1, 1)[:, 0]
-                x = g[tok_emb][tok] + g[pos_tab][i]        # [B, D]
-                new_caches = []
-                for l in range(n_layers):
-                    h = ln(x, 2 * l)
-                    wq, bq = W(6 * l + 0)
-                    wk, bk = W(6 * l + 1)
-                    wv, bv = W(6 * l + 2)
-                    wo, bo = W(6 * l + 3)
-                    q = h @ wq + bq
-                    kk = h @ wk + bk
-                    vv = h @ wv + bv
-                    ck, cv = caches[l]
-                    ck = jax.lax.dynamic_update_slice(
-                        ck, kk[:, None, :], (0, i, 0))
-                    cv = jax.lax.dynamic_update_slice(
-                        cv, vv[:, None, :], (0, i, 0))
-                    new_caches.append((ck, cv))
-                    qh = q.reshape(b, n_heads, d_head)
-                    kh = ck.reshape(b, max_len, n_heads, d_head)
-                    vh = cv.reshape(b, max_len, n_heads, d_head)
-                    sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
-                    sc = jnp.where(
-                        (jnp.arange(max_len) <= i)[None, None, :],
-                        sc, -jnp.inf)
-                    w_att = jax.nn.softmax(sc, axis=-1)
-                    ctxh = jnp.einsum("bhs,bshd->bhd", w_att, vh)
-                    x = x + (ctxh.reshape(b, d_model) @ wo + bo)
-                    h2 = ln(x, 2 * l + 1)
-                    w1, b1 = W(6 * l + 4)
-                    w2, b2 = W(6 * l + 5)
-                    x = x + (jax.nn.relu(h2 @ w1 + b1) @ w2 + b2)
-                xf = ln(x, 2 * n_layers)
-                wf, bf = W(6 * n_layers)
-                logits = xf @ wf + bf                       # [B, V]
-                if temperature and temperature > 0.0:
-                    k, sub = jax.random.split(k)
-                    nxt = jax.random.categorical(
-                        sub, logits / temperature, axis=-1)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                # past the prompt, the model's token becomes position i+1
-                keep_prompt = (i + 1) < p
-                cur = jax.lax.dynamic_slice_in_dim(ids, i + 1, 1, 1)[:, 0]
-                wr = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
-                ids = jax.lax.dynamic_update_slice(
-                    ids, wr[:, None], (0, i + 1))
-                return ids, tuple(new_caches), k
+        def body(i, carry):
+            ids, caches, k = carry
+            tok = jax.lax.dynamic_slice_in_dim(ids, i, 1, 1)[:, 0]
+            x = g[tok_emb][tok] + g[pos_tab][i]        # [B, D]
+            new_caches = []
+            for l in range(n_layers):
+                h = ln(x, 2 * l)
+                wq, bq = W(6 * l + 0)
+                wk, bk = W(6 * l + 1)
+                wv, bv = W(6 * l + 2)
+                wo, bo = W(6 * l + 3)
+                q = h @ wq + bq
+                kk = h @ wk + bk
+                vv = h @ wv + bv
+                ck, cv = caches[l]
+                ck = jax.lax.dynamic_update_slice(
+                    ck, kk[:, None, :], (0, i, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, vv[:, None, :], (0, i, 0))
+                new_caches.append((ck, cv))
+                qh = q.reshape(b, n_heads, d_head)
+                kh = ck.reshape(b, max_len, n_heads, d_head)
+                vh = cv.reshape(b, max_len, n_heads, d_head)
+                sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
+                sc = jnp.where(
+                    (jnp.arange(max_len) <= i)[None, None, :],
+                    sc, -jnp.inf)
+                w_att = jax.nn.softmax(sc, axis=-1)
+                ctxh = jnp.einsum("bhs,bshd->bhd", w_att, vh)
+                x = x + (ctxh.reshape(b, d_model) @ wo + bo)
+                h2 = ln(x, 2 * l + 1)
+                w1, b1 = W(6 * l + 4)
+                w2, b2 = W(6 * l + 5)
+                x = x + (jax.nn.relu(h2 @ w1 + b1) @ w2 + b2)
+            xf = ln(x, 2 * n_layers)
+            wf, bf = W(6 * n_layers)
+            logits = xf @ wf + bf                       # [B, V]
+            if temperature and temperature > 0.0:
+                k, sub = jax.random.split(k)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # past the prompt, the model's token becomes position i+1
+            keep_prompt = (i + 1) < p
+            cur = jax.lax.dynamic_slice_in_dim(ids, i + 1, 1, 1)[:, 0]
+            wr = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
+            ids = jax.lax.dynamic_update_slice(
+                ids, wr[:, None], (0, i + 1))
+            return ids, tuple(new_caches), k
 
-            ids, _, _ = jax.lax.fori_loop(0, p + num_steps - 1, body,
-                                          (ids0, caches0, key))
-            return ids
+        ids, _, _ = jax.lax.fori_loop(0, p + num_steps - 1, body,
+                                      (ids0, caches0, key))
+        return ids
 
     def generate(states, prompt_ids, num_steps, temperature=0.0, seed=0):
         g_in = {n: jnp.asarray(v) for n, v in states.items()}
