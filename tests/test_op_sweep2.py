@@ -685,3 +685,52 @@ def test_lod_tensor_array_roundtrip_and_shrink():
     np.testing.assert_allclose(np.asarray(got.data), data)
     lod = [list(level) for level in got.lod]
     assert lod in ([[0, 2, 6]], [[0, 4, 6]])  # original or rank order
+
+
+def test_collective_broadcast_and_ppermute():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import parallel
+    from paddle_tpu.core.executor import program_to_fn
+
+    mesh = parallel.make_mesh({"dp": 8})
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        for name in ("x", "bc", "pp"):
+            blk.create_var(name=name, dtype="float32")
+        blk.append_op("c_broadcast", {"X": ["x"]}, {"Out": ["bc"]},
+                      {"ring_id": "dp", "root": 2})
+        blk.append_op("c_ppermute", {"X": ["x"]}, {"Out": ["pp"]},
+                      {"ring_id": "dp", "shift": 1})
+    fn = program_to_fn(main, ["x"], ["bc", "pp"])
+
+    def local(xl):
+        fetches, _ = fn({"x": xl}, {}, jax.random.key(0))
+        return fetches["bc"], fetches["pp"]
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    bc, pp = jax.shard_map(local, mesh=mesh, in_specs=P("dp"),
+                           out_specs=(P("dp"), P("dp")))(x)
+    np.testing.assert_allclose(np.asarray(bc), np.full((8, 1), 2.0))
+    np.testing.assert_allclose(np.asarray(pp).reshape(-1),
+                               np.roll(np.arange(8), -1 * -1))
+
+
+def test_uniform_random_batch_size_like():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        blk.create_var(name="ref", dtype="float32")
+        blk.create_var(name="u", dtype="float32")
+        blk.append_op("uniform_random_batch_size_like", {"Input": ["ref"]},
+                      {"Out": ["u"]},
+                      {"shape": [1, 5], "min": 0.0, "max": 1.0, "seed": 3,
+                       "dtype": "float32", "input_dim_idx": 0,
+                       "output_dim_idx": 0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={"ref": np.zeros((7, 2), np.float32)},
+                   fetch_list=["u"])
+    g = np.asarray(got)
+    assert g.shape == (7, 5) and g.min() >= 0.0 and g.max() <= 1.0
