@@ -29,6 +29,7 @@ __all__ = [
     "build_lm_generator",
     "build_lm_kv_decoder",
     "build_translate_generator",
+    "build_lm_beam_search",
 ]
 
 
@@ -472,3 +473,92 @@ def build_translate_generator(src_vocab, tgt_vocab, max_src_len,
 
     translate.state_names = list(fn.state_in_names)
     return startup, translate
+
+
+def build_lm_beam_search(vocab_size, max_len, beam_size=4, d_model=256,
+                         n_heads=4, n_layers=2, d_inner=None,
+                         length_penalty=0.0):
+    """Static-shape beam search for the decoder-only LM, on-device.
+
+    The LoD-era path (reference beam_search/beam_search_decode ops, kept
+    for the book seq2seq) prunes hypotheses host-side with dynamic
+    shapes; on TPU the beam is a fixed [B, K] lane structure folded into
+    the batch: each step scores all K beams in one fixed-width forward
+    (B*K rows), takes top-K over the K*V continuation scores, and
+    gathers the winning prefixes — all inside one jit.
+
+    Returns (startup_program, search) where
+      search(states, prompt_ids [B, P], num_steps) ->
+          (ids [B, K, max_len], scores [B, K]) sorted best-first;
+    scores are sum log p (optionally /len^length_penalty).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.framework import Program, program_guard
+    from ..core.executor import program_to_fn
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids_in = layers.data(name="gen_ids", shape=[max_len],
+                             dtype="int64")
+        probs = transformer_lm(ids_in, vocab_size, d_model=d_model,
+                               n_heads=n_heads, n_layers=n_layers,
+                               d_inner=d_inner, max_len=max_len,
+                               is_test=True)
+    fn = program_to_fn(main, ["gen_ids"], [probs.name])
+    K = int(beam_size)
+
+    @functools.partial(jax.jit, static_argnames=("p", "num_steps"))
+    def _run(ids0, states, p, num_steps):
+        b = ids0.shape[0]
+
+        def body(i, carry):
+            ids, scores = carry            # [B, K, L], [B, K]
+            flat = ids.reshape(b * K, max_len)
+            fetches, _ = fn({"gen_ids": flat}, states,
+                            jax.random.key(0))
+            pr = fetches[probs.name]       # [B*K, L, V]
+            step_p = jax.lax.dynamic_slice_in_dim(
+                pr, i - 1, 1, axis=1)[:, 0].reshape(b, K, vocab_size)
+            logp = jnp.log(step_p + 1e-9)
+            # at the first expansion only beam 0 is a real hypothesis
+            first = (i == p)
+            beam_mask = jnp.where(
+                first,
+                jnp.concatenate([jnp.zeros((1,)),
+                                 jnp.full((K - 1,), -jnp.inf)])[None, :],
+                jnp.zeros((1, K)))
+            cand = scores[:, :, None] + logp + beam_mask[:, :, None]
+            flat_cand = cand.reshape(b, K * vocab_size)
+            top_scores, top_idx = jax.lax.top_k(flat_cand, K)   # [B, K]
+            src_beam = top_idx // vocab_size
+            tok = (top_idx % vocab_size).astype(jnp.int32)
+            ids = jnp.take_along_axis(
+                ids, src_beam[:, :, None], axis=1)              # regather
+            ids = jax.lax.dynamic_update_slice(
+                ids, tok[:, :, None], (0, 0, i))
+            return ids, top_scores
+
+        ids0 = jnp.broadcast_to(ids0[:, None, :],
+                                (b, K, max_len)).copy()
+        scores0 = jnp.zeros((b, K))
+        ids, scores = jax.lax.fori_loop(p, p + num_steps, body,
+                                        (ids0, scores0))
+        if length_penalty:
+            scores = scores / (num_steps ** length_penalty)
+        return ids, scores
+
+    def search(states, prompt_ids, num_steps):
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, p = prompt_ids.shape
+        assert p + num_steps <= max_len
+        ids0 = jnp.zeros((b, max_len), jnp.int32)
+        ids0 = jax.lax.dynamic_update_slice(ids0, prompt_ids, (0, 0))
+        g = {n: jnp.asarray(v) for n, v in states.items()}
+        return _run(ids0, g, p, int(num_steps))
+
+    search.state_names = list(fn.state_in_names)
+    return startup, search
