@@ -476,8 +476,7 @@ def build_translate_generator(src_vocab, tgt_vocab, max_src_len,
 
 
 def build_lm_beam_search(vocab_size, max_len, beam_size=4, d_model=256,
-                         n_heads=4, n_layers=2, d_inner=None,
-                         length_penalty=0.0):
+                         n_heads=4, n_layers=2, d_inner=None):
     """Static-shape beam search for the decoder-only LM, on-device.
 
     The LoD-era path (reference beam_search/beam_search_decode ops, kept
@@ -490,7 +489,9 @@ def build_lm_beam_search(vocab_size, max_len, beam_size=4, d_model=256,
     Returns (startup_program, search) where
       search(states, prompt_ids [B, P], num_steps) ->
           (ids [B, K, max_len], scores [B, K]) sorted best-first;
-    scores are sum log p (optionally /len^length_penalty).
+    scores are sum log p.  (No EOS handling: all beams share one length,
+    so GNMT-style length normalization would be a constant rescale —
+    deliberately not offered as a knob.)
     """
     import functools
 
@@ -547,8 +548,6 @@ def build_lm_beam_search(vocab_size, max_len, beam_size=4, d_model=256,
         scores0 = jnp.zeros((b, K))
         ids, scores = jax.lax.fori_loop(p, p + num_steps, body,
                                         (ids0, scores0))
-        if length_penalty:
-            scores = scores / (num_steps ** length_penalty)
         return ids, scores
 
     def search(states, prompt_ids, num_steps):

@@ -112,6 +112,8 @@ class VariableServer:
         # legacy --async_pserver; sync barriers become no-ops)
         self.sync = sync
         self._async_progs: Dict[str, object] = {}
+        self._async_built = False
+        self._async_seen: set = set()
         self._lock = threading.Condition()
         self._barriers = 0
         self._round = 0
@@ -254,23 +256,27 @@ class VariableServer:
         self._async_progs = selected
         self._async_epilogue = (self._slice_program(epilogue)
                                 if epilogue else None)
-        self._async_n_grads = max(len(grads), 1)
-        self._async_applied = 0
+        self._async_grads = grads
+        self._async_built = True
 
     def _apply_async(self, name, value):
         with self._lock:
             self.scope.set_var(name, value)
             if self.program is None:
                 return
-            if not self._async_progs:
+            if not self._async_built:
                 self._build_async_slices()
             prog = self._async_progs.get(name)
             if prog is not None:
                 self.exe.run(prog, scope=self.scope)
-            self._async_applied += 1
-            if (self._async_epilogue is not None
-                    and self._async_applied % self._async_n_grads == 0):
+                self._async_seen.add(name)
+            # epilogue fires once per full sweep of DISTINCT grads (Adam
+            # beta pows / global step advance at the sync round rate);
+            # non-grad sends and resends don't advance the cadence
+            if (self._async_epilogue is not None and self._async_grads
+                    and self._async_seen >= self._async_grads):
                 self.exe.run(self._async_epilogue, scope=self.scope)
+                self._async_seen.clear()
 
     def _run_optimize(self):
         # sum per-trainer grads into the canonical grad var, then run the
