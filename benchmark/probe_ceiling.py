@@ -1,0 +1,159 @@
+"""Perf probe: hand-written pure-JAX ResNet-50 train step (no program
+layer) to establish the achievable single-chip ceiling for the bench
+config.  Not part of the published bench — a diagnostic for the perf gap
+between paddle_tpu's program-lowered step and what the chip can do.
+
+Usage: python benchmark/probe_ceiling.py [--layout NHWC|NCHW] [--iters N]
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 256
+IMG = 224
+
+
+def conv(x, w, stride, layout):
+    df = layout
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=(df, "HWIO" if layout == "NHWC" else "OIHW", df))
+
+
+def init_resnet50(key, layout, dtype):
+    """Params as a flat list of (kind, arrays) in execution order."""
+    cfg = [(3, 64), (4, 128), (6, 256), (3, 512)]
+    params = []
+    k = iter(jax.random.split(key, 200))
+
+    def conv_w(cin, cout, ks):
+        fan = ks * ks * cin
+        w = (jax.random.normal(next(k), (ks, ks, cin, cout), dtype) *
+             jnp.asarray(np.sqrt(2.0 / fan), dtype))
+        if layout == "NCHW":
+            w = w.transpose(3, 2, 0, 1)
+        return w
+
+    def bn_p(c):
+        return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype),
+                "mean": jnp.zeros((c,), jnp.float32),
+                "var": jnp.ones((c,), jnp.float32)}
+
+    params.append({"w": conv_w(3, 64, 7), "bn": bn_p(64)})
+    cin = 64
+    blocks = []
+    strides = []
+    for i, (count, ch) in enumerate(cfg):
+        for b in range(count):
+            stride = 2 if (i > 0 and b == 0) else 1
+            blk = {
+                "w1": conv_w(cin, ch, 1), "bn1": bn_p(ch),
+                "w2": conv_w(ch, ch, 3), "bn2": bn_p(ch),
+                "w3": conv_w(ch, ch * 4, 1), "bn3": bn_p(ch * 4),
+            }
+            if stride != 1 or cin != ch * 4:
+                blk["ws"] = conv_w(cin, ch * 4, 1)
+                blk["bns"] = bn_p(ch * 4)
+            cin = ch * 4
+            blocks.append(blk)
+            strides.append(stride)
+    fc_w = jax.random.normal(next(k), (2048, 1000), dtype) * 0.01
+    return {"stem": params[0], "blocks": blocks,
+            "fc": {"w": fc_w, "b": jnp.zeros((1000,), dtype)}}, \
+        tuple(strides)
+
+
+def bn(x, p, layout):
+    c_axis = 3 if layout == "NHWC" else 1
+    axes = tuple(i for i in range(4) if i != c_axis)
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes)
+    v = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(m)
+    sh = [1] * 4
+    sh[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(v + 1e-5)
+    y = (xf - m.reshape(sh)) * inv.reshape(sh)
+    return (y.astype(x.dtype) * p["scale"].reshape(sh) +
+            p["bias"].reshape(sh))
+
+
+def fwd(params, x, labels, layout, strides):
+    y = conv(x, params["stem"]["w"], 2, layout)
+    y = jax.nn.relu(bn(y, params["stem"]["bn"], layout))
+    if layout == "NHWC":
+        y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                                  (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1),
+                                                 (0, 0)])
+    else:
+        y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 1, 3, 3),
+                                  (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1),
+                                                 (1, 1)])
+    for blk, s in zip(params["blocks"], strides):
+        short = y
+        if "ws" in blk:
+            short = bn(conv(y, blk["ws"], s, layout), blk["bns"], layout)
+        z = jax.nn.relu(bn(conv(y, blk["w1"], s, layout), blk["bn1"], layout))
+        z = jax.nn.relu(bn(conv(z, blk["w2"], 1, layout), blk["bn2"], layout))
+        z = bn(conv(z, blk["w3"], 1, layout), blk["bn3"], layout)
+        y = jax.nn.relu(short + z)
+    axes = (1, 2) if layout == "NHWC" else (2, 3)
+    y = jnp.mean(y.astype(jnp.float32), axis=axes).astype(y.dtype)
+    logits = y @ params["fc"]["w"] + params["fc"]["b"]
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "strides"),
+                   donate_argnums=(0, 1))
+def train_step(params, mom, x, labels, layout, strides):
+    loss, grads = jax.value_and_grad(
+        lambda p: fwd(p, x, labels, layout, strides))(params)
+    new_mom = jax.tree.map(lambda m, g: 0.9 * m + g.astype(m.dtype),
+                           mom, grads)
+    new_params = jax.tree.map(lambda p, m: p - (0.1 * m).astype(p.dtype),
+                              params, new_mom)
+    return new_params, new_mom, loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layout", default="NHWC")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+    layout = args.layout
+    dtype = jnp.dtype(args.dtype)
+
+    params, strides = init_resnet50(jax.random.key(0), layout, dtype)
+    mom = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+    r = np.random.RandomState(0)
+    shape = ((BATCH, IMG, IMG, 3) if layout == "NHWC"
+             else (BATCH, 3, IMG, IMG))
+    x = jax.device_put(r.rand(*shape).astype(np.float32)).astype(dtype)
+    labels = jax.device_put(r.randint(0, 1000, (BATCH,)).astype(np.int32))
+
+    params, mom, loss = train_step(params, mom, x, labels, layout, strides)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, mom, loss = train_step(params, mom, x, labels, layout,
+                                       strides)
+    jax.block_until_ready(loss)
+    ms = (time.perf_counter() - t0) / args.iters * 1000
+    img_s = BATCH / ms * 1000
+    tf = 12.3e9 * img_s / 1e12
+    print(f"layout={layout} dtype={args.dtype}: {ms:.2f} ms/step, "
+          f"{img_s:.0f} img/s, ~{tf:.1f} TFLOP/s, "
+          f"MFU~{100 * tf / 197:.1f}% (v5e bf16 peak 197)")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,12 +40,11 @@ def transpose(ctx, ins, attrs):
 def concat(ctx, ins, attrs):
     vs = many(ins, "X")
     out = jnp.concatenate([data_of(v) for v in vs], axis=attrs["axis"])
-    if attrs["axis"] != 0:
-        # feature-axis concat keeps the row structure: propagate the first
-        # input's LoD (reference concat_op.cc shares Ins[0]'s lod)
-        for v in vs:
-            if isinstance(v, LoDTensor):
-                return {"Out": LoDTensor(out, list(v.lod))}
+    if attrs["axis"] != 0 and isinstance(vs[0], LoDTensor):
+        # feature-axis concat keeps the row structure: share Ins[0]'s lod
+        # specifically (reference concat_op.cc) — not whichever input
+        # happens to carry one
+        return {"Out": LoDTensor(out, list(vs[0].lod))}
     return {"Out": out}
 
 

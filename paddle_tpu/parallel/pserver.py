@@ -123,6 +123,11 @@ class VariableServer:
         self._threads = []
         self._stopping = False
         self.port = None
+        if not sync and self.program is not None:
+            # validate the optimize program HERE, where the user can see
+            # the error — a raise inside a handler thread would surface to
+            # trainers only as a dropped connection
+            self._build_async_slices()
 
     # -- lifecycle ----------------------------------------------------------
     def serve(self, port: int = 0) -> int:
@@ -242,11 +247,23 @@ class VariableServer:
                  for n in op_.inputs.get("Grad", [])}
         selected = {}
         claimed = set()
+        claimed_by = {}  # id(op) -> first grad slice that claimed it
         for g in sorted(grads):
             keep, produced = [], set()
             for op_ in src.ops:
                 ins = {n for ns in op_.inputs.values() for n in ns}
                 if g in ins or (produced & ins):
+                    prev = claimed_by.setdefault(id(op_), g)
+                    if prev != g:
+                        # an op reading multiple grads (e.g. a global-norm
+                        # clip) would re-execute per arriving grad against
+                        # stale peer grads — refuse rather than silently
+                        # duplicate; such programs need sync_mode=True
+                        raise ValueError(
+                            f"async pserver: op {op_.type!r} is reachable "
+                            f"from both grad {prev!r} and grad {g!r}; "
+                            "multi-grad ops cannot run grads-on-arrival — "
+                            "use sync_mode=True for this optimize program")
                     keep.append(op_)
                     claimed.add(id(op_))
                     produced.update(n for ns in op_.outputs.values()
@@ -264,8 +281,7 @@ class VariableServer:
             self.scope.set_var(name, value)
             if self.program is None:
                 return
-            if not self._async_built:
-                self._build_async_slices()
+            assert self._async_built  # built (and validated) in __init__
             prog = self._async_progs.get(name)
             if prog is not None:
                 self.exe.run(prog, scope=self.scope)

@@ -110,19 +110,37 @@ class Parameters:
             ti.size = len(mbytes)
             tar.addfile(ti, _io.BytesIO(mbytes))
 
-    def init_from_tar(self, f) -> None:
-        """Load values for parameters present in BOTH tar and program
-        (v2 init_from_tar semantics: extra tar entries are ignored)."""
-        own = self._param_vars()
+    @staticmethod
+    def _iter_tar_arrays(f):
+        """Yield (name, ndarray) for every .npy member of a params tar."""
         with tarfile.open(fileobj=f, mode="r") as tar:
             for member in tar.getmembers():
                 if not member.name.endswith(".npy"):
                     continue
                 name = member.name[:-len(".npy")]
-                if name not in own:
-                    continue
                 arr = np.load(_io.BytesIO(tar.extractfile(member).read()),
                               allow_pickle=False)
+                yield name, arr
+
+    def init_from_tar(self, f) -> None:
+        """Load values for parameters present in BOTH tar and program
+        (v2 init_from_tar semantics: extra tar entries are ignored)."""
+        own = self._param_vars()
+        for name, arr in self._iter_tar_arrays(f):
+            if name in own:
                 self.set(name, arr)
 
-    from_tar = init_from_tar
+    @staticmethod
+    def from_tar(f) -> "Parameters":
+        """Construct a NEW ``Parameters`` solely from a tar stream
+        (reference v2 parameters.py:274 ``@staticmethod from_tar``): a
+        detached Program holding one Parameter var per tar entry and a
+        private Scope with the loaded values.  Use ``init_from_tar`` to
+        load values into an existing program's parameters in place."""
+        prog = Program()
+        scope = Scope()
+        blk = prog.global_block()
+        for name, arr in Parameters._iter_tar_arrays(f):
+            blk.create_parameter(name, list(arr.shape), str(arr.dtype))
+            scope.set_var(name, arr)
+        return Parameters(prog, scope)

@@ -305,3 +305,36 @@ def test_variable_server_async_adam_epilogue():
     b2_3 = float(np.asarray(scope.find_var(b2name)).reshape(-1)[0])
     # one grad in the program -> epilogue ran once per send: b2 = b2^4
     np.testing.assert_allclose(b2_3, b2_0 * 0.999 ** 3, rtol=1e-5)
+
+
+def test_variable_server_async_rejects_multi_grad_op():
+    """An op reading two different grads (e.g. a grad-sum) cannot run
+    grads-on-arrival: _build_async_slices must fail fast instead of
+    silently duplicating the op into both slices."""
+    import pytest
+    from paddle_tpu.parallel.pserver import VariableServer
+
+    scope = fluid.Scope()
+    scope.set_var("mw", np.ones(4, np.float32))
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        for n in ("mw", "g1", "g2", "gsum", "mlr"):
+            blk.create_var(name=n, shape=[4] if n != "mlr" else [1],
+                           dtype="float32", persistable=True)
+        blk.append_op("sum", {"X": ["g1", "g2"]}, {"Out": ["gsum"]}, {})
+        blk.append_op("sgd", {"Param": ["mw"], "Grad": ["gsum"],
+                              "LearningRate": ["mlr"]},
+                      {"ParamOut": ["mw"]}, {})
+        # make g1/g2 look like arriving grads: ops reading them as Grad
+        blk.append_op("sgd", {"Param": ["mw"], "Grad": ["g1"],
+                              "LearningRate": ["mlr"]},
+                      {"ParamOut": ["mw"]}, {})
+        blk.append_op("sgd", {"Param": ["mw"], "Grad": ["g2"],
+                              "LearningRate": ["mlr"]},
+                      {"ParamOut": ["mw"]}, {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    # validation is eager (at construction): a raise inside a handler
+    # thread would surface to trainers only as a dropped connection
+    with pytest.raises(ValueError, match="multi-grad"):
+        VariableServer(prog, scope, exe, sync=False)

@@ -400,3 +400,19 @@ def test_param_attr_spelling():
     fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
     np.testing.assert_array_equal(np.asarray(scope.find_var("pa_w")),
                                   np.full((4, 2), 0.5, np.float32))
+
+def test_parameters_from_tar_constructs_standalone():
+    # reference v2 parameters.py:274 — from_tar is a CONSTRUCTOR returning
+    # a new Parameters built solely from the tar, independent of any program
+    main, startup = _build()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    p = fluid.Parameters(main, scope)
+    buf = io.BytesIO()
+    p.to_tar(buf)
+    buf.seek(0)
+    q = fluid.Parameters.from_tar(buf)
+    assert sorted(q.names()) == sorted(p.names())
+    for n in p:
+        np.testing.assert_array_equal(q[n], p[n])
+        assert q.get_shape(n) == tuple(p[n].shape)
