@@ -70,14 +70,33 @@ def init_resnet50(key, layout, dtype):
         tuple(strides)
 
 
+BN_MODE = "f32"  # f32 | bf16 | none | affine
+
+
 def bn(x, p, layout):
     c_axis = 3 if layout == "NHWC" else 1
-    axes = tuple(i for i in range(4) if i != c_axis)
-    xf = x.astype(jnp.float32)
-    m = jnp.mean(xf, axis=axes)
-    v = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(m)
     sh = [1] * 4
     sh[c_axis] = x.shape[c_axis]
+    if BN_MODE == "none":
+        return x + p["bias"].reshape(sh)
+    axes = tuple(i for i in range(4) if i != c_axis)
+    if BN_MODE == "affine":
+        # HBM-traffic-minimal form: stats accumulate in f32 IN-REGISTER
+        # over the bf16 tensor (no materialized f32 activation), and the
+        # normalize collapses to one affine pass y = x*a + b whose bwd
+        # needs only x (already stored as the conv output) — no xhat
+        # residual tensor.
+        m = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        ex2 = jnp.mean(jax.lax.square(x.astype(jnp.float32)), axis=axes)
+        v = ex2 - jax.lax.square(m)
+        inv = jax.lax.rsqrt(v + 1e-5)
+        a = inv * p["scale"].astype(jnp.float32)
+        b = p["bias"].astype(jnp.float32) - m * a
+        return x * a.astype(x.dtype).reshape(sh) + \
+            b.astype(x.dtype).reshape(sh)
+    xf = x.astype(jnp.float32) if BN_MODE == "f32" else x
+    m = jnp.mean(xf, axis=axes)
+    v = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(m)
     inv = jax.lax.rsqrt(v + 1e-5)
     y = (xf - m.reshape(sh)) * inv.reshape(sh)
     return (y.astype(x.dtype) * p["scale"].reshape(sh) +
@@ -123,12 +142,26 @@ def train_step(params, mom, x, labels, layout, strides):
     return new_params, new_mom, loss
 
 
+@functools.partial(jax.jit, static_argnames=("layout", "strides"))
+def fwd_step(params, x, labels, layout, strides):
+    return fwd(params, x, labels, layout, strides)
+
+
 def main():
+    global BN_MODE
     ap = argparse.ArgumentParser()
     ap.add_argument("--layout", default="NHWC")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--bn", default="f32",
+                    choices=["f32", "bf16", "none", "affine"])
+    ap.add_argument("--mode", default="train", choices=["train", "fwd"])
+    ap.add_argument("--profile", default="",
+                    help="dir to write a jax.profiler trace into")
+    ap.add_argument("--bytes-only", action="store_true",
+                    help="compile only; print XLA cost-analysis bytes/flops")
     args = ap.parse_args()
+    BN_MODE = args.bn
     layout = args.layout
     dtype = jnp.dtype(args.dtype)
 
@@ -140,17 +173,45 @@ def main():
     x = jax.device_put(r.rand(*shape).astype(np.float32)).astype(dtype)
     labels = jax.device_put(r.randint(0, 1000, (BATCH,)).astype(np.int32))
 
-    params, mom, loss = train_step(params, mom, x, labels, layout, strides)
-    jax.block_until_ready(loss)
+    if args.mode == "fwd":
+        def step():
+            return fwd_step(params, x, labels, layout, strides)
+        flop_per_img = 4.1e9
+    else:
+        state = [params, mom]
+
+        def step():
+            state[0], state[1], loss = train_step(
+                state[0], state[1], x, labels, layout, strides)
+            return loss
+        flop_per_img = 12.3e9
+
+    if args.bytes_only:
+        lowered = (fwd_step if args.mode == "fwd" else train_step).lower(
+            *([params, x, labels, layout, strides] if args.mode == "fwd"
+              else [params, mom, x, labels, layout, strides]))
+        ca = lowered.compile().cost_analysis() or {}
+        gb = ca.get("bytes accessed", 0) / 1e9
+        print(f"layout={layout} bn={args.bn} mode={args.mode}: "
+              f"bytes={gb:.1f} GB/step -> roofline "
+              f"{gb / 819 * 1000:.1f} ms ({BATCH / (gb / 819):.0f} img/s); "
+              f"flops={ca.get('flops', 0) / 1e12:.2f} TF/step")
+        return
+
+    jax.block_until_ready(step())  # compile + warmup
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        params, mom, loss = train_step(params, mom, x, labels, layout,
-                                       strides)
+        loss = step()
     jax.block_until_ready(loss)
+    if args.profile:
+        jax.profiler.stop_trace()
     ms = (time.perf_counter() - t0) / args.iters * 1000
     img_s = BATCH / ms * 1000
-    tf = 12.3e9 * img_s / 1e12
-    print(f"layout={layout} dtype={args.dtype}: {ms:.2f} ms/step, "
+    tf = flop_per_img * img_s / 1e12
+    print(f"layout={layout} dtype={args.dtype} bn={args.bn} "
+          f"mode={args.mode}: {ms:.2f} ms/step, "
           f"{img_s:.0f} img/s, ~{tf:.1f} TFLOP/s, "
           f"MFU~{100 * tf / 197:.1f}% (v5e bf16 peak 197)")
 

@@ -4,10 +4,13 @@ Reference: /root/reference/python/paddle/v2/dataset/ (uci_housing, mnist,
 cifar, imdb, imikolov, movielens, conll05, wmt14, wmt16, sentiment,
 flowers, voc2012, mq2007).
 
-This environment has no network egress, so each module serves DETERMINISTIC
-SYNTHETIC data with the same schema (shapes/dtypes/vocab accessors) as the
-reference downloads — models and book tests exercise identical code paths;
-swap in real data by pointing the loaders at files with the same layout.
+mnist/cifar/imdb/imikolov/wmt16 download, md5-verify, cache and parse the
+real corpora (reference common.py machinery, see `common.py`); when the
+network is unavailable — or `PADDLE_TPU_DATASET=synthetic` — every module
+serves DETERMINISTIC SYNTHETIC data with the same schema
+(shapes/dtypes/vocab accessors), so models and book tests exercise
+identical code paths offline.  `PADDLE_TPU_DATASET=real` makes a failed
+download an error instead of a fallback.
 """
 from . import (  # noqa: F401
     cifar,

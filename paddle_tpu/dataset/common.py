@@ -1,11 +1,129 @@
-"""Shared synthetic-data helpers for the dataset package."""
+"""Dataset acquisition machinery + synthetic-data helpers.
+
+Reference: /root/reference/python/paddle/v2/dataset/common.py (md5file :43,
+download :62 — cache under DATA_HOME/<module>/, verify md5, retry up to 3;
+split :151, cluster_files_reader :184).
+
+Real corpora are downloaded, md5-verified and cached exactly like the
+reference.  Because this stack must also run in zero-egress CI, every
+dataset module keeps a deterministic SYNTHETIC generator with the same
+schema, selected by ``PADDLE_TPU_DATASET``:
+
+  * ``auto`` (default) — use the cached/downloaded real corpus; if the
+    download fails (offline), warn once and serve synthetic data.
+  * ``real`` — real data or raise.
+  * ``synthetic`` — never touch the network.
+"""
 from __future__ import annotations
 
+import hashlib
+import os
+import shutil
+import sys
+import urllib.request
 import zlib
 
 import numpy as np
 
-__all__ = ["fixed_rng", "cached"]
+__all__ = ["DATA_HOME", "data_home", "md5file", "download", "data_mode",
+           "fetch_real", "fixed_rng", "cached", "split",
+           "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def data_home() -> str:
+    """DATA_HOME, env-overridable per call (tests point it at a tmpdir)."""
+    return os.path.expanduser(
+        os.environ.get("PADDLE_TPU_DATA_HOME", DATA_HOME))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str,
+             save_name: str = None) -> str:
+    """Fetch `url` into DATA_HOME/<module_name>/, verify md5, return the
+    local path.  A cached file with the right md5 short-circuits; corrupt
+    or missing files are re-fetched up to 3 times."""
+    dirname = os.path.join(data_home(), module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+
+    retry = 0
+    while not (os.path.exists(filename) and md5file(filename) == md5sum):
+        if _CACHE_ONLY[0]:
+            raise RuntimeError(f"{filename} is not cached and downloads "
+                               "are disabled (offline fallback probe)")
+        if retry >= 3:
+            raise RuntimeError(
+                f"Cannot download {url} within retry limit 3")
+        retry += 1
+        sys.stderr.write(f"Cache file {filename} not found, "
+                         f"downloading {url}\n")
+        tmp = filename + ".part"
+        with urllib.request.urlopen(url, timeout=30) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+        os.replace(tmp, filename)
+    return filename
+
+
+def data_mode() -> str:
+    mode = os.environ.get("PADDLE_TPU_DATASET", "auto").lower()
+    if mode not in ("auto", "real", "synthetic"):
+        raise ValueError(f"PADDLE_TPU_DATASET={mode!r}: expected "
+                         "auto|real|synthetic")
+    return mode
+
+
+_offline_warned: set = set()
+_CACHE_ONLY = [False]  # download() raises instead of fetching when set
+
+
+def fetch_real(module_name: str, fetch_fn):
+    """Run `fetch_fn` (downloads, returns paths) under the dataset-mode
+    policy.  Returns its result, or None meaning "serve synthetic".  In
+    `auto` mode a failed download warns once per module; subsequent calls
+    for that module still consult the on-disk cache (download()'s md5
+    short-circuit) but never retry the network."""
+    mode = data_mode()
+    if mode == "synthetic":
+        return None
+    if mode == "auto" and module_name in _offline_warned:
+        # a previous download failed — serve already-cached files if the
+        # fetch can complete from disk alone, else fall back quietly
+        try:
+            _CACHE_ONLY[0] = True
+            return fetch_fn()
+        except Exception:
+            return None
+        finally:
+            _CACHE_ONLY[0] = False
+    try:
+        return fetch_fn()
+    except Exception as e:
+        if mode == "real":
+            raise
+        if module_name not in _offline_warned:
+            _offline_warned.add(module_name)
+            sys.stderr.write(
+                f"paddle_tpu.dataset.{module_name}: download failed "
+                f"({type(e).__name__}: {e}); serving synthetic data. "
+                "Set PADDLE_TPU_DATASET=real to require the corpus.\n")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# synthetic helpers (zero-egress fallback generators)
+# ---------------------------------------------------------------------------
 
 
 def fixed_rng(tag: str) -> np.random.RandomState:
@@ -24,3 +142,49 @@ def cached(fn):
         return store[k]
 
     return wrapper
+
+
+# ---------------------------------------------------------------------------
+# cluster helpers (reference common.py split/cluster_files_reader)
+# ---------------------------------------------------------------------------
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Materialize `reader` into numbered chunk files of `line_count`
+    samples each; returns the number of files written."""
+    import pickle
+
+    dumper = dumper or pickle.dump
+    lines = []
+    index = 0
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) == line_count:
+            with open(suffix % index, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            index += 1
+    if lines:
+        with open(suffix % index, "wb") as f:
+            dumper(lines, f)
+        index += 1
+    return index
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over this trainer's round-robin shard of chunk files."""
+    import glob
+    import pickle
+
+    loader = loader or pickle.load
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for sample in loader(f):
+                        yield sample
+
+    return reader

@@ -58,10 +58,11 @@ def test_wmt16_schema_and_dict():
         assert nxt[-1] == dataset.wmt16.END_ID
         assert len(trg) == len(nxt)
         assert max(trg) < 60 and max(src) < 50
+    # reference wmt16.py orientation: default token->id, reverse id->token
     d = dataset.wmt16.get_dict("en", 50)
-    assert d[0] == "<s>" and len(d) == 50
+    assert d["<s>"] == 0 and len(d) == 50
     rd = dataset.wmt16.get_dict("en", 50, reverse=True)
-    assert rd["<s>"] == 0
+    assert rd[0] == "<s>"
 
 
 def test_determinism():
