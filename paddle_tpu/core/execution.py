@@ -185,11 +185,18 @@ def run_op(ctx: ExecContext, op, env):
     op_ctx.op = op
     op_ctx.env = env
     op_ctx.root = ctx
-    if info.type == t:  # explicit lowering (fwd op, or custom grad)
-        outs = info.lower(op_ctx, ins, {**info.attrs, **op.attrs})
-    else:  # generic "<fwd>_grad" resolved to forward info
-        outs = generic_grad_lower(op_ctx, ins, {**info.attrs, **op.attrs},
-                                  info)
+    # named scope per op: XLA op metadata carries "<type>:<first output>",
+    # so device profiles/HLO dumps attribute fusions back to program ops
+    # (reference executor.cc:124 wraps each op run in a RecordEvent; inside
+    # a jit trace the scope name is the compile-time analogue)
+    outs_names = op.output_names()
+    scope = f"{t}:{outs_names[0]}" if outs_names else t
+    with jax.named_scope(scope):
+        if info.type == t:  # explicit lowering (fwd op, or custom grad)
+            outs = info.lower(op_ctx, ins, {**info.attrs, **op.attrs})
+        else:  # generic "<fwd>_grad" resolved to forward info
+            outs = generic_grad_lower(op_ctx, ins,
+                                      {**info.attrs, **op.attrs}, info)
     scatter_outputs(op, env, outs)
 
 

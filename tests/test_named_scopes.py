@@ -1,0 +1,33 @@
+"""Per-op named scopes inside compiled blocks: XLA op metadata must carry
+"<op type>:<first output>" so device profiles attribute fusions back to
+program ops (VERDICT r1 #6; reference executor.cc:124 RecordEvent parity
+for the compiled path)."""
+import numpy as np
+
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.core.executor import program_to_fn
+
+
+def test_compiled_block_carries_op_scopes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+
+    fn = program_to_fn(main, ["x", "y"], [loss.name])
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    states = {n: np.asarray(scope.find_var(n)) for n in fn.state_in_names}
+    feeds = {"x": np.zeros((2, 4), np.float32),
+             "y": np.zeros((2, 1), np.float32)}
+    ir = jax.jit(fn).lower(feeds, states,
+                           jax.random.key(0)).as_text(debug_info=True)
+
+    # forward ops, grad ops and optimizer ops are all attributed
+    for marker in ("mul:", "relu:", "mean:", "sgd:", "mul_grad:"):
+        assert marker in ir, f"scope {marker!r} missing from lowered IR"
