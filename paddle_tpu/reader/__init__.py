@@ -22,6 +22,7 @@ __all__ = [
     "xmap_readers",
     "cache",
     "batch",
+    "bucket_by_length",
     "native_pipeline",
     "PipeReader",
     "ComposeNotAligned",
@@ -250,6 +251,65 @@ def batch(reader, batch_size, drop_last=False):
             yield b
 
     return batch_reader
+
+
+def bucket_by_length(reader, batch_size, boundaries, seq_slots=(0,),
+                     key_slot=None, pad_value=0, drop_last=False):
+    """Bucketed batching for variable-length samples: bounds XLA
+    executable count to len(boundaries)+1 per program.
+
+    The LoD offset table is part of the compile-cache key (core/lod.py), so
+    feeding raw per-batch length multisets recompiles per batch — the TPU
+    answer to the reference's zero-recompile dynamic batching
+    (lod_rank_table_op.cc / while_op.cc dynamic shapes) is static length
+    buckets.  Samples are pooled by the bucket of ``len(sample[key_slot])``
+    (default: the first seq slot); when a pool reaches `batch_size` a batch
+    is yielded in which every slot in `seq_slots` is right-padded with
+    `pad_value` to the bucket boundary, so every batch from a bucket has
+    the SAME shapes + LoD and hits the same executable.
+
+    Sequences longer than the last boundary are truncated to it.  Padding
+    rows are real rows at the LoD level — models that must ignore them
+    should mask (or choose a benign pad token, e.g. an embedding id whose
+    vector is zero).  Partial pools are flushed at exhaustion unless
+    `drop_last` (each flush costs at most one extra compile per bucket).
+    """
+    bounds = sorted({int(b) for b in boundaries})
+    if not bounds:
+        raise ValueError("boundaries must be non-empty")
+    key = seq_slots[0] if key_slot is None else key_slot
+
+    def bucket_of(n):
+        for b in bounds:
+            if n <= b:
+                return b
+        return bounds[-1]
+
+    def pad(sample, bound):
+        row = list(sample)
+        for s in seq_slots:
+            seq = list(row[s])[:bound]
+            fill = bound - len(seq)
+            if fill:
+                seq = seq + [pad_value] * fill
+            row[s] = seq
+        return tuple(row)
+
+    def bucket_reader():
+        pools = {b: [] for b in bounds}
+        for sample in reader():
+            b = bucket_of(len(sample[key]))
+            pool = pools[b]
+            pool.append(pad(sample, b))
+            if len(pool) == batch_size:
+                yield pool[:]
+                pool.clear()
+        if not drop_last:
+            for b in bounds:
+                if pools[b]:
+                    yield pools[b]
+
+    return bucket_reader
 
 
 def native_pipeline(reader, slots, batch_size, shuffle_buf=0, seed=0,

@@ -363,3 +363,26 @@ class TestLayerNorm(OpTest):
 
     def test_grad(self):
         self.check_grad(["X", "Scale", "Bias"], max_relative_error=2e-2)
+
+
+class TestBatchNormLargeMeanF32(OpTest):
+    """f32 variance must use the centered two-pass form: E[x^2]-m^2
+    catastrophically cancels when |mean| >> std (review r2 finding)."""
+    op_type = "batch_norm"
+    attrs = {"momentum": 0.9, "epsilon": 1e-5, "is_test": False}
+
+    def setUp(self):
+        x = (1e4 + rng.randn(4, 3, 4, 4)).astype(np.float32)
+        scale = np.ones(3, np.float32)
+        bias = np.zeros(3, np.float32)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = ((x - bm.reshape(1, 3, 1, 1)) /
+             np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5))
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": np.zeros(3, np.float32),
+                       "Variance": np.ones(3, np.float32)}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output(atol=5e-3)
