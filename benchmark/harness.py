@@ -3,6 +3,12 @@
 One copy of the measure loop (reference `paddle train --job=time`
 semantics) used by bench.py, run_image.py and run_rnn.py so warmup /
 sync / timing changes can't silently diverge between published numbers.
+
+`chip_specs()` + `roofline_fields()` attach the hardware context every
+bench JSON must carry (VERDICT r1 #1): model TFLOP/s, MFU against the
+chip's peak, and the HBM side of the roofline from XLA's own cost
+analysis — on a memory-bound model the HBM utilization, not MFU, says
+whether the chip is actually being used.
 """
 from __future__ import annotations
 
@@ -10,11 +16,63 @@ import time
 
 import numpy as np
 
+# device_kind prefix -> (bf16 peak FLOP/s, HBM bytes/s)
+_CHIPS = {
+    "TPU v5 lite": (197e12, 819e9),   # v5e
+    "TPU v5": (459e12, 2765e9),       # v5p (checked after v5 lite)
+    "TPU v4": (275e12, 1228e9),
+    "TPU v6 lite": (918e12, 1640e9),  # v6e / Trillium
+}
 
-def time_program(main, startup, feeds, fetch_name, iters):
+
+def chip_specs():
+    """(device_kind, peak_flops, hbm_bytes_per_s) of the default device;
+    (kind, None, None) off-TPU (no meaningful peak for CPU hosts)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix in ("TPU v5 lite", "TPU v6 lite", "TPU v5", "TPU v4"):
+        if kind.startswith(prefix):
+            return kind, *_CHIPS[prefix]
+    return kind, None, None
+
+
+def roofline_fields(ms_per_step, model_flops_per_step, cost):
+    """The honesty block for one measured config: achieved model TFLOP/s,
+    MFU vs chip peak, XLA-counted HBM GB/step and HBM utilization —
+    `model_flops` is the analytic model FLOP count (2*MACs), not XLA's
+    (which also counts pointwise work)."""
+    kind, peak, hbm = chip_specs()
+    sec = ms_per_step / 1000.0
+    tflops = model_flops_per_step / sec / 1e12
+    out = {
+        "device": kind,
+        "tflops": round(tflops, 2),
+        "mfu": round(tflops * 1e12 / peak, 4) if peak else None,
+    }
+    gb = (cost or {}).get("bytes accessed")
+    if gb is not None:
+        out["hbm_gb_per_step"] = round(gb / 1e9, 2)
+        if hbm:
+            out["hbm_util"] = round((gb / sec) / hbm, 4)
+    return out
+
+
+def roofline_from_cost(ms_per_step, cost):
+    """roofline_fields using XLA's own per-step FLOP count as the model
+    FLOPs (uniform across models; slightly generous — XLA also counts
+    pointwise work — so bench.py's headline uses an analytic count
+    instead)."""
+    return roofline_fields(ms_per_step, (cost or {}).get("flops", 0.0),
+                           cost)
+
+
+def time_program(main, startup, feeds, fetch_name, iters,
+                 with_cost: bool = False):
     """Run `iters` steady-state training steps of `main`'s block 0 on the
-    default device; returns ms/batch.  `feeds` are device_put as-is;
-    states are donated so param updates stay on device."""
+    default device; returns ms/batch (or (ms, xla_cost_analysis_dict) when
+    `with_cost`).  `feeds` are device_put as-is; states are donated so
+    param updates stay on device."""
     import jax
 
     import paddle_tpu as fluid
@@ -33,10 +91,15 @@ def time_program(main, startup, feeds, fetch_name, iters):
         return fetches[fetch_name], new_states
 
     dev_feeds = jax.device_put(feeds)
-    loss, states = step(dev_feeds, states)  # compile + warmup
+    # AOT-compile once and call the executable directly (a separate
+    # lower().compile() would not share jit's cache -> double compile)
+    compiled = step.lower(dev_feeds, states).compile()
+    cost = compiled.cost_analysis() or {} if with_cost else None
+    loss, states = compiled(dev_feeds, states)  # warmup
     jax.block_until_ready(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss, states = step(dev_feeds, states)
+        loss, states = compiled(dev_feeds, states)
     jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / iters * 1000
+    ms = (time.perf_counter() - t0) / iters * 1000
+    return (ms, cost) if with_cost else ms

@@ -176,7 +176,7 @@ def main():
     if args.mode == "fwd":
         def step():
             return fwd_step(params, x, labels, layout, strides)
-        flop_per_img = 4.1e9
+        flop_per_img = 8.2e9   # 2*MACs
     else:
         state = [params, mom]
 
@@ -184,7 +184,7 @@ def main():
             state[0], state[1], loss = train_step(
                 state[0], state[1], x, labels, layout, strides)
             return loss
-        flop_per_img = 12.3e9
+        flop_per_img = 24.6e9  # 3x fwd, 2*MACs
 
     if args.bytes_only:
         lowered = (fwd_step if args.mode == "fwd" else train_step).lower(

@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from harness import time_program
+from harness import roofline_from_cost, time_program
 
 SPECS = {
     # name -> (input HxW, reference 1xK40m ms/batch table keyed by batch,
@@ -68,15 +68,18 @@ def run_one(model, batch, iters, dtype):
         "img": r.rand(batch, 3, img, img).astype(np_dtype(dtype)),
         "label": r.randint(0, classes, (batch, 1)).astype(np.int32),
     }
-    ms = time_program(main, startup, feeds, avg.name, iters)
+    ms, cost = time_program(main, startup, feeds, avg.name, iters,
+                            with_cost=True)
     ref = ref_table.get(batch)
-    print(json.dumps({
+    out = {
         "model": model, "batch": batch,
         "ms_per_batch": round(ms, 2),
         "images_per_sec": round(batch / ms * 1000, 1),
         "ref_k40m_ms_per_batch": ref,
         "speedup_vs_ref": round(ref / ms, 2) if ref else None,
-    }))
+    }
+    out.update(roofline_from_cost(ms, cost))
+    print(json.dumps(out))
 
 
 def main():

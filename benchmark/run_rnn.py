@@ -16,7 +16,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from harness import time_program
+from harness import roofline_from_cost, time_program
 
 VOCAB = 30000
 SEQ_LEN = 100  # reference fixedlen=100 (pad_seq=True mode)
@@ -61,16 +61,19 @@ def run_one(batch, hidden, iters, dtype):
         [lod_from_seq_lens([SEQ_LEN] * batch)])
     feeds = {"words": words,
              "label": r.randint(0, 2, (batch, 1)).astype(np.int32)}
-    ms = time_program(main, startup, feeds, avg.name, iters)
+    ms, cost = time_program(main, startup, feeds, avg.name, iters,
+                            with_cost=True)
     ref = REF.get(batch, {}).get(hidden)
-    print(json.dumps({
+    out = {
         "model": "lstm_textcls", "batch": batch, "hidden": hidden,
         "seq_len": SEQ_LEN,
         "ms_per_batch": round(ms, 2),
         "tokens_per_sec": round(batch * SEQ_LEN / ms * 1000, 1),
         "ref_k40m_ms_per_batch": ref,
         "speedup_vs_ref": round(ref / ms, 2) if ref else None,
-    }))
+    }
+    out.update(roofline_from_cost(ms, cost))
+    print(json.dumps(out))
 
 
 def main():

@@ -27,7 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from harness import time_program  # noqa: E402  (benchmark/ on path via bench.py)
+from harness import roofline_from_cost, time_program  # noqa: E402  (benchmark/ on path via bench.py)
 
 SRC_VOCAB = 30000
 TGT_VOCAB = 30000
@@ -124,15 +124,18 @@ def run_one(model, batch, src_len, tgt_len, iters, dtype):
         feeds = {"src": seq(SRC_VOCAB, src_len),
                  "tgt": seq(TGT_VOCAB, tgt_len),
                  "lbl": seq(TGT_VOCAB, tgt_len)}
-    ms = time_program(main, startup, feeds, avg.name, iters)
+    ms, cost = time_program(main, startup, feeds, avg.name, iters,
+                            with_cost=True)
     tokens = batch * (src_len + tgt_len)
-    print(json.dumps({
+    out = {
         "model": f"seq2seq_{model}", "batch": batch,
         "src_len": src_len, "tgt_len": tgt_len, "dtype": dtype,
         "ms_per_batch": round(ms, 2),
         "tokens_per_sec": round(tokens / ms * 1000, 1),
         "vs_baseline": None,   # reference published no seq2seq throughput
-    }))
+    }
+    out.update(roofline_from_cost(ms, cost))
+    print(json.dumps(out))
 
 
 def main():

@@ -1,0 +1,227 @@
+"""TTL-lease service registry: elastic pserver membership + liveness.
+
+Python surface over the native implementation
+(`native/src/registry.cc`).  Reference semantics:
+go/pserver/etcd_client.go — a pserver `Register`s under the lowest free
+index with a TTL lease kept alive by heartbeats and publishes its
+address; trainers discover the live address list and wait for the
+desired count (go/pserver/client/etcd_client.go); an expired lease frees
+the index so a replacement claims it (go/cmd/pserver/pserver.go:34-45).
+
+Use `Registry` to host (in-process handle + optional TCP serving) and
+`RegistryClient` from other processes.  `Lease` runs the heartbeat loop
+on a daemon thread and exposes `lost` when the registry revoked the
+slot (e.g. after a heartbeat gap longer than the TTL).
+"""
+from __future__ import annotations
+
+import ctypes
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu import native
+
+__all__ = ["Registry", "RegistryClient", "Lease"]
+
+
+def _declare(lib):
+    if getattr(lib, "_registry_declared", False):
+        return lib
+    p = ctypes.c_void_p
+    i = ctypes.c_int
+    i64 = ctypes.c_int64
+    cp = ctypes.c_char_p
+    lib.pt_registry_create.restype = p
+    lib.pt_registry_create.argtypes = []
+    lib.pt_registry_set_desired.argtypes = [p, cp, i]
+    lib.pt_registry_register.restype = i
+    lib.pt_registry_register.argtypes = [
+        p, cp, cp, ctypes.c_double, ctypes.POINTER(i64)]
+    lib.pt_registry_heartbeat.restype = i
+    lib.pt_registry_heartbeat.argtypes = [p, cp, i, i64]
+    lib.pt_registry_deregister.restype = i
+    lib.pt_registry_deregister.argtypes = [p, cp, i, i64]
+    lib.pt_registry_list.argtypes = [p, cp, cp, ctypes.c_size_t]
+    lib.pt_registry_wait_ready.restype = i
+    lib.pt_registry_wait_ready.argtypes = [
+        p, cp, ctypes.c_size_t, ctypes.c_double]
+    lib.pt_registry_serve.restype = i
+    lib.pt_registry_serve.argtypes = [p, i]
+    lib.pt_registry_stop.argtypes = [p]
+    lib.pt_registry_destroy.argtypes = [p]
+    lib._registry_declared = True
+    return lib
+
+
+class Registry:
+    """In-process registry; `serve()` additionally exposes it over TCP."""
+
+    def __init__(self):
+        self._lib = _declare(native.lib())
+        self._h = self._lib.pt_registry_create()
+        self.port: Optional[int] = None
+
+    def set_desired(self, kind: str, n: int) -> None:
+        self._lib.pt_registry_set_desired(self._h, kind.encode(), n)
+
+    def register(self, kind: str, addr: str,
+                 ttl_s: float) -> Tuple[int, int]:
+        """(index, lease) or raises when all desired slots are held."""
+        lease = ctypes.c_int64(0)
+        idx = self._lib.pt_registry_register(
+            self._h, kind.encode(), addr.encode(), ttl_s,
+            ctypes.byref(lease))
+        if idx < 0:
+            raise RuntimeError(
+                f"registry: no free {kind!r} slot below the desired count")
+        return idx, lease.value
+
+    def heartbeat(self, kind: str, index: int, lease: int) -> bool:
+        return bool(self._lib.pt_registry_heartbeat(
+            self._h, kind.encode(), index, lease))
+
+    def deregister(self, kind: str, index: int, lease: int) -> bool:
+        return bool(self._lib.pt_registry_deregister(
+            self._h, kind.encode(), index, lease))
+
+    def list(self, kind: str) -> Dict[int, str]:
+        buf = ctypes.create_string_buffer(1 << 20)
+        self._lib.pt_registry_list(self._h, kind.encode(), buf, len(buf))
+        out: Dict[int, str] = {}
+        for line in buf.value.decode().splitlines():
+            if line.strip():
+                idx, addr = line.split(None, 1)
+                out[int(idx)] = addr
+        return out
+
+    def wait_ready(self, kind: str, n: int, timeout_s: float) -> bool:
+        return bool(self._lib.pt_registry_wait_ready(
+            self._h, kind.encode(), n, timeout_s))
+
+    def serve(self, port: int = 0) -> int:
+        got = self._lib.pt_registry_serve(self._h, port)
+        if got < 0:
+            raise RuntimeError("registry: TCP serve failed")
+        self.port = got
+        return got
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.pt_registry_stop(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pt_registry_stop(self._h)
+            self._lib.pt_registry_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RegistryClient:
+    """TCP client; one short-lived connection per call (the protocol is
+    line-oriented and every verb is a single round trip)."""
+
+    def __init__(self, addr: str, timeout_s: float = 5.0):
+        host, port = addr.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout_s
+
+    def _roundtrip(self, line: str, multi: bool = False) -> List[str]:
+        with socket.create_connection(self._addr,
+                                      timeout=self._timeout) as s:
+            s.sendall(line.encode() + b"\n")
+            f = s.makefile("r")
+            first = f.readline().strip()
+            if not first:
+                # clean EOF before a reply (registry restarting / closing
+                # the accept): a TRANSIENT transport failure, not a
+                # protocol answer — callers like Lease._beat retry on
+                # OSError but treat a definitive GONE as revocation
+                raise OSError(f"registry closed connection mid-request "
+                              f"({line.split()[0]})")
+            if not multi:
+                return [first]
+            lines = [first]
+            while True:
+                ln = f.readline()
+                if not ln or ln.strip() == ".":
+                    break
+                lines.append(ln.rstrip("\n"))
+            return lines
+
+    def set_desired(self, kind: str, n: int) -> None:
+        self._roundtrip(f"DESIRE {kind} {n}")
+
+    def register(self, kind: str, addr: str,
+                 ttl_s: float) -> Tuple[int, int]:
+        resp = self._roundtrip(
+            f"REG {kind} {int(ttl_s * 1000)} {addr}")[0].split()
+        if resp[0] != "OK":
+            raise RuntimeError(
+                f"registry: no free {kind!r} slot below the desired count")
+        return int(resp[1]), int(resp[2])
+
+    def heartbeat(self, kind: str, index: int, lease: int) -> bool:
+        return self._roundtrip(f"HB {kind} {index} {lease}")[0] == "OK"
+
+    def deregister(self, kind: str, index: int, lease: int) -> bool:
+        return self._roundtrip(f"DEREG {kind} {index} {lease}")[0] == "OK"
+
+    def list(self, kind: str) -> Dict[int, str]:
+        lines = self._roundtrip(f"LIST {kind}", multi=True)
+        out: Dict[int, str] = {}
+        for line in lines[1:]:
+            if line.strip():
+                idx, addr = line.split(None, 1)
+                out[int(idx)] = addr
+        return out
+
+    def wait_ready(self, kind: str, n: int, timeout_s: float) -> bool:
+        # server blocks up to timeout_s; allow socket slack on top
+        host, port = self._addr
+        with socket.create_connection(
+                (host, port), timeout=timeout_s + self._timeout) as s:
+            s.sendall(f"WAIT {kind} {n} {int(timeout_s * 1000)}\n".encode())
+            return s.makefile("r").readline().strip() == "OK"
+
+
+class Lease:
+    """Holds one registration alive: heartbeats every ttl/3 on a daemon
+    thread; `lost` flips when the registry revoked the slot (missed
+    heartbeats past the TTL — the reference's lease-expiry signal that
+    tells a pserver to exit, go/cmd/pserver/pserver.go:42)."""
+
+    def __init__(self, registry, kind: str, addr: str, ttl_s: float = 3.0):
+        self._reg = registry
+        self.kind = kind
+        self.addr = addr
+        self.ttl_s = ttl_s
+        self.index, self._lease = registry.register(kind, addr, ttl_s)
+        self.lost = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        while not self._stop.wait(self.ttl_s / 3.0):
+            try:
+                ok = self._reg.heartbeat(self.kind, self.index, self._lease)
+            except OSError:
+                continue  # registry unreachable: retry until it answers
+            if not ok:  # definitive GONE: the slot was revoked
+                self.lost = True
+                return
+
+    def release(self):
+        self._stop.set()
+        self._thread.join(timeout=self.ttl_s)
+        try:
+            self._reg.deregister(self.kind, self.index, self._lease)
+        except OSError:
+            pass

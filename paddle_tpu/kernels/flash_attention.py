@@ -35,7 +35,12 @@ except ImportError:  # pragma: no cover
 __all__ = ["flash_attention", "flash_attention_reference"]
 
 NEG_INF = -1e30  # finite mask value: keeps exp()/max() NaN-free in-kernel
-DEFAULT_BLOCK = 128
+# measured on v5e at seq 4096, d 128, bf16 (async-chain, distinct inputs):
+# 512x1024 blocks run 6.5 ms vs 21.8 ms at 128x128 and 15.1 ms for the XLA
+# composition — big K blocks amortize the per-step acc rescale + m/l
+# bookkeeping, big Q blocks amortize K/V streaming
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 
 
 # ---------------------------------------------------------------------------
@@ -53,9 +58,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay in their storage dtype: bf16 x bf16 -> f32 rides
+        # the MXU's native path (an .astype(f32) here forces the ~8x
+        # slower fp32 MXU passes — measured 0.54x vs XLA before, 1.8x+
+        # after); accumulation is f32 via preferred_element_type
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -72,7 +81,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -154,10 +163,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype MXU operands, f32 accumulate (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]        # (block_q, 1)
         delta = delta_ref[0]    # (block_q, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -172,8 +182,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(j * block_k <= i * block_q + (block_q - 1))
@@ -199,10 +210,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype MXU operands, f32 accumulate (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]        # (1, block_q) — transposed layout
         delta = delta_ref[0]    # (1, block_q)
         # transposed tile: rows = k positions, cols = q positions
@@ -215,13 +227,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_k, block_q), 1)
             st = jnp.where(qcols >= krows, st, NEG_INF)
         pt = jnp.exp(st - lse)
-        dv_acc[:] += jax.lax.dot_general(pt, do, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
         dst = pt * (dpt - delta) * scale
-        dk_acc[:] += jax.lax.dot_general(dst, q, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         # a k block gets gradient only from q blocks at/below its diagonal
@@ -365,7 +379,7 @@ def flash_attention_reference(q, k, v, causal=False, scale=None):
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=None):
     """Flash attention over [batch, seq, heads, head_dim] tensors.
 

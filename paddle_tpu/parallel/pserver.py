@@ -141,8 +141,25 @@ class VariableServer:
         self._threads.append(t)
         return self.port
 
+    def register_with(self, registry, kind: str = "pserver",
+                      ttl_s: float = 3.0, host: str = "127.0.0.1"):
+        """Publish this server in a TTL-lease registry (cloud.registry) so
+        trainers discover it and a replacement can claim the slot if this
+        process dies (reference go/cmd/pserver/pserver.go:34-45).  Returns
+        the live Lease; its `.index` is this pserver's cluster index and
+        `.lost` flips if the registry revokes the slot."""
+        from ..cloud.registry import Lease
+
+        if self.port is None:
+            raise RuntimeError("serve() before register_with()")
+        self._lease = Lease(registry, kind, f"{host}:{self.port}", ttl_s)
+        return self._lease
+
     def stop(self):
         self._stopping = True
+        lease = getattr(self, "_lease", None)
+        if lease is not None and not lease.lost:
+            lease.release()
         try:
             if self._sock is not None:
                 self._sock.close()

@@ -256,7 +256,9 @@ def batch(reader, batch_size, drop_last=False):
 def bucket_by_length(reader, batch_size, boundaries, seq_slots=(0,),
                      key_slot=None, pad_value=0, drop_last=False):
     """Bucketed batching for variable-length samples: bounds XLA
-    executable count to len(boundaries)+1 per program.
+    executable count to len(boundaries) per program with `drop_last`
+    (up to 2*len(boundaries) without it — each bucket's final partial
+    batch adds at most one extra shape).
 
     The LoD offset table is part of the compile-cache key (core/lod.py), so
     feeding raw per-batch length multisets recompiles per batch — the TPU

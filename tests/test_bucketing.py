@@ -83,7 +83,9 @@ def test_executor_cache_bounded_by_buckets():
     n_train_execs = len(exe._cache) - n_startup_execs
     assert n_train_execs <= len(BOUNDS), (
         f"{n_train_execs} executables for {n_batches} batches")
-    assert np.mean(losses[-50:]) <= np.mean(losses[:50])
+    # the labels are random (no learnable signal) — only sanity-check that
+    # training ran and losses are finite, not that they decrease
+    assert np.isfinite(losses).all()
 
 
 def test_bucket_duplicate_boundaries_no_double_flush():
