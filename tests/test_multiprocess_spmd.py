@@ -1,0 +1,58 @@
+"""Real 2-process jax.distributed SPMD run (VERDICT r1 #8).
+
+The 8-virtual-device conftest mesh cannot test the PROCESS coordination
+path (jax.distributed.initialize, cross-process collectives, global
+arrays assembled from per-process shards).  This launches two actual
+processes through tools/launch.py --coordinator mode — the closest
+honest approximation to multi-host DCN this single-host environment
+allows — and each worker asserts a cross-process psum and a dp-sharded
+program train step against a full-batch numpy reference.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_spmd_psum_and_dp_step():
+    port = _free_port()
+    worker = os.path.join(REPO, "examples", "dist_spmd_psum.py")
+    launcher = os.path.join(REPO, "tools", "launch.py")
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            # 2 devices per process -> a 4-device global dp mesh
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        )
+        env.pop("PYTEST_CURRENT_TEST", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, launcher,
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             worker],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    joined = "\n".join(outs)
+    assert "psum across 2 processes / 4 devices OK" in joined
+    assert joined.count("matches the full-batch numpy reference OK") == 2
