@@ -71,8 +71,17 @@ def run_single(n, batch_per_dev, iters, depth, img):
         out = pe.run(feed)
     jax.block_until_ready(out[0])
     ms = (time.perf_counter() - t0) / iters * 1000
-    return {"devices": n, "batch": batch, "ms_per_batch": round(ms, 2),
-            "images_per_sec": round(batch / ms * 1000, 1)}
+    out = {"devices": n, "batch": batch, "ms_per_batch": round(ms, 2),
+           "images_per_sec": round(batch / ms * 1000, 1)}
+    if jax.default_backend() != "tpu":
+        # the communication structure is meaningful even when virtual
+        # throughput is not: dp-N must show grad all-reduces (and only
+        # those), pinned per N from the compiled HLO.  Skipped on real
+        # chips: compiled_collectives lowers+compiles a second copy of
+        # the step (minutes of compile for a structure that is identical
+        # to the CPU lowering's).
+        out["collectives"] = pe.compiled_collectives(feed)
+    return out
 
 
 def main():

@@ -166,6 +166,37 @@ class ParallelExecutor:
             out = [np.asarray(v) for v in out]
         return out
 
+    def compiled_collectives(self, feed: Dict) -> Dict[str, int]:
+        """Counts of cross-device collective ops in the optimized HLO of
+        the train step compiled for `feed`'s shapes — pins the
+        communication STRUCTURE of a mesh without the hardware (e.g.
+        dp-N must show grad all-reduces and nothing else; run_scaling.py
+        --virtual reports this per N alongside the no-op virtual
+        throughput)."""
+        import re
+
+        feeds = {
+            n: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                    np.asarray(v).dtype,
+                                    sharding=self._data_sharding)
+            for n, v in feed.items()
+        }
+        key = jax.random.key(self._seed)
+        txt = self._jit_step.lower(feeds, self._states, key) \
+            .compile().as_text()
+        out = {}
+        for op in ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all"):
+            # instruction forms: `<name> = <type> <op>(`, where <type> may
+            # be a spaced tuple `(f32[], ...)`; async pairs appear as
+            # <op>-start(/<op>-done( — count one per pair.  `<op>(` never
+            # matches operand references (those are `%<op>.N`).
+            n_start = len(re.findall(rf"{op}-start\(", txt))
+            n_bare = len(re.findall(rf"{op}\(", txt))
+            if n_start + n_bare:
+                out[op] = n_start + n_bare
+        return out
+
     def state(self, name, return_numpy=True):
         v = self._states[name]
         return np.asarray(v) if return_numpy else v

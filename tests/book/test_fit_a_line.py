@@ -5,6 +5,10 @@ test_fit_a_line.py:24-102 (train to a loss threshold, then round-trip the
 inference model).  Data: synthetic uci_housing-shaped regression (no
 network egress in this environment).
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

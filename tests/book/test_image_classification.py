@@ -6,6 +6,10 @@ trained until loss threshold).  Synthetic CIFAR: class templates + noise;
 smaller nets than the book (depth-8 resnet, 1-block vgg stack) keep CPU
 test time bounded while exercising conv/batch_norm/dropout/residual paths.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

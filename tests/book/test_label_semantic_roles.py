@@ -7,6 +7,10 @@ sharing the 'crfw' transition parameter; evaluated by chunk_eval.
 Data: synthetic CoNLL-shaped sequences with a learnable word->tag rule
 (no network egress here).
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

@@ -6,6 +6,10 @@ While + beam_search/beam_search_decode generation).  Synthetic copy task:
 the target sequence equals the source sequence — the decoder must learn to
 reproduce the source from the encoder context and its own previous outputs.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

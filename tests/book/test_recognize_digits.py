@@ -5,6 +5,10 @@ test_recognize_digits_mlp.py / test_recognize_digits_conv.py.
 Synthetic MNIST-shaped data: each class is a distinct fixed template plus
 noise, learnable to high accuracy in a few steps.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

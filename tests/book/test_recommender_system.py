@@ -6,6 +6,10 @@ movie (id/category sequence/title sequence), two fused fc towers, cos_sim
 scaled to [0,5], square_error_cost vs the rating.  Data: synthetic
 movielens-shaped batches (no network egress here).
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

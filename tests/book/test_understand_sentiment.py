@@ -6,6 +6,10 @@ Synthetic data: class determined by which token range dominates a
 variable-length sequence — exercises the LoD feed path (DataFeeder),
 embedding, dynamic_lstm over ragged batches, and sequence pooling.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

@@ -5,6 +5,10 @@ test_word2vec.py (4-gram context -> embeddings -> concat fc -> softmax).
 Synthetic corpus (zero egress): token t+1 follows token t deterministically
 modulo the dict size, so the model can drive the loss near zero.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

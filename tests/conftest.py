@@ -39,3 +39,11 @@ def _fresh_state():
     fw.switch_main_program(old_main)
     fw.switch_startup_program(old_startup)
     executor_mod._global_scope = old_scope
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test (book training flows, subprocess "
+        "clusters). Fast subset: pytest -m 'not slow' (~half the wall "
+        "time); CI runs the full suite.")

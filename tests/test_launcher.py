@@ -4,6 +4,10 @@ Reference analogue: paddle/scripts/cluster_train/paddle.py (env-var
 launcher) + the book_distribute role convention; here the whole
 pserver-cluster flow runs as real subprocesses on localhost.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import subprocess
 import sys

