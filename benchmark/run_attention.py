@@ -51,14 +51,18 @@ def bench_one(batch, heads, seq, dim, causal, dtype, iters, atol):
     v = jnp.asarray(r.randn(*shape), dtype)
 
     def loss_pallas(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=causal)
+        # min_seq_k=0: the artifact must exercise the KERNEL even at
+        # sizes where the production policy would route to XLA
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       min_seq_k=0)
                        .astype(jnp.float32))
 
     def loss_ref(q, k, v):
         return jnp.sum(flash_attention_reference(q, k, v, causal=causal)
                        .astype(jnp.float32))
 
-    fwd_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal))
+    fwd_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                                    min_seq_k=0))
     fwd_x = jax.jit(
         lambda q, k, v: flash_attention_reference(q, k, v, causal=causal))
     grad_p = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))

@@ -378,15 +378,25 @@ def flash_attention_reference(q, k, v, causal=False, scale=None):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+# below this K/V length the materialized-scores XLA composition measured
+# faster than the Pallas kernel on v5e (the S^2 matrix still fits cache-
+# friendly tiles and XLA's single fusion beats the grid-loop overhead);
+# above it the kernel wins and keeps winning as S^2 grows (1.5-2.3x at
+# 4k-8k, and 32k+ only runs at all on the kernel) — run_attention.py
+MIN_PALLAS_SEQ_K = 2048
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None):
+                    interpret=None, min_seq_k=MIN_PALLAS_SEQ_K):
     """Flash attention over [batch, seq, heads, head_dim] tensors.
 
     Streams K/V through VMEM with online softmax (fwd) and recomputation
     (bwd).  Falls back to the XLA composition when not on a TPU backend
-    (unless `interpret=True` asks for the pallas interpreter, e.g. tests)
-    or when the sequence doesn't tile onto MXU-aligned blocks.
+    (unless `interpret=True` asks for the pallas interpreter, e.g. tests),
+    when the sequence doesn't tile onto MXU-aligned blocks, or when the
+    K/V length is below `min_seq_k` (where the XLA composition measures
+    faster; pass min_seq_k=0 to force the kernel).
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -398,6 +408,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
         # Mosaic only lowers on TPU, and emulating the grid loop on CPU/GPU
         # is far slower than one fused XLA attention — fall back unless the
         # caller opted into the pallas interpreter (interpret=True, tests)
+        return flash_attention_reference(q, k, v, causal, scale_v)
+    if not interp and sk < min_seq_k:
         return flash_attention_reference(q, k, v, causal, scale_v)
     tiles_ok = sq % block_q == 0 and sk % block_k == 0
     if not interp:
