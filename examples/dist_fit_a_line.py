@@ -19,9 +19,15 @@ import paddle_tpu as fluid
 
 
 def main():
-    pservers = os.environ["PSERVERS"]
     role = os.environ["TRAINING_ROLE"]
     trainers = int(os.environ.get("PADDLE_INIT_NUM_GRADIENT_SERVERS", "1"))
+    # static PSERVERS env OR TTL-lease discovery (launch.py --registry):
+    # resolve_pserver_cluster registers this pserver / waits for the
+    # cluster either way, returning an index-ordered endpoint list that
+    # is identical on every process (the transpiler split is positional)
+    from paddle_tpu.cloud.registry import resolve_pserver_cluster
+
+    pservers, my_endpoint, lease = resolve_pserver_cluster()
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -39,9 +45,11 @@ def main():
 
     exe = fluid.Executor(fluid.CPUPlace())
     if role == "PSERVER":
-        endpoint = os.environ["SERVER_ENDPOINT"]
+        endpoint = my_endpoint or os.environ["SERVER_ENDPOINT"]
         exe.run(t.get_startup_program(endpoint))
         exe.run(t.get_pserver_program(endpoint))  # serves until STOP
+        if lease is not None:
+            lease.release()
         return
 
     assert role == "TRAINER", role

@@ -197,13 +197,15 @@ class Lease:
     heartbeats past the TTL — the reference's lease-expiry signal that
     tells a pserver to exit, go/cmd/pserver/pserver.go:42)."""
 
-    def __init__(self, registry, kind: str, addr: str, ttl_s: float = 3.0):
+    def __init__(self, registry, kind: str, addr: str, ttl_s: float = 3.0,
+                 on_lost=None):
         self._reg = registry
         self.kind = kind
         self.addr = addr
         self.ttl_s = ttl_s
         self.index, self._lease = registry.register(kind, addr, ttl_s)
         self.lost = False
+        self._on_lost = on_lost
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
@@ -216,6 +218,8 @@ class Lease:
                 continue  # registry unreachable: retry until it answers
             if not ok:  # definitive GONE: the slot was revoked
                 self.lost = True
+                if self._on_lost is not None:
+                    self._on_lost()
                 return
 
     def release(self):
@@ -225,3 +229,63 @@ class Lease:
             self._reg.deregister(self.kind, self.index, self._lease)
         except OSError:
             pass
+
+
+def resolve_pserver_cluster(ttl_s: float = 3.0, timeout_s: float = 60.0,
+                            exit_on_lost: bool = True):
+    """Role-aware cluster resolution for registry-launched pserver jobs
+    (tools/launch.py --registry): replaces the static PSERVERS endpoint
+    list with TTL-lease discovery (reference go/pserver etcd flow).
+
+    Reads PADDLE_TPU_REGISTRY (+PADDLE_TPU_NUM_PSERVERS, TRAINING_ROLE).
+    A PSERVER first BINDS a listening socket (parked for its upcoming
+    listen_and_serv via `parallel.pserver.prebind_endpoint` — the port
+    is owned continuously from publication to serve, no TOCTOU gap),
+    registers the bound address under a kept-alive lease, then everyone
+    blocks until the desired count is registered and gets the SAME
+    index-ordered endpoint list (the transpiler's param split is
+    positional, so order must agree across all processes).
+
+    `exit_on_lost` (pserver role): when the registry revokes the lease
+    (heartbeat gap > TTL — the slot may already be re-assigned), the
+    process EXITS instead of serving as a zombie with a stale identity,
+    matching the reference pserver's lease-expiry crash
+    (go/cmd/pserver/pserver.go:42).
+
+    Returns (pservers_csv, my_endpoint_or_None, lease_or_None); falls
+    back to the PSERVERS/SERVER_ENDPOINT env convention when no registry
+    is configured.
+    """
+    import os
+    import sys
+
+    reg_addr = os.environ.get("PADDLE_TPU_REGISTRY")
+    role = os.environ.get("TRAINING_ROLE", "TRAINER")
+    if not reg_addr:
+        return (os.environ["PSERVERS"],
+                os.environ.get("SERVER_ENDPOINT"), None)
+    rc = RegistryClient(reg_addr)
+    n = int(os.environ["PADDLE_TPU_NUM_PSERVERS"])
+    my_ep = None
+    lease = None
+    if role == "PSERVER":
+        from ..parallel.pserver import prebind_endpoint
+
+        my_ep = prebind_endpoint()
+
+        def _lost():
+            sys.stderr.write(
+                f"pserver {my_ep}: registry lease revoked (heartbeat "
+                "gap > TTL); exiting — the slot may already belong to a "
+                "replacement\n")
+            os._exit(17)
+
+        lease = Lease(rc, "pserver", my_ep, ttl_s=ttl_s,
+                      on_lost=_lost if exit_on_lost else None)
+    if not rc.wait_ready("pserver", n, timeout_s):
+        raise RuntimeError(
+            f"registry at {reg_addr}: only "
+            f"{len(rc.list('pserver'))}/{n} pservers registered within "
+            f"{timeout_s}s — cluster cannot form (fail fast, don't hang)")
+    eps = [addr for _, addr in sorted(rc.list("pserver").items())]
+    return ",".join(eps), my_ep, lease

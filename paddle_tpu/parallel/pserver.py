@@ -27,9 +27,31 @@ import numpy as np
 from ..core.lod import LoDTensor
 
 __all__ = ["VariableServer", "VariableClient", "serialize_var",
-           "deserialize_var"]
+           "deserialize_var", "prebind_endpoint"]
 
 _HDR = struct.Struct("<I")
+
+# endpoint -> bound+listening socket, held from address PUBLICATION to
+# serve(): registry-discovered pservers bind FIRST and register the
+# already-owned port (the reference's etcd flow — pserver.go binds the
+# service then publishes), so no other process can take it in between
+_prebound: Dict[int, socket.socket] = {}
+
+
+def prebind_endpoint(host: str = "127.0.0.1") -> str:
+    """Bind+listen an OS-assigned port NOW and park the socket for the
+    VariableServer that will later `serve(port)`; returns 'host:port'."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    s.listen(16)
+    port = s.getsockname()[1]
+    _prebound[port] = s
+    return f"{host}:{port}"
+
+
+def _adopt_prebound(port: int):
+    return _prebound.pop(port, None) if port else None
 
 
 # ---------------------------------------------------------------------------
@@ -131,10 +153,14 @@ class VariableServer:
 
     # -- lifecycle ----------------------------------------------------------
     def serve(self, port: int = 0) -> int:
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("127.0.0.1", port))
-        self._sock.listen(16)
+        sock = _adopt_prebound(port)
+        if sock is not None:
+            self._sock = sock
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind(("127.0.0.1", port))
+            self._sock.listen(16)
         self.port = self._sock.getsockname()[1]
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()

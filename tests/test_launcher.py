@@ -45,3 +45,35 @@ def test_launch_dist_fit_a_line(monkeypatch):
                     p.wait(timeout=20)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+def test_launch_registry_discovery_cluster(monkeypatch):
+    """--registry mode: no static endpoints — pservers self-register
+    under TTL leases, trainers discover via the registry, same model
+    converges (reference etcd flow, go/cmd/pserver/pserver.go)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=1")
+    from launch import launch_registry_cluster
+
+    reg, procs = launch_registry_cluster(
+        os.path.join(REPO, "examples", "dist_fit_a_line.py"), [],
+        n_pservers=2, n_trainers=2)
+    try:
+        rcs = [p.wait(timeout=480) for role, p in procs
+               if role == "trainer"]
+        assert all(rc == 0 for rc in rcs), rcs
+        # both pservers registered with distinct auto-assigned endpoints
+        eps = reg.list("pserver")
+        assert len(eps) == 2 and len(set(eps.values())) == 2
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for _, p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        reg.close()

@@ -35,7 +35,7 @@ import socket
 import subprocess
 import sys
 
-__all__ = ["launch_pserver_cluster"]
+__all__ = ["launch_pserver_cluster", "launch_registry_cluster"]
 
 
 def _free_port() -> int:
@@ -44,6 +44,43 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def launch_registry_cluster(script, script_args, n_pservers, n_trainers,
+                            python=sys.executable):
+    """Registry mode: NO static endpoint list.  The launcher hosts a
+    TTL-lease registry (paddle_tpu.cloud.registry); pservers pick their
+    own ports and register, trainers discover — the reference's etcd
+    flow (go/cmd/pserver/pserver.go) instead of PSERVERS env plumbing.
+    The script resolves its role via
+    `cloud.registry.resolve_pserver_cluster()`.
+
+    Returns (registry, [(role, proc)...]); stop the registry after the
+    trainers exit."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.cloud.registry import Registry
+
+    reg = Registry()
+    rport = reg.serve(0)
+    reg.set_desired("pserver", n_pservers)
+    base = dict(os.environ,
+                PADDLE_TPU_REGISTRY=f"127.0.0.1:{rport}",
+                PADDLE_TPU_NUM_PSERVERS=str(n_pservers),
+                PADDLE_INIT_NUM_GRADIENT_SERVERS=str(n_trainers))
+    procs = []
+    for _ in range(n_pservers):
+        env = dict(base, TRAINING_ROLE="PSERVER")
+        procs.append(("pserver",
+                      subprocess.Popen([python, script] + script_args,
+                                       env=env)))
+    for i in range(n_trainers):
+        env = dict(base, TRAINING_ROLE="TRAINER",
+                   PADDLE_INIT_TRAINER_ID=str(i))
+        procs.append(("trainer",
+                      subprocess.Popen([python, script] + script_args,
+                                       env=env)))
+    return reg, procs
 
 
 def launch_pserver_cluster(script, script_args, n_pservers, n_trainers,
@@ -94,6 +131,11 @@ def main():
     ap.add_argument("--pserver-offset", type=int, default=0,
                     help="index into --endpoints of this host's first "
                          "pserver (multi-host)")
+    ap.add_argument("--registry", action="store_true",
+                    help="host a TTL-lease registry instead of static "
+                         "endpoints; pservers self-register, trainers "
+                         "discover (script must use "
+                         "cloud.registry.resolve_pserver_cluster)")
     ap.add_argument("--coordinator", default=None,
                     help="jax.distributed coordinator address host:port")
     ap.add_argument("--num-processes", type=int, default=None)
@@ -116,9 +158,18 @@ def main():
         sys.exit(subprocess.call([sys.executable, args.script] +
                                  args.script_args, env=env))
 
-    procs = launch_pserver_cluster(args.script, args.script_args,
-                                   args.pservers, args.trainers,
-                                   args.endpoints, args.pserver_offset)
+    reg = None
+    if args.registry:
+        if args.endpoints or args.pserver_offset:
+            ap.error("--registry discovers endpoints dynamically; "
+                     "--endpoints/--pserver-offset only apply to the "
+                     "static mode")
+        reg, procs = launch_registry_cluster(
+            args.script, args.script_args, args.pservers, args.trainers)
+    else:
+        procs = launch_pserver_cluster(args.script, args.script_args,
+                                       args.pservers, args.trainers,
+                                       args.endpoints, args.pserver_offset)
     rc = 0
     # trainers finishing ends the job; pservers are then terminated
     # (the reference's fabric launcher kills pservers the same way)
@@ -134,6 +185,8 @@ def main():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+    if reg is not None:
+        reg.close()
     sys.exit(rc)
 
 
