@@ -281,3 +281,36 @@ def test_wmt16_parser_and_dict_cache(tmp_path, monkeypatch):
     # tiny dict -> OOV words map to <unk>
     small = list(wmt16.reader_creator(tar, "wmt16/val", 5, 5, "en")())
     assert wmt16.UNK_ID in small[0][0]
+
+
+# ---------------------------------------------------------------------------
+# uci_housing: space-separated table fixture
+# ---------------------------------------------------------------------------
+
+
+def test_uci_housing_parser_and_normalization(tmp_path):
+    from paddle_tpu.dataset import uci_housing
+
+    r = np.random.RandomState(3)
+    raw = np.abs(r.rand(10, 14)).astype(np.float32) * 10
+    path = tmp_path / "housing.data"
+    with open(path, "w") as f:
+        for row in raw:
+            f.write(" ".join(f"{v:.4f}" for v in row) + "\n")
+
+    train_rows, test_rows = uci_housing.load_data(str(path))
+    assert train_rows.shape == (8, 14) and test_rows.shape == (2, 14)
+    # features are mean-centered scaled by range; target column untouched
+    data = np.vstack([train_rows, test_rows])
+    parsed = np.loadtxt(path, dtype=np.float32)
+    for i in range(13):
+        col = parsed[:, i]
+        expect = (col - col.mean()) / (col.max() - col.min())
+        np.testing.assert_allclose(data[:, i], expect, rtol=1e-4,
+                                   atol=1e-5)
+    np.testing.assert_allclose(data[:, 13], parsed[:, 13], rtol=1e-4)
+
+    with pytest.raises(ValueError, match="not a multiple"):
+        bad = tmp_path / "bad.data"
+        bad.write_text("1.0 2.0 3.0\n")
+        uci_housing.load_data(str(bad))
