@@ -1,0 +1,82 @@
+"""Distributed recognize-digits (MLP) with the pserver transpiler.
+
+Reference: tests/book_distribute/notest_dist_recognize_digits.py — the
+same env-var role convention as dist_fit_a_line (PSERVERS /
+TRAINING_ROLE / SERVER_ENDPOINT / PADDLE_INIT_TRAINER_ID, or TTL-lease
+discovery under tools/launch.py --registry), with a real model on real
+reader data: 784 -> 128 -> 64 -> softmax(10) over the mnist dataset
+(real corpus when cached, synthetic fallback offline).
+
+    python tools/launch.py --pservers 2 --trainers 2 \
+        examples/dist_recognize_digits.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, reader
+
+
+def main():
+    role = os.environ["TRAINING_ROLE"]
+    trainers = int(os.environ.get("PADDLE_INIT_NUM_GRADIENT_SERVERS", "1"))
+    from paddle_tpu.cloud.registry import resolve_pserver_cluster
+
+    pservers, my_endpoint, lease = resolve_pserver_cluster()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h1 = fluid.layers.fc(input=img, size=128, act="relu")
+        h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+        pred = fluid.layers.fc(input=h2, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        opt_ops, params_grads = fluid.Momentum(
+            learning_rate=0.05, momentum=0.9).minimize(loss)
+
+        t = fluid.DistributeTranspiler()
+        t.transpile(optimize_ops=opt_ops, params_grads=params_grads,
+                    trainers=trainers, pservers=pservers)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        endpoint = my_endpoint or os.environ["SERVER_ENDPOINT"]
+        exe.run(t.get_startup_program(endpoint))
+        exe.run(t.get_pserver_program(endpoint))  # serves until STOP
+        if lease is not None:
+            lease.release()
+        return
+
+    assert role == "TRAINER", role
+    exe.run(startup)
+    trainer_prog = t.get_trainer_program()
+    batches = reader.batch(reader.shuffle(dataset.mnist.train(), 512),
+                           batch_size=64, drop_last=True)
+    accs = []
+    losses = []
+    for i, batch in enumerate(batches()):
+        imgs = np.stack([s[0] for s in batch]).astype(np.float32)
+        lbls = np.asarray([s[1] for s in batch], np.int64)[:, None]
+        lv, av = exe.run(trainer_prog,
+                         feed={"img": imgs, "label": lbls},
+                         fetch_list=[loss, acc])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        accs.append(float(np.asarray(av).reshape(-1)[0]))
+        if i >= 29:
+            break
+    first, last = np.mean(accs[:5]), np.mean(accs[-5:])
+    print(f"acc {first:.3f} -> {last:.3f}  loss {losses[0]:.3f} -> "
+          f"{losses[-1]:.3f}")
+    if not (last > first or losses[-1] < losses[0]):
+        raise SystemExit("did not learn")
+
+
+if __name__ == "__main__":
+    main()

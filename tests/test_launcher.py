@@ -77,3 +77,29 @@ def test_launch_registry_discovery_cluster(monkeypatch):
                 except subprocess.TimeoutExpired:
                     p.kill()
         reg.close()
+
+
+def test_launch_dist_recognize_digits(monkeypatch):
+    """Second book_distribute model (reference
+    notest_dist_recognize_digits): an MLP classifier over the mnist
+    reader through 2 pservers x 2 trainers, static-endpoint mode."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=1")
+    procs = launch_pserver_cluster(
+        os.path.join(REPO, "examples", "dist_recognize_digits.py"), [],
+        n_pservers=2, n_trainers=2)
+    try:
+        rcs = [p.wait(timeout=480) for role, p in procs
+               if role == "trainer"]
+        assert all(rc == 0 for rc in rcs), rcs
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for _, p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
