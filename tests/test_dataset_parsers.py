@@ -314,3 +314,52 @@ def test_uci_housing_parser_and_normalization(tmp_path):
         bad = tmp_path / "bad.data"
         bad.write_text("1.0 2.0 3.0\n")
         uci_housing.load_data(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# movielens: ml-1m zip fixture (::-separated, latin-1)
+# ---------------------------------------------------------------------------
+
+
+def _write_ml1m_zip(tmp_path):
+    import zipfile
+
+    movies = ("1::Toy Story (1995)::Animation|Children's|Comedy\n"
+              "2::Jumanji (1995)::Adventure|Children's|Fantasy\n")
+    users = ("1::F::1::10::48067\n"
+             "2::M::56::16::70072\n")
+    ratings = ("1::1::5::978300760\n"
+               "1::2::3::978302109\n"
+               "2::1::4::978301968\n")
+    path = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies.encode("latin-1"))
+        z.writestr("ml-1m/users.dat", users.encode("latin-1"))
+        z.writestr("ml-1m/ratings.dat", ratings.encode("latin-1"))
+    return str(path)
+
+
+def test_movielens_meta_and_readers(tmp_path):
+    from paddle_tpu.dataset import movielens
+
+    path = _write_ml1m_zip(tmp_path)
+    movies, users, titles, cats = movielens.parse_meta(path)
+    assert movies[1].title == "Toy Story"
+    assert movies[2].categories == ["Adventure", "Children's", "Fantasy"]
+    assert users[1].is_male is False and users[2].is_male is True
+    assert users[2].age == movielens.age_table.index(56)
+    assert sorted(cats) == ["Adventure", "Animation", "Children's",
+                            "Comedy", "Fantasy"]
+    assert "toy" in titles and "jumanji" in titles
+
+    rd = movielens._ratings_reader(path, movies, users, titles, cats,
+                                   is_test=False)
+    recs = list(rd())
+    test_recs = list(movielens._ratings_reader(
+        path, movies, users, titles, cats, is_test=True)())
+    assert len(recs) + len(test_recs) == 3
+    usr_val = recs[0][:4]
+    assert usr_val[0] in (1, 2) and usr_val[1] in (0, 1)
+    # rating rescale r*2-5: 5 -> 5.0, 3 -> 1.0, 4 -> 3.0
+    all_ratings = {r2[-1][0] for r2 in recs + test_recs}
+    assert all_ratings <= {5.0, 1.0, 3.0}
