@@ -363,3 +363,49 @@ def test_movielens_meta_and_readers(tmp_path):
     # rating rescale r*2-5: 5 -> 5.0, 3 -> 1.0, 4 -> 3.0
     all_ratings = {r2[-1][0] for r2 in recs + test_recs}
     assert all_ratings <= {5.0, 1.0, 3.0}
+
+
+# ---------------------------------------------------------------------------
+# wmt14: dict members + tab-separated parallel text in one tgz
+# ---------------------------------------------------------------------------
+
+
+def _write_wmt14_tar(tmp_path):
+    src_dict = "<s>\n<e>\n<unk>\na\nman\nsleeps\n"
+    trg_dict = "<s>\n<e>\n<unk>\nein\nmann\nschlaeft\n"
+    train = ("a man sleeps\tein mann schlaeft\n"
+             "a man runs\tein mann rennt\n"
+             + " ".join(["tok"] * 90) + "\t" + " ".join(["tok"] * 90)
+             + "\n")  # >80 tokens: dropped
+    path = tmp_path / "wmt14.tgz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (("wmt14/src.dict", src_dict),
+                           ("wmt14/trg.dict", trg_dict),
+                           ("wmt14/train/train", train)):
+            data = text.encode()
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+    return str(path)
+
+
+def test_wmt14_parser(tmp_path):
+    from paddle_tpu.dataset import wmt14
+
+    path = _write_wmt14_tar(tmp_path)
+    src_dict, trg_dict = wmt14.read_dicts(path, 6)
+    assert src_dict["<s>"] == 0 and src_dict["sleeps"] == 5
+    assert trg_dict["schlaeft"] == 5
+
+    recs = list(wmt14.reader_creator(path, "train/train", 6)())
+    assert len(recs) == 2  # the 90-token line was dropped
+    src, trg, nxt = recs[0]
+    assert src == [0, 3, 4, 5, 1]          # <s> a man sleeps <e>
+    assert trg == [0, 3, 4, 5]             # <s> ein mann schlaeft
+    assert nxt == [3, 4, 5, 1]
+    # OOV -> <unk>
+    assert wmt14.UNK_IDX in recs[1][1] or wmt14.UNK_IDX in recs[1][0]
+
+    # small dict truncation
+    small_src, _ = wmt14.read_dicts(path, 4)
+    assert len(small_src) == 4 and "man" not in small_src
