@@ -409,3 +409,62 @@ def test_wmt14_parser(tmp_path):
     # small dict truncation
     small_src, _ = wmt14.read_dicts(path, 4)
     assert len(small_src) == 4 and "man" not in small_src
+
+
+# ---------------------------------------------------------------------------
+# conll05: gzipped parallel words/props streams in a tar
+# ---------------------------------------------------------------------------
+
+
+def _write_conll05_tar(tmp_path):
+    from paddle_tpu.dataset import conll05
+
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    # sentence 1: one predicate 'sat' with (A0* *) (V*) columns
+    props = ("-\t(A0*\n-\t*)\nsat\t(V*)\n\n"
+             "-\t(A1*)\nbark\t(V*)\n\n")
+    # normalize tabs to spaces (props columns are whitespace-separated)
+    props = props.replace("\t", " ")
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="wb") as f:
+        f.write(words.encode())
+    with gzip.GzipFile(fileobj=pbuf, mode="wb") as f:
+        f.write(props.encode())
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, data in ((conll05.WORDS_NAME, wbuf.getvalue()),
+                           (conll05.PROPS_NAME, pbuf.getvalue())):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+    return str(path)
+
+
+def test_conll05_corpus_and_reader(tmp_path):
+    from paddle_tpu.dataset import conll05
+
+    path = _write_conll05_tar(tmp_path)
+    recs = list(conll05.corpus_reader(path)())
+    assert len(recs) == 2
+    sent, pred, tags = recs[0]
+    assert sent == ["The", "cat", "sat"]
+    assert pred == "sat"
+    assert tags == ["B-A0", "I-A0", "B-V"]
+    assert recs[1][2] == ["B-A1", "B-V"]
+
+    word_dict = {w: i for i, w in enumerate(
+        ["The", "cat", "sat", "Dogs", "bark", "bos", "eos"])}
+    verb_dict = {"sat": 0, "bark": 1}
+    label_dict = {t: i for i, t in enumerate(
+        ["B-A0", "I-A0", "B-V", "B-A1", "O"])}
+    rows = list(conll05.reader_creator(
+        conll05.corpus_reader(path), word_dict, verb_dict,
+        label_dict)())
+    words, n2, n1, c0, p1, p2, verb, mark, labels = rows[0]
+    assert words == [0, 1, 2]
+    assert c0 == [2, 2, 2]            # ctx_0 = 'sat'
+    assert n1 == [1, 1, 1]            # ctx_n1 = 'cat'
+    assert n2 == [0, 0, 0]            # ctx_n2 = 'The'
+    assert p1 == [word_dict["eos"]] * 3
+    assert mark == [1, 1, 1]          # whole window inside the sentence
+    assert labels == [0, 1, 2]
