@@ -468,3 +468,26 @@ def test_conll05_corpus_and_reader(tmp_path):
     assert p1 == [word_dict["eos"]] * 3
     assert mark == [1, 1, 1]          # whole window inside the sentence
     assert labels == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# mq2007: LETOR text format
+# ---------------------------------------------------------------------------
+
+
+def test_mq2007_letor_parser(tmp_path):
+    from paddle_tpu.dataset import mq2007
+
+    path = tmp_path / "train.txt"
+    lines = ["2 qid:10 1:0.5 2:0.25 46:1.0 #docid = D1",
+             "0 qid:10 1:0.1 2:0.0 #docid = D2",
+             "1 qid:11 1:0.9 #docid = D3"]
+    path.write_text("\n".join(lines) + "\n")
+    qs = mq2007.load_from_text(str(path), fill_missing=-1.0)
+    assert len(qs) == 2
+    feats, rel = qs[0]
+    assert feats.shape == (2, 46) and rel.tolist() == [2, 0]
+    assert feats[0, 0] == np.float32(0.5)
+    assert feats[0, 45] == np.float32(1.0)
+    assert feats[1, 45] == np.float32(-1.0)  # missing -> fill
+    assert qs[1][1].tolist() == [1]
