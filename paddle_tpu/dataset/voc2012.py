@@ -1,21 +1,68 @@
-"""voc2012: segmentation surface — (3xHxW float image, HxW int mask).
+"""voc2012: segmentation — (HWC image array, HxW class-index mask).
 
-Reference: /root/reference/python/paddle/v2/dataset/voc2012.py
-(train/test/val readers yielding image + per-pixel label).  Synthetic
-(zero-egress): blocky masks with 21 classes (20 objects + background),
-images correlated with their mask so segmentation is learnable.
+Reference: /root/reference/python/paddle/v2/dataset/voc2012.py — the
+VOCtrainval tar's ImageSets/Segmentation/{train,trainval,val}.txt name
+lists select JPEGImages/<name>.jpg + SegmentationClass/<name>.png pairs,
+decoded to numpy (the palette PNG decodes to class indices).  Real
+corpus under PADDLE_TPU_DATASET=auto|real; synthetic blocky-mask
+fallback offline (same (image, mask) contract, float CHW image).
 """
 from __future__ import annotations
 
+import io
+import tarfile
+
 import numpy as np
 
+from . import common
 from .common import fixed_rng
 
-__all__ = ["train", "test", "val"]
+__all__ = ["train", "test", "val", "reader_creator"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
 _CLASSES = 21
 _H = _W = 64
-_N = {"train": 256, "test": 64, "val": 64}
+_N = {"train": 256, "test": 64, "val": 64}  # synthetic sizes
+
+# reference split selection: train -> 'trainval', test -> 'train',
+# val -> 'val' (voc2012.py train/test/val docstrings)
+_SPLIT = {"train": "trainval", "test": "train", "val": "val"}
+
+
+def reader_creator(filename, sub_name):
+    """Real parser over the VOC tar: (np.array(jpg), np.array(png))
+    per name in the split's ImageSets list."""
+    from PIL import Image
+
+    def reader():
+        with tarfile.open(filename) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            sets = tf.extractfile(members[SET_FILE.format(sub_name)])
+            for line in sets:
+                name = line.decode().strip()
+                if not name:
+                    continue
+                data = tf.extractfile(
+                    members[DATA_FILE.format(name)]).read()
+                label = tf.extractfile(
+                    members[LABEL_FILE.format(name)]).read()
+                yield (np.array(Image.open(io.BytesIO(data))),
+                       np.array(Image.open(io.BytesIO(label))))
+
+    return reader
+
+
+def _fetch():
+    return common.download(VOC_URL, "voc2012", VOC_MD5)
+
+
+# -- synthetic fallback ------------------------------------------------------
 
 
 def _sample(r):
@@ -30,7 +77,7 @@ def _sample(r):
     return img, mask
 
 
-def _reader(tag):
+def _synthetic_reader(tag):
     def reader():
         r = fixed_rng(f"voc2012/{tag}")
         for _ in range(_N[tag]):
@@ -39,13 +86,20 @@ def _reader(tag):
     return reader
 
 
+def _make(tag):
+    path = common.fetch_real("voc2012", _fetch)
+    if path is None:
+        return _synthetic_reader(tag)
+    return reader_creator(path, _SPLIT[tag])
+
+
 def train():
-    return _reader("train")
+    return _make("train")
 
 
 def test():
-    return _reader("test")
+    return _make("test")
 
 
 def val():
-    return _reader("val")
+    return _make("val")

@@ -491,3 +491,80 @@ def test_mq2007_letor_parser(tmp_path):
     assert feats[0, 45] == np.float32(1.0)
     assert feats[1, 45] == np.float32(-1.0)  # missing -> fill
     assert qs[1][1].tolist() == [1]
+
+
+# ---------------------------------------------------------------------------
+# flowers: jpg tgz + .mat labels/splits
+# ---------------------------------------------------------------------------
+
+
+def test_flowers_parser(tmp_path):
+    import scipy.io as scio
+    from PIL import Image
+
+    from paddle_tpu.dataset import flowers
+
+    tgz = tmp_path / "102flowers.tgz"
+    with tarfile.open(tgz, "w:gz") as tar:
+        for i, color in ((1, (255, 0, 0)), (2, (0, 255, 0)),
+                         (3, (0, 0, 255))):
+            buf = io.BytesIO()
+            Image.new("RGB", (300, 280), color).save(buf, format="JPEG")
+            data = buf.getvalue()
+            ti = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+    labels = tmp_path / "imagelabels.mat"
+    setid = tmp_path / "setid.mat"
+    scio.savemat(labels, {"labels": np.array([[5, 9, 13]])})
+    scio.savemat(setid, {"tstid": np.array([[1, 3]]),
+                         "trnid": np.array([[2]]),
+                         "valid": np.array([[2]])})
+
+    rd = flowers.reader_creator(str(tgz), str(labels), str(setid),
+                                "tstid", flowers.test_mapper)
+    recs = list(rd())
+    assert [lbl for _, lbl in recs] == [5, 13]  # 1-based mat labels
+    img = recs[0][0]
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+
+    # raw mode (mapper=None) yields the jpeg bytes
+    raw = list(flowers.reader_creator(str(tgz), str(labels), str(setid),
+                                      "trnid", None)())
+    assert raw == [(raw[0][0], 9)] and raw[0][0][:2] == b"\xff\xd8"
+
+
+# ---------------------------------------------------------------------------
+# voc2012: VOC tar with ImageSets/JPEGImages/SegmentationClass
+# ---------------------------------------------------------------------------
+
+
+def test_voc2012_parser(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.dataset import voc2012
+
+    path = tmp_path / "VOCtrainval.tar"
+    with tarfile.open(path, "w") as tar:
+        def add(name, data):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+
+        add(voc2012.SET_FILE.format("train"), b"img1\n")
+        buf = io.BytesIO()
+        Image.new("RGB", (8, 6), (10, 20, 30)).save(buf, format="JPEG")
+        add(voc2012.DATA_FILE.format("img1"), buf.getvalue())
+        # grayscale mask keeps raw class indices (PIL re-indexes sparse
+        # P-mode palettes on save; real VOC PNGs carry full palettes)
+        mask = Image.new("L", (8, 6))
+        mask.putpixel((0, 0), 7)
+        buf = io.BytesIO()
+        mask.save(buf, format="PNG")
+        add(voc2012.LABEL_FILE.format("img1"), buf.getvalue())
+
+    recs = list(voc2012.reader_creator(str(path), "train")())
+    assert len(recs) == 1
+    img, lab = recs[0]
+    assert img.shape == (6, 8, 3)      # HWC
+    assert lab.shape == (6, 8) and lab[0, 0] == 7 and lab[1, 1] == 0
