@@ -4,6 +4,10 @@ Reference model defs: benchmark/paddle/image/{alexnet,googlenet,
 smallnet_mnist_cifar}.py — here built fluid-style and smoke-trained on
 tiny inputs.
 """
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 import paddle_tpu as fluid

@@ -4,6 +4,7 @@ TPU-native addition (no reference analogue — the reference is
 NCHW/cuDNN-only): NHWC is the MXU/VPU-native conv layout; these tests pin
 layout equivalence against NCHW so the fast path can't drift numerically.
 """
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
@@ -50,6 +51,7 @@ def test_nhwc_matches_nchw():
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_resnet_nhwc_trains():
     import paddle_tpu.models.resnet as resnet
     main, startup = fluid.Program(), fluid.Program()
