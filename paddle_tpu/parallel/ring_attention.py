@@ -25,7 +25,15 @@ __all__ = ["ring_attention", "all_to_all_attention", "attention_reference"]
 
 def _block_attn(q, k, v, scale, causal, q_off, kv_off):
     """One (q-block, kv-block) tile: returns (unnormalized out, running max,
-    running denom) for streaming softmax."""
+    running denom) for streaming softmax.
+
+    Lowering note: the per-chunk scores here are XLA-composed (the
+    [b, h, blk, blk] tile materializes in HBM).  Swapping in the Pallas
+    flash kernel per chunk needs an (o, lse) partial contract WITH a
+    custom VJP that propagates the lse cotangent through the ring merge
+    — unverifiable on this 1-chip environment (the kernel only lowers
+    on real TPU multi-chip meshes), so the composed form stays until a
+    pod is available to validate it."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         ql = q.shape[1]
