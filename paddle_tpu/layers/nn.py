@@ -136,11 +136,14 @@ def dropout(x, dropout_prob, is_test=False, seed=0, name=None):
     return out
 
 
-def flash_attention(q, k, v, causal=False, scale=None, name=None):
+def flash_attention(q, k, v, causal=False, scale=None, min_seq_k=None,
+                    name=None):
     """Fused attention over [batch, seq, heads, head_dim] tensors, lowered
-    to the Pallas flash-attention kernel (kernels/flash_attention.py).
-    No reference analogue — the reference composes attention from matmuls
-    (nets.py:162-219); this is the TPU-native hot path."""
+    to the Pallas flash-attention kernel (kernels/flash_attention.py) for
+    long sequences and XLA's fused composition below the measured
+    crossover (min_seq_k=None -> kernel policy default ~2k; 0 forces the
+    kernel).  No reference analogue — the reference composes attention
+    from matmuls (nets.py:162-219); this is the TPU-native hot path."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_tmp_variable(q.dtype)
     out.shape = q.shape
@@ -149,7 +152,9 @@ def flash_attention(q, k, v, causal=False, scale=None, name=None):
                      {"Out": [out.name]},
                      {"causal": bool(causal),
                       "scale": 1.0 if scale is None else float(scale),
-                      "default_scale": scale is None})
+                      "default_scale": scale is None,
+                      "min_seq_k": -1 if min_seq_k is None
+                      else int(min_seq_k)})
     return out
 
 

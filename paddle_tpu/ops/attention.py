@@ -15,14 +15,21 @@ from ..kernels import flash_attention as _flash
 
 
 @register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
-             attrs={"causal": False, "scale": 1.0, "default_scale": True})
+             attrs={"causal": False, "scale": 1.0, "default_scale": True,
+                    "min_seq_k": -1})
 def flash_attention_op(ctx, ins, attrs):
     """Q/K/V: [batch, seq, heads, head_dim].  default_scale=True ->
-    1/sqrt(head_dim); otherwise the explicit `scale` attr (0.0 included)."""
+    1/sqrt(head_dim); otherwise the explicit `scale` attr (0.0 included).
+    min_seq_k: -1 = kernel policy default (XLA composition below ~2k K/V
+    length, where it measures faster); 0 forces the Pallas kernel."""
     q = data_of(one(ins, "Q"))
     k = data_of(one(ins, "K"))
     v = data_of(one(ins, "V"))
     scale = None if attrs.get("default_scale", True) else attrs["scale"]
+    kw = {}
+    msk = int(attrs.get("min_seq_k", -1))
+    if msk >= 0:
+        kw["min_seq_k"] = msk
     out = _flash(q, k, v, causal=bool(attrs.get("causal", False)),
-                 scale=scale)
+                 scale=scale, **kw)
     return {"Out": out}

@@ -45,5 +45,5 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running test (book training flows, subprocess "
-        "clusters). Fast subset: pytest -m 'not slow' (~half the wall "
-        "time); CI runs the full suite.")
+        "clusters). Fast subset: pytest -m 'not slow' runs in ~1/3 the "
+        "wall time (6:22 vs 18:41 measured); CI runs the full suite.")
