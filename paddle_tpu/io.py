@@ -290,8 +290,11 @@ def export_aot_model(dirname, feed_specs: dict, target_vars, executor,
     }
     state_structs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
                      for n, v in states.items()}
-    exported = jax_export.export(jax.jit(infer))(state_structs,
-                                                 feed_structs)
+    # multi-platform lowering: one artifact serves CPU hosts and TPU
+    # serving runtimes (single-platform exports refuse to run elsewhere)
+    exported = jax_export.export(
+        jax.jit(infer), platforms=("cpu", "tpu"))(state_structs,
+                                                  feed_structs)
     os.makedirs(dirname, exist_ok=True)
     path = os.path.join(dirname, AOT_FILENAME)
     with open(path, "wb") as f:
