@@ -54,7 +54,7 @@ def build(model, img, dtype):
         cost = fluid.layers.cross_entropy(input=predict, label=label)
         avg = fluid.layers.mean(cost)
         fluid.Momentum(learning_rate=0.01, momentum=0.9).minimize(avg)
-    return main, startup, avg
+    return main, startup, avg, predict
 
 
 def run_one(model, batch, iters, dtype):
@@ -62,7 +62,7 @@ def run_one(model, batch, iters, dtype):
 
     img, ref_table = SPECS[model]
     classes = 10 if model == "smallnet" else 1000
-    main, startup, avg = build(model, img, dtype)
+    main, startup, avg, _ = build(model, img, dtype)
     r = np.random.RandomState(0)
     feeds = {
         "img": r.rand(batch, 3, img, img).astype(np_dtype(dtype)),
@@ -82,6 +82,54 @@ def run_one(model, batch, iters, dtype):
     print(json.dumps(out))
 
 
+def infer_one(model, batch, iters, dtype):
+    """Inference img/s (is_test program, no optimizer) — the
+    IntelOptimizedPaddle.md CPU-inference table's axis.  Timing is
+    tunnel-cache-proof: distinct input per iteration, async chain, one
+    final block (docs/design/perf.md)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.executor import program_to_fn
+    from paddle_tpu.core.types import np_dtype
+
+    img, _ = SPECS[model]
+    main_p, startup, _, predict = build(model, img, dtype)
+    from paddle_tpu.io import prune
+
+    pred_name = predict.name
+    # forward slice only (drop loss + optimizer ops), is_test semantics
+    infer_prog = prune(main_p, [predict], for_test=True)
+    fn = program_to_fn(infer_prog, ["img"], [pred_name])
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    states = {n: jax.device_put(np.asarray(scope.find_var(n)))
+              for n in fn.state_in_names}
+    key = jax.random.key(0)
+    jfn = jax.jit(lambda feeds, states: fn(feeds, states, key)[0])
+    r = np.random.RandomState(0)
+    variants = [jax.device_put(r.rand(batch, 3, img, img)
+                               .astype(np_dtype(dtype)))
+                for _ in range(iters)]
+    jax.block_until_ready(variants)
+    out = jfn({"img": variants[0]}, states)
+    jax.block_until_ready(out)
+    outs = []
+    t0 = time.perf_counter()
+    for v in variants:
+        outs.append(jfn({"img": v}, states))
+    jax.block_until_ready(outs)
+    ms = (time.perf_counter() - t0) / iters * 1000
+    print(json.dumps({
+        "model": model, "batch": batch, "mode": "inference",
+        "ms_per_batch": round(ms, 3),
+        "images_per_sec": round(batch / ms * 1000, 1),
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="alexnet", choices=sorted(SPECS))
@@ -90,11 +138,19 @@ def main():
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--all", action="store_true",
                     help="reference table grid (README.md:33-95)")
+    ap.add_argument("--infer", action="store_true",
+                    help="inference mode (no optimizer, is_test)")
     args = ap.parse_args()
-    if args.all:
+    if args.all and args.infer:
+        for model in ("alexnet", "googlenet", "resnet50", "vgg19"):
+            for batch in (1, 8, 16):
+                infer_one(model, batch, max(args.iters, 20), args.dtype)
+    elif args.all:
         for model in ("alexnet", "googlenet", "smallnet"):
             for batch in sorted(SPECS[model][1]):
                 run_one(model, batch, args.iters, args.dtype)
+    elif args.infer:
+        infer_one(args.model, args.batch, args.iters, args.dtype)
     else:
         run_one(args.model, args.batch, args.iters, args.dtype)
 
