@@ -85,7 +85,7 @@ def launch_registry_cluster(script, script_args, n_pservers, n_trainers,
 
 def launch_pserver_cluster(script, script_args, n_pservers, n_trainers,
                            endpoints=None, pserver_offset=0,
-                           python=sys.executable):
+                           python=sys.executable, **popen_kwargs):
     """Spawn pserver + trainer processes with the book_distribute env-var
     convention; returns the list of (role, proc).
 
@@ -116,7 +116,7 @@ def launch_pserver_cluster(script, script_args, n_pservers, n_trainers,
                    PADDLE_INIT_NUM_GRADIENT_SERVERS=str(n_trainers))
         procs.append(("trainer",
                       subprocess.Popen([python, script] + script_args,
-                                       env=env)))
+                                       env=env, **popen_kwargs)))
     return procs
 
 
