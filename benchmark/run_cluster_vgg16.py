@@ -112,12 +112,18 @@ def run_trainer_role(batch, iters):
 
 
 def run_cluster(batch, iters, n_pservers, n_trainers):
+    import threading
+
     sys.path.insert(0, os.path.join(REPO, "tools"))
     from launch import launch_pserver_cluster
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=1")
-    os.environ.update(env)
+    # child processes rebuild env from os.environ (launch.py); APPEND to
+    # XLA_FLAGS — clobbering would silently drop operator-set flags like
+    # --xla_cpu_multi_thread_eigen=false and invalidate the measurement
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=1"
+                               ).strip()
     procs = launch_pserver_cluster(
         os.path.abspath(__file__),
         ["--role-body", "--batch", str(batch), "--iters", str(iters)],
@@ -125,19 +131,34 @@ def run_cluster(batch, iters, n_pservers, n_trainers):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     total = 0.0
     ok = True
-    for role, p in procs:
-        if role != "trainer":
-            continue
-        out, _ = p.communicate(timeout=1800)
-        m = re.search(r'\{"role_samples_per_sec": ([0-9.]+)\}',
-                      out or "")
-        if m:
-            total += float(m.group(1))
-        else:
-            ok = False
-    for role, p in procs:
-        if p.poll() is None:
-            p.terminate()
+    try:
+        # drain every trainer pipe CONCURRENTLY: sync-SGD trainers move in
+        # lock-step through the pserver barrier, so one trainer blocked on
+        # a full unread pipe would stall the whole cluster
+        outs = {}
+
+        def drain(p):
+            outs[p] = p.communicate(timeout=1800)[0]
+
+        threads = [threading.Thread(target=drain, args=(p,), daemon=True)
+                   for role, p in procs if role == "trainer"]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1800)
+        for role, p in procs:
+            if role != "trainer":
+                continue
+            m = re.search(r'\{"role_samples_per_sec": ([0-9.]+)\}',
+                          outs.get(p) or "")
+            if m:
+                total += float(m.group(1))
+            else:
+                ok = False
+    finally:
+        for role, p in procs:
+            if p.poll() is None:
+                p.terminate()
     print(json.dumps({
         "bench": "cluster_vgg16", "mode": "pserver_cluster",
         "pservers": n_pservers, "trainers": n_trainers, "batch": batch,

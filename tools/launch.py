@@ -85,13 +85,16 @@ def launch_registry_cluster(script, script_args, n_pservers, n_trainers,
 
 def launch_pserver_cluster(script, script_args, n_pservers, n_trainers,
                            endpoints=None, pserver_offset=0,
-                           python=sys.executable, **popen_kwargs):
+                           python=sys.executable, **trainer_popen_kwargs):
     """Spawn pserver + trainer processes with the book_distribute env-var
     convention; returns the list of (role, proc).
 
     `endpoints` lists the FULL cluster's pservers; this call serves
     eps[pserver_offset : pserver_offset+n_pservers] (multi-host: one call
-    per host with its own offset)."""
+    per host with its own offset).  `trainer_popen_kwargs` apply to the
+    TRAINER Popen calls only (e.g. stdout=PIPE to harvest results);
+    pservers deliberately inherit stdio — nobody drains their pipes, and
+    a full unread pipe would block the server."""
     eps = (endpoints.split(",") if endpoints else
            [f"127.0.0.1:{_free_port()}" for _ in range(n_pservers)])
     if pserver_offset + n_pservers > len(eps):
@@ -116,7 +119,7 @@ def launch_pserver_cluster(script, script_args, n_pservers, n_trainers,
                    PADDLE_INIT_NUM_GRADIENT_SERVERS=str(n_trainers))
         procs.append(("trainer",
                       subprocess.Popen([python, script] + script_args,
-                                       env=env, **popen_kwargs)))
+                                       env=env, **trainer_popen_kwargs)))
     return procs
 
 
