@@ -5,6 +5,10 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the dataset layer's auto mode would download real corpora on a
+# networked host — tests must be deterministic and offline-equal
+# everywhere (parsers are covered separately on generated fixtures)
+os.environ.setdefault("PADDLE_TPU_DATASET", "synthetic")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
