@@ -26,6 +26,15 @@ Two modes:
            --process-id 0 train.py [args...]
    Exports JAX coordination env (the etcd-membership analogue) and execs
    the script; paddle_tpu.parallel.init_distributed() picks it up.
+
+3. registry-discovered pserver cluster (the reference's etcd flow):
+       python tools/launch.py --registry --pservers 2 --trainers 2 train.py
+   The launcher hosts a TTL-lease registry (cloud.registry); pservers
+   bind their own ports, register under kept-alive leases, trainers
+   discover — no static endpoint list, and a dead pserver's slot frees
+   for a replacement.  The script resolves its role via
+   cloud.registry.resolve_pserver_cluster() (see
+   examples/dist_fit_a_line.py, which supports both modes).
 """
 from __future__ import annotations
 
