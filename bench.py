@@ -72,13 +72,19 @@ def build_resnet50_train(batch, dtype):
     return main, startup, avg_cost
 
 
-def run_convergence(target_acc=0.85, max_seconds=120, batch=128):
+def run_convergence(target_acc=0.85, max_seconds=None, batch=128):
     """CIFAR-10 ResNet-20 trained in the SAME numeric config as the
     headline (amp/pure-bf16 per BENCH_AMP) until test accuracy >=
     target_acc; returns a compact result dict with wall-clock.  Uses the
     real corpus when cached, the deterministic synthetic fallback
     offline (dataset/common.py policy) — the point is that the measured
-    numeric mode LEARNS, not the dataset."""
+    numeric mode LEARNS, not the dataset.
+
+    BOTH executables (train step, test eval) are compiled BEFORE the
+    clock starts — r2's driver run burned its whole 120 s budget on
+    tunnel compiles and recorded steps=2, best_acc=0.0.  The training
+    budget (BENCH_CONV_SECONDS, default 180) is pure post-compile
+    wall-clock."""
     import paddle_tpu as fluid
     from paddle_tpu import dataset, reader
     from paddle_tpu.core.types import np_dtype
@@ -108,8 +114,19 @@ def run_convergence(target_acc=0.85, max_seconds=120, batch=128):
             lbls = np.asarray([s[1] for s in b], np.int64)[:, None]
             yield {"img": imgs, "label": lbls}
 
+    if max_seconds is None:
+        max_seconds = float(os.environ.get("BENCH_CONV_SECONDS", "180"))
     train_rd = dataset.cifar.train10()
     test_feed = next(batches(dataset.cifar.test10()))
+    # precompile both executables, then reset params so the timed run
+    # starts from initialization (executor caches by (program, shapes);
+    # the startup re-run is a cache hit and restores init values)
+    t_c = time.perf_counter()
+    exe.run(main, feed=next(batches(train_rd)), fetch_list=[avg],
+            scope=scope)
+    exe.run(test_prog, feed=test_feed, fetch_list=[acc], scope=scope)
+    exe.run(startup, scope=scope)
+    compile_seconds = time.perf_counter() - t_c
     t0 = time.perf_counter()
     steps = 0
     best = 0.0
@@ -121,7 +138,7 @@ def run_convergence(target_acc=0.85, max_seconds=120, batch=128):
             if steps % 20 == 0:
                 a, = exe.run(test_prog, feed=test_feed, fetch_list=[acc],
                              scope=scope)
-                best = max(best, float(np.asarray(a)))
+                best = max(best, float(np.asarray(a).reshape(-1)[0]))
                 if best >= target_acc:
                     reached = True
                     break
@@ -130,12 +147,13 @@ def run_convergence(target_acc=0.85, max_seconds=120, batch=128):
     return {"model": "resnet20_cifar10", "target_acc": target_acc,
             "best_acc": round(best, 4), "reached": reached,
             "steps": steps,
-            "seconds": round(time.perf_counter() - t0, 1)}
+            "seconds": round(time.perf_counter() - t0, 1),
+            "compile_seconds": round(compile_seconds, 1)}
 
 
 def main():
     import paddle_tpu as fluid
-    from harness import roofline_fields, time_program
+    from harness import plausibility, roofline_fields, time_program
 
     if AMP:
         fluid.amp.enable_bf16()
@@ -150,8 +168,23 @@ def main():
         "img": r.rand(*img_shape).astype(np_dtype(DTYPE)),
         "label": r.randint(0, 1000, (BATCH, 1)).astype(np.int32),
     }
+    flops = RESNET50_TRAIN_FLOPS_PER_IMG * BATCH
+    # the timed loop rotates 4 distinct pre-staged batches (harness.
+    # feed_variants) so the tunnel dispatch cache cannot replay a step
     ms, cost = time_program(main_p, startup, feeds, avg.name, ITERS,
                             with_cost=True)
+    fields = roofline_fields(ms, flops, cost)
+    measurement = "async_chained"
+    ok, reason = plausibility(fields, ms)
+    if not ok:
+        # validation fallback: block_until_ready every step.  Overstates
+        # ms on a tunnel (includes the round-trip the async loop
+        # pipelines away) but can never be a cache replay.
+        ms, cost = time_program(main_p, startup, feeds, avg.name, ITERS,
+                                with_cost=True, sync_each_iter=True)
+        fields = roofline_fields(ms, flops, cost)
+        measurement = "sync_per_step"
+        ok, reason = plausibility(fields, ms)
     img_per_sec = BATCH / ms * 1000
     out = {
         "metric": "resnet50_train_images_per_sec",
@@ -162,13 +195,23 @@ def main():
         "amp": AMP,
         "layout": LAYOUT,
         "ms_per_step": round(ms, 2),
+        "measurement": measurement,
     }
-    out.update(roofline_fields(ms, RESNET50_TRAIN_FLOPS_PER_IMG * BATCH,
-                               cost))
+    out.update(fields)
+    out["valid"] = ok
+    if not ok:
+        out["invalid_reason"] = reason
     if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
             "0", "false", "no", "off"):
-        out["convergence"] = run_convergence()
+        conv = run_convergence()
+        out["convergence"] = conv
+        if not conv["reached"]:
+            out["valid"] = False
+            out.setdefault("invalid_reason",
+                           "convergence target not reached in budget")
     print(json.dumps(out))
+    if not out["valid"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -58,6 +58,33 @@ def roofline_fields(ms_per_step, model_flops_per_step, cost):
     return out
 
 
+def plausibility(fields, ms_per_step):
+    """(ok, reason): physical-plausibility gate for one measured config —
+    the defense BENCH_r02 lacked (it published 196,547 img/s, mfu 24.5,
+    hbm_util 71.7 from a tunnel dispatch-cache artifact).  A number is
+    implausible if mfu > 0.6 (no dense model on this stack exceeds ~0.5),
+    hbm_util > 1.2 (beyond the chip's memory bandwidth even allowing
+    XLA's fusion double-counting, benchmark/README.md calibration), or
+    ms/step is below the HBM floor implied by XLA's own bytes-accessed
+    count.  Off-TPU (no peak specs) everything passes."""
+    reasons = []
+    mfu = fields.get("mfu")
+    hbm_util = fields.get("hbm_util")
+    if mfu is not None and mfu > 0.6:
+        reasons.append(f"mfu {mfu} > 0.6 (beyond bf16 roofline)")
+    if hbm_util is not None and hbm_util > 1.2:
+        reasons.append(f"hbm_util {hbm_util} > 1.2 (beyond HBM bandwidth)")
+    gb = fields.get("hbm_gb_per_step")
+    _, _, hbm = chip_specs()
+    if gb and hbm:
+        floor_ms = gb * 1e9 / hbm * 1000
+        if ms_per_step < floor_ms / 1.2:
+            reasons.append(
+                f"ms_per_step {ms_per_step:.2f} < HBM floor "
+                f"{floor_ms:.2f}/1.2 from XLA bytes-accessed")
+    return (not reasons), "; ".join(reasons)
+
+
 def roofline_from_cost(ms_per_step, cost):
     """roofline_fields using XLA's own per-step FLOP count as the model
     FLOPs (uniform across models; slightly generous — XLA also counts
@@ -67,18 +94,58 @@ def roofline_from_cost(ms_per_step, cost):
                            cost)
 
 
+def feed_variants(feeds, n=4, seed=123):
+    """`n` distinct same-shape feed dicts (index 0 = the original).
+
+    The axon device tunnel caches identical dispatches: repeating one
+    jitted call on the SAME input arrays can return in ~0.03 ms with no
+    device work (measured "6000 TFLOP/s" — the BENCH_r02 failure mode).
+    Every timed loop must therefore rotate materially different inputs:
+    float feeds are regenerated per variant, integer feeds rolled along
+    the batch axis.  Callers may also pass a list of dicts to use their
+    own variants verbatim."""
+    import jax.numpy as jnp
+
+    if isinstance(feeds, (list, tuple)):
+        return list(feeds)
+    r = np.random.RandomState(seed)
+    out = [dict(feeds)]
+    for i in range(1, n):
+        v = {}
+        for k, a in feeds.items():
+            a = np.asarray(a)
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                v[k] = r.uniform(size=a.shape).astype(a.dtype)
+            elif a.ndim:
+                v[k] = np.roll(a, i, axis=0)
+            else:
+                v[k] = a
+        out.append(v)
+    return out
+
+
 def time_program(main, startup, feeds, fetch_name, iters,
-                 with_cost: bool = False):
+                 with_cost: bool = False, sync_each_iter: bool = False,
+                 n_variants: int = 4):
     """Run `iters` steady-state training steps of `main`'s block 0 on the
     default device; returns ms/batch (or (ms, xla_cost_analysis_dict) when
-    `with_cost`).  `feeds` are device_put as-is; states are donated so
-    param updates stay on device."""
+    `with_cost`).  States are donated so param updates stay on device.
+
+    `feeds` (a dict, or a list of same-shape dicts) is expanded to
+    `n_variants` distinct pre-staged batches and rotated through the
+    timed loop — see `feed_variants` for why identical inputs are
+    disqualifying here.  `sync_each_iter=True` is the validation
+    fallback: block_until_ready every step and report the median, which
+    includes the full host<->device round-trip the async-chained loop
+    pipelines away (so it OVERSTATES ms on a tunnel — use it to bound,
+    not to headline)."""
     import jax
 
     import paddle_tpu as fluid
     from paddle_tpu.core.executor import program_to_fn
 
-    fn = program_to_fn(main, list(feeds.keys()), [fetch_name])
+    feed_list = feed_variants(feeds, n_variants)
+    fn = program_to_fn(main, list(feed_list[0].keys()), [fetch_name])
     scope = fluid.Scope()
     fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
     states = {n: jax.device_put(np.asarray(scope.find_var(n)))
@@ -90,16 +157,26 @@ def time_program(main, startup, feeds, fetch_name, iters,
         fetches, new_states = fn(feeds, states, key)
         return fetches[fetch_name], new_states
 
-    dev_feeds = jax.device_put(feeds)
+    dev_feeds = [jax.device_put(f) for f in feed_list]
     # AOT-compile once and call the executable directly (a separate
     # lower().compile() would not share jit's cache -> double compile)
-    compiled = step.lower(dev_feeds, states).compile()
+    compiled = step.lower(dev_feeds[0], states).compile()
     cost = compiled.cost_analysis() or {} if with_cost else None
-    loss, states = compiled(dev_feeds, states)  # warmup
+    loss, states = compiled(dev_feeds[0], states)  # warmup
     jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss, states = compiled(dev_feeds, states)
-    jax.block_until_ready(loss)
-    ms = (time.perf_counter() - t0) / iters * 1000
+    n = len(dev_feeds)
+    if sync_each_iter:
+        times = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            loss, states = compiled(dev_feeds[(i + 1) % n], states)
+            jax.block_until_ready(loss)
+            times.append(time.perf_counter() - t0)
+        ms = float(np.median(times)) * 1000
+    else:
+        t0 = time.perf_counter()
+        for i in range(iters):
+            loss, states = compiled(dev_feeds[(i + 1) % n], states)
+        jax.block_until_ready(loss)
+        ms = (time.perf_counter() - t0) / iters * 1000
     return (ms, cost) if with_cost else ms

@@ -42,6 +42,7 @@ def _declare(lib):
     lib.pt_registry_heartbeat.argtypes = [p, cp, i, i64]
     lib.pt_registry_deregister.restype = i
     lib.pt_registry_deregister.argtypes = [p, cp, i, i64]
+    lib.pt_registry_list.restype = ctypes.c_size_t
     lib.pt_registry_list.argtypes = [p, cp, cp, ctypes.c_size_t]
     lib.pt_registry_wait_ready.restype = i
     lib.pt_registry_wait_ready.argtypes = [
@@ -86,8 +87,16 @@ class Registry:
             self._h, kind.encode(), index, lease))
 
     def list(self, kind: str) -> Dict[int, str]:
-        buf = ctypes.create_string_buffer(1 << 20)
-        self._lib.pt_registry_list(self._h, kind.encode(), buf, len(buf))
+        # pt_registry_list returns the required length; retry bigger on
+        # truncation rather than silently dropping endpoints
+        size = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            need = self._lib.pt_registry_list(
+                self._h, kind.encode(), buf, len(buf))
+            if need < len(buf):
+                break
+            size = max(size * 2, need + 1)
         out: Dict[int, str] = {}
         for line in buf.value.decode().splitlines():
             if line.strip():

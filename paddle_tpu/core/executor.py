@@ -177,6 +177,24 @@ def _aval_key(v):
     )
 
 
+def _commit(v, target):
+    """Commit a state value to `target` (a device or sharding) WITHOUT a
+    host round-trip.  jax.jit's internal cache keys on argument
+    committed-ness: startup-program outputs are uncommitted (no committed
+    inputs), while step outputs of the donated training jit are committed,
+    so without normalization the second `exe.run` of an identical config
+    re-traces and re-compiles the whole program (measured 384/305/1.5 ms
+    on a small MLP; +~60 s via the TPU tunnel).  device_put is a no-op
+    returning the same buffer when the value is already committed there."""
+    if isinstance(v, LoDTensor):
+        return LoDTensor(_commit(v.data, target), v.lod)
+    if isinstance(v, jnp.ndarray):
+        return jax.device_put(v, target)
+    if isinstance(v, (np.ndarray, int, float, bool, np.generic)):
+        return jax.device_put(np.asarray(v), target)
+    return v  # opaque host object
+
+
 class _MissingState(KeyError):
     pass
 
@@ -269,6 +287,17 @@ class Executor:
             root = root.parent
 
         class _Env(ScopeEnv):
+            def get(self, name):
+                v = super().get(name)
+                if v is None and name in persistable \
+                        and name not in self.written:
+                    # same diagnosis the compiled path gives via
+                    # _MissingState — not a raw op-level AttributeError
+                    raise RuntimeError(
+                        f"persistable variable {name!r} has no value in "
+                        "scope — run the startup program first")
+                return v
+
             def set(self, name, value):
                 if name in persistable:
                     root.set_var(name, value)
@@ -346,12 +375,13 @@ class Executor:
                     for op in ops:
                         _run_op_instrumented(ctx, op, env)
                     continue
-                self._run_segment_compiled(fp, seg_idx, ops, env, key)
+                self._run_segment_compiled(fp, seg_idx, ops, env, key,
+                                           device)
             outs = self._fetch(env, fetch_names)
         scope.kids.remove(local)
         return outs
 
-    def _run_segment_compiled(self, fp, seg_idx, ops, env, key):
+    def _run_segment_compiled(self, fp, seg_idx, ops, env, key, device):
         # names this segment reads from the surrounding env
         read, written = [], set()
         for op in ops:
@@ -359,7 +389,9 @@ class Executor:
                 if n not in written and n not in read and env.has(n):
                     read.append(n)
             written.update(op.output_names())
-        in_vals = {n: env.get(n) for n in read}
+        repl = _dp_replicated_sharding(ops)
+        in_vals = {n: _commit(env.get(n), repl if repl is not None else device)
+                   for n in read}
         cache_key = (
             fp, "seg", seg_idx,
             tuple((n, _aval_key(v)) for n, v in sorted(in_vals.items())),
@@ -374,7 +406,6 @@ class Executor:
                     run_op(seg_ctx, op, seg_env)
                 return {n: seg_env.d[n] for n in seg_env.written
                         if n in seg_env.d}
-            repl = _dp_replicated_sharding(ops)
             if repl is not None:
                 fn = jax.jit(fn, in_shardings=(repl, repl))
             else:
@@ -438,8 +469,10 @@ class Executor:
                 raise _MissingState(n)
             return scope.find_var(n)
 
-        ro = {n: get_state(n) for n in ro_names}
-        rw = {n: get_state(n) for n in rw_names}
+        repl = _dp_replicated_sharding(block.ops)
+        target = repl if repl is not None else device
+        ro = {n: _commit(get_state(n), target) for n in ro_names}
+        rw = {n: _commit(get_state(n), target) for n in rw_names}
 
         cache_key = (
             self._fingerprint(program),
@@ -454,7 +487,7 @@ class Executor:
         fn = self._cache.get(cache_key)
         if fn is None:
             fn = self._build_compiled_fn(
-                block, fetch_names, state_out_names
+                block, fetch_names, state_out_names, repl
             )
             self._cache[cache_key] = fn
         from paddle_tpu import profiler
@@ -469,7 +502,8 @@ class Executor:
             scope.set_var(n, v)
         return [fetches[n] for n in fetch_names]
 
-    def _build_compiled_fn(self, block, fetch_names, state_out_names):
+    def _build_compiled_fn(self, block, fetch_names, state_out_names,
+                           repl=None):
         def fn(feeds, ro, rw, rng_key):
             env = DictEnv({**ro, **rw, **feeds})
             ctx = ExecContext(rng_key, executor=self, compiled=True)
@@ -484,7 +518,6 @@ class Executor:
             return fetches, state_out
 
         # donate read-write state buffers: in-place param updates on device
-        repl = _dp_replicated_sharding(block.ops)
         if repl is not None:
             # a parallel_do op constrains values to a multi-device mesh:
             # land every input replicated on that device set so the

@@ -20,6 +20,7 @@ import hashlib
 import os
 import shutil
 import sys
+import threading as _threading
 import urllib.request
 import zlib
 
@@ -59,7 +60,7 @@ def download(url: str, module_name: str, md5sum: str,
 
     retry = 0
     while not (os.path.exists(filename) and md5file(filename) == md5sum):
-        if _CACHE_ONLY[0]:
+        if _cache_only():
             raise RuntimeError(f"{filename} is not cached and downloads "
                                "are disabled (offline fallback probe)")
         if retry >= 3:
@@ -85,7 +86,16 @@ def data_mode() -> str:
 
 
 _offline_warned: set = set()
-_CACHE_ONLY = [False]  # download() raises instead of fetching when set
+# Thread-local: download() raises instead of fetching when set.  Must be
+# per-thread, not module-global — reader prefetch threads (xmap_readers /
+# native_pipeline) can load datasets concurrently, and one call's
+# cache-only window must not make another thread's first-time download
+# raise and silently degrade to synthetic data.
+_CACHE_ONLY = _threading.local()
+
+
+def _cache_only() -> bool:
+    return getattr(_CACHE_ONLY, "flag", False)
 
 
 def fetch_real(module_name: str, fetch_fn):
@@ -101,12 +111,12 @@ def fetch_real(module_name: str, fetch_fn):
         # a previous download failed — serve already-cached files if the
         # fetch can complete from disk alone, else fall back quietly
         try:
-            _CACHE_ONLY[0] = True
+            _CACHE_ONLY.flag = True
             return fetch_fn()
         except Exception:
             return None
         finally:
-            _CACHE_ONLY[0] = False
+            _CACHE_ONLY.flag = False
     try:
         return fetch_fn()
     except Exception as e:

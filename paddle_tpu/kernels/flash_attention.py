@@ -386,6 +386,15 @@ def flash_attention_reference(q, k, v, causal=False, scale=None):
 MIN_PALLAS_SEQ_K = 2048
 
 
+def _largest_tile(seq, block, align=128):
+    """Largest multiple of `align` that divides `seq`, capped at `block`;
+    0 when none exists (seq not `align`-aligned)."""
+    for m in range(min(block, seq) // align, 0, -1):
+        if seq % (m * align) == 0:
+            return m * align
+    return 0
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=None, min_seq_k=MIN_PALLAS_SEQ_K):
@@ -411,6 +420,14 @@ def flash_attention(q, k, v, causal=False, scale=None,
         return flash_attention_reference(q, k, v, causal, scale_v)
     if not interp and sk < min_seq_k:
         return flash_attention_reference(q, k, v, causal, scale_v)
+    if not interp and (sq % block_q or sk % block_k):
+        # seqs that are MXU-aligned but not multiples of the large
+        # default blocks (e.g. sk=2560 vs block_k=1024) must shrink to
+        # the largest 128-multiple divisor, not fall back to the
+        # score-materializing composition — above the crossover that
+        # fallback is exactly what the kernel exists to avoid
+        block_q = _largest_tile(sq, block_q) or block_q
+        block_k = _largest_tile(sk, block_k) or block_k
     tiles_ok = sq % block_q == 0 and sk % block_k == 0
     if not interp:
         # Mosaic lowering wants MXU-aligned tiles; route small/ragged

@@ -55,6 +55,9 @@ struct Registry {
   // bound); open fds are tracked so StopServe can shutdown() them, which
   // unblocks any thread parked in read().
   std::atomic<bool> serving{false};
+  // set (under mu) by StopServe so handlers parked in WaitReady's cv —
+  // which fd shutdown cannot unblock — wake and exit before teardown
+  std::atomic<bool> stopping{false};
   std::atomic<int> active_conns{0};
   int listen_fd = -1;
   std::thread server_thread;
@@ -152,6 +155,7 @@ struct Registry {
         std::chrono::duration_cast<Clock::duration>(
             std::chrono::duration<double>(timeout_s));
     for (;;) {
+      if (stopping) return 0;  // registry is tearing down
       ExpireLocked(kind);
       if (kinds[kind].size() >= n) return 1;
       // re-check at least every 50ms: expiry is lazy, so a waiter must
@@ -286,6 +290,13 @@ struct Registry {
 
   void StopServe() {
     if (!serving.exchange(false)) return;
+    {
+      // under mu so a WaitReady between its stopping-check and cv.wait
+      // cannot miss the wakeup
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv.notify_all();
     shutdown(listen_fd, SHUT_RDWR);
     close(listen_fd);
     if (server_thread.joinable()) server_thread.join();
@@ -294,11 +305,19 @@ struct Registry {
       std::lock_guard<std::mutex> lk(conn_mu);
       for (int fd : conn_fds) shutdown(fd, SHUT_RDWR);
     }
-    // detached handlers exit promptly once their fd is shut down; bound
-    // the wait so a pathological handler cannot hang process shutdown
-    for (int i = 0; i < 200 && active_conns.load() > 0; ++i) {
-      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    // wait until every detached handler has actually exited before
+    // returning: the caller (pt_registry_destroy / ~Registry) deletes
+    // this object next, so returning with a live handler would be a
+    // use-after-free.  Handlers in read() are woken by the fd shutdown
+    // above, handlers in WaitReady by stopping+notify_all; re-notify in
+    // the loop in case one re-entered the cv before seeing the flag.
+    while (active_conns.load() > 0) {
+      cv.notify_all();
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
     }
+    // every handler has exited: clear the flag so the in-process
+    // WaitReady API and a later Serve() on this handle work again
+    stopping = false;
   }
 };
 
@@ -326,10 +345,14 @@ PT_API int pt_registry_deregister(void* h, const char* kind, int index,
 }
 
 // writes newline-joined "<index> <addr>" into buf (NUL-terminated)
-PT_API void pt_registry_list(void* h, const char* kind, char* buf,
-                             size_t buflen) {
+// Returns the REQUIRED length (strlen, excluding NUL).  A return >=
+// buflen means the copy was truncated and the caller must retry with a
+// bigger buffer — silent truncation would drop live endpoints.
+PT_API size_t pt_registry_list(void* h, const char* kind, char* buf,
+                               size_t buflen) {
   std::string s = static_cast<Registry*>(h)->List(kind);
   std::snprintf(buf, buflen, "%s", s.c_str());
+  return s.size();
 }
 
 PT_API int pt_registry_wait_ready(void* h, const char* kind, size_t n,

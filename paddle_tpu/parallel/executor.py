@@ -166,6 +166,15 @@ class ParallelExecutor:
             out = [np.asarray(v) for v in out]
         return out
 
+    def state(self, name: str) -> np.ndarray:
+        """Gather one state var (parameter / accumulator) to host — the
+        cross-strategy equivalence tests read final params through this
+        (reference test_CompareSparse.cpp discipline: different execution
+        strategies must produce identical trained parameters)."""
+        if name not in self._states:
+            raise KeyError(f"no state var {name!r}")
+        return np.asarray(self._states[name])
+
     def compiled_collectives(self, feed: Dict) -> Dict[str, int]:
         """Counts of cross-device collective ops in the optimized HLO of
         the train step compiled for `feed`'s shapes — pins the

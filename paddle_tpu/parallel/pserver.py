@@ -24,7 +24,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..core.lod import LoDTensor
+from ..core.lod import LoDTensor, SelectedRows
 
 __all__ = ["VariableServer", "VariableClient", "serialize_var",
            "deserialize_var", "prebind_endpoint"]
@@ -60,6 +60,20 @@ def _adopt_prebound(port: int):
 
 
 def serialize_var(value) -> bytes:
+    if isinstance(value, SelectedRows):
+        # sparse message: rows + row values + dense height — the
+        # reference's large-model path ships sparse rows to pservers
+        # (ParameterServer2::getParameterSparse, sendrecvop_utils.cc
+        # SerializeToMessage's SELECTED_ROWS branch)
+        rows = np.ascontiguousarray(np.asarray(value.rows))
+        data = np.ascontiguousarray(np.asarray(value.value))
+        head = json.dumps({
+            "kind": "selected_rows", "height": int(value.height),
+            "rows_dtype": str(rows.dtype), "n_rows": int(rows.shape[0]),
+            "dtype": str(data.dtype), "shape": list(data.shape),
+        }).encode()
+        return (_HDR.pack(len(head)) + head + rows.tobytes() +
+                data.tobytes())
     if isinstance(value, LoDTensor):
         data = np.asarray(value.data)
         lod = [list(map(int, lvl)) for lvl in value.lod]
@@ -77,6 +91,13 @@ def deserialize_var(payload: bytes):
     (hlen,) = _HDR.unpack_from(payload)
     head = json.loads(payload[_HDR.size:_HDR.size + hlen])
     raw = payload[_HDR.size + hlen:]
+    if head.get("kind") == "selected_rows":
+        rows_dt = np.dtype(head["rows_dtype"])
+        split = head["n_rows"] * rows_dt.itemsize
+        rows = np.frombuffer(raw[:split], dtype=rows_dt).copy()
+        data = np.frombuffer(raw[split:], dtype=np.dtype(head["dtype"])) \
+            .reshape(head["shape"]).copy()
+        return SelectedRows(rows, data, head["height"])
     data = np.frombuffer(raw, dtype=np.dtype(head["dtype"])).reshape(
         head["shape"]).copy()
     if head["lod"] is not None:
@@ -329,6 +350,9 @@ class VariableServer:
             if prog is not None:
                 self.exe.run(prog, scope=self.scope)
                 self._async_seen.add(name)
+                if isinstance(value, SelectedRows):
+                    # applied rows must not survive to the next arrival
+                    self.scope.erase(name)
             # epilogue fires once per full sweep of DISTINCT grads (Adam
             # beta pows / global step advance at the sync round rate);
             # non-grad sends and resends don't advance the cadence
@@ -340,18 +364,46 @@ class VariableServer:
     def _run_optimize(self):
         # sum per-trainer grads into the canonical grad var, then run the
         # optimize program (the reference generates sum ops in the pserver
-        # program; here the fan-in sum is part of the serving contract)
+        # program; here the fan-in sum is part of the serving contract).
+        # SelectedRows parts merge by row concatenation — duplicate rows
+        # are summed by the optimizer's scatter-add, same as the
+        # reference's merge_selected_rows.
         names = {}
         for n in list(self.scope.local_names()):
             if ".trainer_" in n:
                 base = n.split(".trainer_")[0]
                 names.setdefault(base, []).append(n)
+        sparse = []
         for base, parts in names.items():
-            vals = [np.asarray(self.scope.find_var(p)) for p in parts]
-            self.scope.set_var(base, np.sum(vals, axis=0)
-                               if len(vals) > 1 else vals[0])
+            vals = [self.scope.find_var(p) for p in parts]
+            if any(isinstance(v, SelectedRows) for v in vals):
+                srs = [v for v in vals if isinstance(v, SelectedRows)]
+                if len(srs) != len(vals):
+                    # a mixed round would silently drop the dense parts —
+                    # heterogeneous trainer programs are a config error
+                    raise RuntimeError(
+                        f"grad {base!r}: some trainers sent SelectedRows "
+                        "and others dense tensors; all trainers must use "
+                        "the same is_sparse setting")
+                merged = SelectedRows(
+                    np.concatenate([np.asarray(s.rows) for s in srs]),
+                    np.concatenate([np.asarray(s.value) for s in srs]),
+                    srs[0].height)
+                self.scope.set_var(base, merged)
+                sparse.append((base, parts))
+            else:
+                vals = [np.asarray(v) for v in vals]
+                self.scope.set_var(base, np.sum(vals, axis=0)
+                                   if len(vals) > 1 else vals[0])
         if self.program is not None:
             self.exe.run(self.program, scope=self.scope)
+        # per-iteration sparse-row clearing (listen_and_serv_op.cc:171):
+        # a round's rows must not be re-applied next round if a slower
+        # trainer's SEND hasn't replaced the slot yet
+        for base, parts in sparse:
+            self.scope.erase(base)
+            for p in parts:
+                self.scope.erase(p)
 
     def _blocking_get(self, name: str):
         # The fan-in optimize runs atomically under the server lock, so a

@@ -120,3 +120,19 @@ def test_nets_multihead_attention():
     p /= p.sum(-1, keepdims=True)
     ref = np.einsum("bhqk,bkhd->bqhd", p, xh).reshape(b, s, dm)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_block_shrink_for_unaligned_seqs():
+    """Seqs that are 128-aligned but not multiples of the large default
+    blocks (e.g. 2560 vs block_k=1024) shrink to the largest 128-multiple
+    divisor instead of falling back to the score-materializing
+    composition (ADVICE r2)."""
+    from paddle_tpu.kernels.flash_attention import _largest_tile
+
+    assert _largest_tile(2560, 1024) == 640
+    assert _largest_tile(3584, 1024) == 896
+    assert _largest_tile(4096, 1024) == 1024
+    assert _largest_tile(2048, 512) == 512
+    assert _largest_tile(640, 512) == 128
+    assert _largest_tile(2000, 1024) == 0  # not 128-aligned: no tile
+    assert _largest_tile(96, 512) == 0

@@ -38,6 +38,21 @@ def test_serialize_roundtrip():
     assert tuple(back.lod) == ((0, 1, 3),)
 
 
+def test_serialize_selected_rows_roundtrip():
+    """Sparse (rows+values) message over the wire — the reference's
+    large-model path (sendrecvop_utils.cc SELECTED_ROWS branch,
+    ParameterServer2::getParameterSparse)."""
+    from paddle_tpu.core.lod import SelectedRows
+    r = np.random.RandomState(3)
+    sr = SelectedRows(np.array([4, 1, 4], np.int32),
+                      r.rand(3, 8).astype(np.float32), height=16)
+    back = deserialize_var(serialize_var(sr))
+    assert isinstance(back, SelectedRows)
+    assert back.height == 16
+    np.testing.assert_array_equal(np.asarray(back.rows), sr.rows)
+    np.testing.assert_array_equal(np.asarray(back.value), sr.value)
+
+
 def _sgd_program(param_name, grad_name, lr):
     """pserver optimize program: param -= lr * grad (the reference
     transpiler emits exactly these optimizer ops into the pserver block)."""

@@ -1,0 +1,250 @@
+"""Cross-strategy training equivalence (SURVEY hard part 5).
+
+The reference's discipline: train the SAME config under different
+execution strategies and assert identical trained parameters
+(/root/reference/paddle/gserver/tests/test_CompareSparse.cpp — dense vs
+sparse vs remote-pserver — and test_NetworkCompare.cpp).  Here one model
+is trained 10 steps from one seed under
+
+  (a) serial Executor,
+  (b) dp-8 ParallelExecutor,
+  (c) dp-8 ParallelExecutor with ZeRO-1 optimizer-state sharding,
+  (d) sync TCP-pserver (DistributeTranspiler, 2 pservers),
+
+and every final parameter must agree across all four — pinning that
+pserver numerics == allreduce numerics == serial numerics, not just that
+each strategy's loss goes down.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import parallel
+from paddle_tpu.core.framework import reset_unique_names
+
+STEPS = 10
+FEATS, CLS, HIDDEN = 16, 4, 32
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build():
+    """Momentum (stateful optimizer) so ZeRO-1 actually shards something
+    and the pserver applies a real accumulator update."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATS], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=HIDDEN, act="relu")
+        logits = fluid.layers.fc(input=h, size=CLS)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt_ops, params_grads = fluid.Momentum(
+            learning_rate=0.1, momentum=0.9).minimize(loss)
+    params = [p.name for p in main.global_block().all_parameters()]
+    return main, startup, loss, opt_ops, params_grads, params
+
+
+def _batches():
+    r = np.random.RandomState(7)
+    return [(r.randn(32, FEATS).astype(np.float32),
+             r.randint(0, CLS, (32, 1)).astype(np.int64))
+            for _ in range(STEPS)]
+
+
+def _train_serial(batches):
+    reset_unique_names()
+    main, startup, loss, _, _, params = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for x, y in batches:
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                scope=scope)
+    return {n: np.asarray(scope.find_var(n)) for n in params}
+
+
+def _train_dp(batches, shard_opt):
+    reset_unique_names()
+    main, startup, loss, _, _, params = _build()
+    pe = parallel.ParallelExecutor(
+        main, ["x", "y"], [loss], mesh={"dp": 8},
+        startup_program=startup, shard_optimizer_states=shard_opt)
+    for x, y in batches:
+        pe.run({"x": x, "y": y})
+    return {n: pe.state(n) for n in params}
+
+
+def _train_pserver(batches):
+    reset_unique_names()
+    main, startup, loss, opt_ops, params_grads, params = _build()
+    eps = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    t = fluid.DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(optimize_ops=opt_ops, params_grads=params_grads,
+                    trainers=1, pservers=",".join(eps))
+    trainer_prog = t.get_trainer_program()
+
+    for ep in eps:
+        pprog = t.get_pserver_program(ep)
+        pscope = fluid.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(t.get_startup_program(ep),
+                                             scope=pscope)
+        threading.Thread(
+            target=lambda prog=pprog, sc=pscope: fluid.Executor(
+                fluid.CPUPlace()).run(prog, scope=sc),
+            daemon=True).start()
+    for ep in eps:
+        host, port = ep.rsplit(":", 1)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                socket.create_connection((host, int(port)),
+                                         timeout=0.2).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    for x, y in batches:
+        exe.run(trainer_prog, feed={"x": x, "y": y}, fetch_list=[loss],
+                scope=scope)
+
+    from paddle_tpu.ops.distributed import reset_clients
+    from paddle_tpu.parallel.pserver import VariableClient
+    for ep in eps:
+        VariableClient(ep).stop_server()
+    reset_clients()
+    # after each step the trainer pulls the updated params back, so the
+    # trainer scope holds the post-step-10 values
+    return {n: np.asarray(scope.find_var(n)) for n in params}
+
+
+def _build_embedding_model(is_sparse):
+    vocab, dim = 50, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        # ids [batch, 1] -> embedding [batch, dim] (trailing unit dim
+        # folded by lookup_table)
+        emb = fluid.layers.embedding(ids, size=[vocab, dim],
+                                     is_sparse=is_sparse)
+        logits = fluid.layers.fc(input=emb, size=CLS)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt_ops, params_grads = fluid.SGD(
+            learning_rate=0.2).minimize(loss)
+    params = [p.name for p in main.global_block().all_parameters()]
+    return main, startup, loss, opt_ops, params_grads, params
+
+
+def _emb_batches():
+    r = np.random.RandomState(11)
+    return [(r.randint(0, 50, (32, 1)).astype(np.int64),
+             r.randint(0, CLS, (32, 1)).astype(np.int64))
+            for _ in range(STEPS)]
+
+
+def _train_embedding_serial(batches, is_sparse):
+    reset_unique_names()
+    main, startup, loss, _, _, params = _build_embedding_model(is_sparse)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for ids, y in batches:
+        exe.run(main, feed={"ids": ids, "y": y}, fetch_list=[loss],
+                scope=scope)
+    return {n: np.asarray(scope.find_var(n)) for n in params}
+
+
+def _train_embedding_pserver(batches, is_sparse):
+    reset_unique_names()
+    main, startup, loss, opt_ops, params_grads, params = \
+        _build_embedding_model(is_sparse)
+    eps = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    t = fluid.DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(optimize_ops=opt_ops, params_grads=params_grads,
+                    trainers=1, pservers=",".join(eps))
+    trainer_prog = t.get_trainer_program()
+    for ep in eps:
+        pprog = t.get_pserver_program(ep)
+        pscope = fluid.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(t.get_startup_program(ep),
+                                             scope=pscope)
+        threading.Thread(
+            target=lambda prog=pprog, sc=pscope: fluid.Executor(
+                fluid.CPUPlace()).run(prog, scope=sc),
+            daemon=True).start()
+    for ep in eps:
+        host, port = ep.rsplit(":", 1)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                socket.create_connection((host, int(port)),
+                                         timeout=0.2).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    for ids, y in batches:
+        exe.run(trainer_prog, feed={"ids": ids, "y": y},
+                fetch_list=[loss], scope=scope)
+    from paddle_tpu.ops.distributed import reset_clients
+    from paddle_tpu.parallel.pserver import VariableClient
+    for ep in eps:
+        VariableClient(ep).stop_server()
+    reset_clients()
+    return {n: np.asarray(scope.find_var(n)) for n in params}
+
+
+def test_sparse_dense_remote_agree():
+    """The literal test_CompareSparse claim: dense grads, SelectedRows
+    grads, and SelectedRows shipped over the pserver wire all train to
+    the same parameters."""
+    batches = _emb_batches()
+    results = {
+        "dense": _train_embedding_serial(batches, is_sparse=False),
+        "sparse": _train_embedding_serial(batches, is_sparse=True),
+        "remote_sparse": _train_embedding_pserver(batches, is_sparse=True),
+    }
+    ref = results["dense"]
+    for strategy, params in results.items():
+        if strategy == "dense":
+            continue
+        for name, val in ref.items():
+            np.testing.assert_allclose(
+                params[name], val, rtol=2e-4, atol=1e-5,
+                err_msg=f"{strategy}:{name} diverged from dense")
+
+
+def test_all_strategies_agree():
+    batches = _batches()
+    results = {
+        "serial": _train_serial(batches),
+        "dp8": _train_dp(batches, shard_opt=False),
+        "zero1": _train_dp(batches, shard_opt=True),
+        "pserver": _train_pserver(batches),
+    }
+    ref = results["serial"]
+    for strategy, params in results.items():
+        if strategy == "serial":
+            continue
+        for name, val in ref.items():
+            np.testing.assert_allclose(
+                params[name], val, rtol=2e-4, atol=1e-5,
+                err_msg=f"{strategy}:{name} diverged from serial")
