@@ -21,6 +21,7 @@ from .mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from .composite import collective_counts, make_composite_step  # noqa: F401
 from .moe import moe_ffn, moe_gate  # noqa: F401
 from .pipeline import (  # noqa: F401
     microbatch,

@@ -166,15 +166,6 @@ class ParallelExecutor:
             out = [np.asarray(v) for v in out]
         return out
 
-    def state(self, name: str) -> np.ndarray:
-        """Gather one state var (parameter / accumulator) to host — the
-        cross-strategy equivalence tests read final params through this
-        (reference test_CompareSparse.cpp discipline: different execution
-        strategies must produce identical trained parameters)."""
-        if name not in self._states:
-            raise KeyError(f"no state var {name!r}")
-        return np.asarray(self._states[name])
-
     def compiled_collectives(self, feed: Dict) -> Dict[str, int]:
         """Counts of cross-device collective ops in the optimized HLO of
         the train step compiled for `feed`'s shapes — pins the
@@ -182,7 +173,7 @@ class ParallelExecutor:
         dp-N must show grad all-reduces and nothing else; run_scaling.py
         --virtual reports this per N alongside the no-op virtual
         throughput)."""
-        import re
+        from .mesh import count_collectives
 
         feeds = {
             n: jax.ShapeDtypeStruct(np.asarray(v).shape,
@@ -193,18 +184,7 @@ class ParallelExecutor:
         key = jax.random.key(self._seed)
         txt = self._jit_step.lower(feeds, self._states, key) \
             .compile().as_text()
-        out = {}
-        for op in ("all-reduce", "all-gather", "reduce-scatter",
-                   "collective-permute", "all-to-all"):
-            # instruction forms: `<name> = <type> <op>(`, where <type> may
-            # be a spaced tuple `(f32[], ...)`; async pairs appear as
-            # <op>-start(/<op>-done( — count one per pair.  `<op>(` never
-            # matches operand references (those are `%<op>.N`).
-            n_start = len(re.findall(rf"{op}-start\(", txt))
-            n_bare = len(re.findall(rf"{op}\(", txt))
-            if n_start + n_bare:
-                out[op] = n_start + n_bare
-        return out
+        return count_collectives(txt)
 
     def state(self, name, return_numpy=True):
         v = self._states[name]

@@ -74,3 +74,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
+
+
+def count_collectives(hlo_text: str) -> dict:
+    """Counts of cross-device collective instructions in optimized HLO
+    text — the one shared digest behind ParallelExecutor.
+    compiled_collectives and composite.collective_counts.  Instruction
+    forms: `<name> = <type> <op>(`; async pairs appear as
+    <op>-start(/<op>-done( and count once.  `<op>(` never matches operand
+    references (those are `%<op>.N`)."""
+    import re
+
+    out = {}
+    for op in ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all"):
+        n = len(re.findall(rf"{op}(?:-start)?\(", hlo_text))
+        if n:
+            out[op] = n
+    return out

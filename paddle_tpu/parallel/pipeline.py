@@ -51,7 +51,8 @@ def unmicrobatch(y):
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
-                  axis: str = "pp", batch_axis: str | None = None):
+                  axis: str = "pp", batch_axis: str | None = None,
+                  param_specs=None):
     """Run `stage_fn` as a `pp`-stage GPipe pipeline.
 
     stage_fn:     (params, activation[mb, ...]) -> activation[mb, ...]
@@ -64,6 +65,13 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                   (dp x pp composition: each dp replica pipelines its own
                   batch shard; param grads psum over dp automatically in
                   shard_map's backward)
+    param_specs:  optional pytree of PartitionSpecs (matching
+                  stage_params' structure, or a single spec) whose FIRST
+                  entry must be `axis` — lets stage weights also shard
+                  over a tensor-parallel mesh axis (dp x pp x tp
+                  composition); the stage_fn is then responsible for the
+                  tp collectives (e.g. psum over 'tp' after a
+                  row-parallel matmul).  Default: P(axis) on every leaf.
     returns:      [n_micro, mb, ...] last-stage outputs (sharded over
                   `batch_axis` if given, otherwise replicated).
 
@@ -82,10 +90,19 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                 f"axis size {pp}: one stacked stage per '{axis}' device "
                 "(a mismatch would silently drop stages)")
     x_spec = P(None, batch_axis) if batch_axis else P()
+    if param_specs is None:
+        param_specs = P(axis)
+    else:
+        for spec in jax.tree_util.tree_leaves(
+                param_specs, is_leaf=lambda s: isinstance(s, P)):
+            if not len(spec) or spec[0] != axis:
+                raise ValueError(
+                    f"param_specs leaf {spec} must lead with the pipeline "
+                    f"axis {axis!r} (stacked stage dim)")
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(axis), x_spec),
+        in_specs=(param_specs, x_spec),
         out_specs=x_spec)
     def _run(params_blk, xs):
         stage = jax.lax.axis_index(axis)
