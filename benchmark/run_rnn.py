@@ -16,7 +16,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from harness import roofline_from_cost, time_program
+from harness import gated_time_program
 
 VOCAB = 30000
 SEQ_LEN = 100  # reference fixedlen=100 (pad_seq=True mode)
@@ -61,8 +61,8 @@ def run_one(batch, hidden, iters, dtype):
         [lod_from_seq_lens([SEQ_LEN] * batch)])
     feeds = {"words": words,
              "label": r.randint(0, 2, (batch, 1)).astype(np.int32)}
-    ms, cost = time_program(main, startup, feeds, avg.name, iters,
-                            with_cost=True)
+    ms, cost, fields = gated_time_program(main, startup, feeds, avg.name,
+                                          iters)
     ref = REF.get(batch, {}).get(hidden)
     out = {
         "model": "lstm_textcls", "batch": batch, "hidden": hidden,
@@ -72,8 +72,10 @@ def run_one(batch, hidden, iters, dtype):
         "ref_k40m_ms_per_batch": ref,
         "speedup_vs_ref": round(ref / ms, 2) if ref else None,
     }
-    out.update(roofline_from_cost(ms, cost))
+    out.update(fields)
     print(json.dumps(out))
+    if not fields["valid"]:
+        sys.exit(1)
 
 
 def main():

@@ -27,7 +27,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from harness import roofline_from_cost, time_program  # noqa: E402  (benchmark/ on path via bench.py)
+from harness import (  # noqa: E402  (benchmark/ on path via bench.py)
+    bound_fields,
+    gated_time_program,
+)
 
 SRC_VOCAB = 30000
 TGT_VOCAB = 30000
@@ -43,12 +46,15 @@ def build_transformer(batch, src_len, tgt_len, dtype):
         tgt = fluid.layers.data(name="tgt", shape=[tgt_len], dtype="int64")
         lbl = fluid.layers.data(name="lbl", shape=[tgt_len, 1],
                                 dtype="int64")
-        probs = transformer_translate(
+        logits = transformer_translate(
             src, tgt, SRC_VOCAB, TGT_VOCAB, d_model=512, n_heads=8,
-            n_layers=6, dropout_rate=0.0, is_test=False)
-        probs2d = fluid.layers.reshape(probs, shape=[-1, TGT_VOCAB])
+            n_layers=6, dropout_rate=0.0, is_test=False,
+            return_logits=True)
+        logits2d = fluid.layers.reshape(logits, shape=[-1, TGT_VOCAB])
         lbl2d = fluid.layers.reshape(lbl, shape=[-1, 1])
-        cost = fluid.layers.cross_entropy(input=probs2d, label=lbl2d)
+        # fused softmax-xent on logits: the [b*t, 30k] probability tensor
+        # (and its cotangent) never round-trips HBM
+        cost = fluid.layers.softmax_with_cross_entropy(logits2d, lbl2d)
         avg = fluid.layers.mean(cost)
         fluid.Adam(learning_rate=1e-4).minimize(avg)
     return main, startup, avg
@@ -124,8 +130,8 @@ def run_one(model, batch, src_len, tgt_len, iters, dtype):
         feeds = {"src": seq(SRC_VOCAB, src_len),
                  "tgt": seq(TGT_VOCAB, tgt_len),
                  "lbl": seq(TGT_VOCAB, tgt_len)}
-    ms, cost = time_program(main, startup, feeds, avg.name, iters,
-                            with_cost=True)
+    ms, cost, fields = gated_time_program(main, startup, feeds, avg.name,
+                                          iters)
     tokens = batch * (src_len + tgt_len)
     out = {
         "model": f"seq2seq_{model}", "batch": batch,
@@ -134,8 +140,11 @@ def run_one(model, batch, src_len, tgt_len, iters, dtype):
         "tokens_per_sec": round(tokens / ms * 1000, 1),
         "vs_baseline": None,   # reference published no seq2seq throughput
     }
-    out.update(roofline_from_cost(ms, cost))
+    out.update(fields)
+    out.update(bound_fields(ms, cost))
     print(json.dumps(out))
+    if not fields["valid"]:
+        sys.exit(1)
 
 
 def main():

@@ -24,6 +24,7 @@ from . import (  # noqa: F401
     nets,
     plot,
     regularizer,
+    serving,
 )
 from .clip import (  # noqa: F401
     ErrorClipByValue,

@@ -163,8 +163,13 @@ def transformer_lm(ids, vocab_size, d_model=256, n_heads=4, n_layers=2,
 
 def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
                           d_model=256, n_heads=4, n_layers=2, d_inner=None,
-                          max_len=2048, dropout_rate=0.0, is_test=False):
-    """Encoder-decoder translation model -> [b, t, tgt_vocab] softmax."""
+                          max_len=2048, dropout_rate=0.0, is_test=False,
+                          return_logits=False):
+    """Encoder-decoder translation model -> [b, t, tgt_vocab] softmax
+    (or raw logits with `return_logits=True` — training should feed
+    those to softmax_with_cross_entropy so the [b*t, vocab] probability
+    tensor is never materialized in HBM: at vocab 30k that tensor plus
+    its backward dominates the step's memory traffic)."""
     enc = transformer_encoder(src_ids, src_vocab, d_model, n_heads,
                               n_layers, d_inner, max_len, dropout_rate,
                               is_test)
@@ -172,6 +177,8 @@ def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
                               n_layers, d_inner, max_len, dropout_rate,
                               is_test)
     logits = layers.fc(input=dec, size=tgt_vocab, num_flatten_dims=2)
+    if return_logits:
+        return logits
     return layers.softmax(logits)
 
 

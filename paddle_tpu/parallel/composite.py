@@ -57,6 +57,14 @@ def make_composite_step(mesh: Mesh, dim: int = 8, hidden: int = 16,
                (ZeRO-1: each dp replica owns a slice of optimizer state)
     """
     pp = mesh.shape["pp"]
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+    # the ZeRO-1 velocity specs shard dim over dp and hidden over tp*dp
+    # (and the param specs shard hidden over tp); grow the demo sizes to
+    # the next multiple so ANY mesh shape places cleanly
+    lcm = np.lcm
+    dim = int(lcm(dim, dp))
+    hidden = int(lcm(hidden, tp * dp))
     r = np.random.RandomState(seed)
     per_stage = [(jnp.asarray(r.randn(dim, hidden), jnp.float32) * 0.3,
                   jnp.zeros((hidden,), jnp.float32),

@@ -29,6 +29,7 @@ __all__ = [
     "xla_profiler",
     "record_event",
     "profiler_summary",
+    "profile_compiled_ops",
 ]
 
 _enabled = False
@@ -132,3 +133,99 @@ def xla_profiler(log_dir: str = "/tmp/paddle_tpu_trace"):
 def cuda_profiler(output_file=None, output_mode=None, config=None):
     with xla_profiler() as d:
         yield d
+
+
+# ---------------------------------------------------------------------------
+# compiled-mode per-op table (reference profiler.h:120-146 semantics for
+# whole-block XLA executables)
+# ---------------------------------------------------------------------------
+
+
+def _scope_map(hlo_text: str) -> Dict[str, str]:
+    """HLO instruction name -> source op_name metadata (carries the
+    per-op jax.named_scope the compiled executor emits)."""
+    import re
+
+    out = {}
+    for m in re.finditer(
+            r"%?([\w.\-]+) = [^\n]*metadata={[^}]*op_name=\"([^\"]+)\"",
+            hlo_text):
+        out[m.group(1)] = m.group(2)
+    return out
+
+
+def profile_compiled_ops(run_fn, steps: int = 3, hlo_text: str = "",
+                         print_table: bool = True):
+    """Per-op timing table for a COMPILED block: trace `steps` calls of
+    `run_fn` with jax.profiler, digest the xplane into the reference's
+    sorted calls/total/min/max/ave table (profiler.h:120-146) — compiled
+    -mode hotspots become rankable without leaving the framework.
+
+    Whole-block jit means the interpreter's per-op RecordEvent cannot
+    see inside the fused executable; the device trace can: each XLA op
+    (fusions included) is one event.  Pass the executable's
+    `.as_text()` as `hlo_text` to annotate rows with the originating
+    `named_scope` (framework op) each fused op belongs to.
+
+    Returns rows: [{"name", "scope", "calls", "total", "min", "max",
+    "ave"}] sorted by total desc (seconds, like profiler_summary).
+    """
+    import glob
+    import shutil
+    import tempfile
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="pt_prof_")
+    try:
+        with jax.profiler.trace(tmp):
+            for _ in range(steps):
+                out = run_fn()
+                jax.block_until_ready(out)
+        pbs = glob.glob(tmp + "/**/*.xplane.pb", recursive=True)
+        if not pbs:
+            raise RuntimeError("jax.profiler produced no xplane capture")
+        pd = jax.profiler.ProfileData.from_file(pbs[0])
+
+        per_op: Dict[str, List[float]] = {}
+        for plane in pd.planes:
+            for line in plane.lines:
+                for ev in line.events:
+                    try:
+                        stats = dict(ev.stats)
+                    except Exception:
+                        stats = {}
+                    hlo = stats.get("hlo_op")
+                    if not hlo:
+                        continue
+                    dur = getattr(ev, "duration_ns", 0.0) or 0.0
+                    if dur <= 0:
+                        continue
+                    per_op.setdefault(str(hlo), []).append(dur / 1e9)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    scopes = _scope_map(hlo_text) if hlo_text else {}
+    rows = []
+    for name, ts in per_op.items():
+        rows.append({
+            "name": name,
+            "scope": scopes.get(name, ""),
+            "calls": len(ts), "total": sum(ts),
+            "min": min(ts), "max": max(ts), "ave": sum(ts) / len(ts),
+        })
+    rows.sort(key=lambda r: -r["total"])
+    if print_table:
+        print(format_op_table(rows))
+    return rows
+
+
+def format_op_table(rows, limit: int = 30) -> str:
+    out = [f"{'XLA op':<44}{'Scope':<36}{'Calls':>6}{'Total(ms)':>11}"
+           f"{'Min(ms)':>9}{'Max(ms)':>9}{'Ave(ms)':>9}"]
+    for r in rows[:limit]:
+        out.append(
+            f"{r['name'][:43]:<44}{r['scope'][-35:]:<36}{r['calls']:>6}"
+            f"{r['total'] * 1e3:>11.3f}{r['min'] * 1e3:>9.3f}"
+            f"{r['max'] * 1e3:>9.3f}{r['ave'] * 1e3:>9.3f}")
+    return "\n".join(out)

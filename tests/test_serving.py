@@ -1,0 +1,78 @@
+"""Resident inference server (paddle_tpu/serving.py).
+
+Pins the serving contract: per-request results are IDENTICAL to direct
+single-call execution (dynamic batching must not change numerics —
+is_test batch-norm has no cross-sample coupling), concurrent submits
+aggregate into fewer dispatches, and padding to a bucket never leaks
+into delivered results.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.io import prune
+from paddle_tpu.serving import InferenceServer
+
+
+def _build_cnn():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4,
+                                   filter_size=3, act="relu")
+        bn = fluid.layers.batch_norm(input=conv)
+        pool = fluid.layers.pool2d(input=bn, pool_size=2, pool_stride=2)
+        predict = fluid.layers.fc(input=pool, size=10, act="softmax")
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=predict, label=label))
+        fluid.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, predict
+
+
+def test_server_matches_direct_and_aggregates():
+    main, startup, predict = _build_cnn()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    infer_prog = prune(main, [predict], for_test=True)
+
+    r = np.random.RandomState(0)
+    imgs = r.rand(13, 3, 16, 16).astype(np.float32)  # odd count: padding
+    # direct reference: one bs-13 run through the executor
+    direct, = exe.run(infer_prog, feed={"img": imgs},
+                      fetch_list=[predict], scope=scope)
+
+    server = InferenceServer(infer_prog, "img", predict, scope,
+                             place=fluid.CPUPlace(),
+                             buckets=(1, 2, 4, 8), window_ms=5.0)
+    try:
+        futs = [server.submit(imgs[i]) for i in range(13)]
+        outs = np.concatenate([np.asarray(f.result()) for f in futs])
+        np.testing.assert_allclose(outs, direct, rtol=2e-5, atol=1e-6)
+        stats = server.stats()
+        assert stats["requests"] == 13
+        # 13 concurrent submits with a 5ms window must coalesce well
+        # below one dispatch per request
+        assert stats["dispatches"] < 13, stats
+    finally:
+        server.close()
+
+
+def test_server_single_request_and_shape_check():
+    main, startup, predict = _build_cnn()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    infer_prog = prune(main, [predict], for_test=True)
+    server = InferenceServer(infer_prog, "img", predict, scope,
+                             place=fluid.CPUPlace(), buckets=(1, 4))
+    try:
+        out = server.infer(np.zeros((3, 16, 16), np.float32))
+        assert out.shape == (1, 10)
+        try:
+            server.submit(np.zeros((3, 8, 8), np.float32))
+            raise AssertionError("bad shape accepted")
+        except ValueError:
+            pass
+    finally:
+        server.close()
