@@ -84,9 +84,10 @@ def bound_fields(ms_per_step, cost):
 
 # hbm_util values up to this bound are plausible: XLA's bytes-accessed
 # over-counts fusion re-reads (calibrate_hbm.py measures the count exact
-# on unfused kernels, and the fused transformer step measured ~1.2x its
-# true traffic), so "122% of peak" can be a REAL step outrunning an
-# over-counted floor — only well beyond it is a timing artifact
+# on unfused kernels, and the fused transformer step measured up to
+# ~1.43x its achievable traffic at a sync-validated step time), so
+# "130-140% of peak" can be a REAL step outrunning an over-counted
+# floor — only well beyond it is a timing artifact
 HBM_UTIL_BOUND = 1.5
 
 

@@ -75,20 +75,19 @@ class InferenceServer:
             return fn(feeds, states, key)[0][fetch_name]
 
         jfn = jax.jit(fwd)
-        sample = None
+        from .core.types import np_dtype
+
+        sample, self._dtype = None, np.dtype("float32")
         for v in program.global_block().vars.values():
             if v.name == feed_name:
                 sample = tuple(int(d) for d in v.shape)
+                self._dtype = np.dtype(np_dtype(v.dtype))
+                break
         if sample is None:
             raise ValueError(f"no feed var {feed_name!r} in program")
         if sample and sample[0] == -1:  # data vars carry the batch dim
             sample = sample[1:]
         self._item_shape = sample
-        self._dtype = np.dtype("float32")
-        for v in program.list_vars():
-            if v.name == feed_name:
-                from .core.types import np_dtype
-                self._dtype = np.dtype(np_dtype(v.dtype))
         # AOT-compile every bucket up front: serving never pays a compile
         self._compiled: Dict[int, object] = {}
         for b in self._buckets:
@@ -188,9 +187,22 @@ class InferenceServer:
                     {self._feed_name: staged}, self._states)
             except Exception as e:  # deliver, don't kill the loop
                 for _, fut in batch:
-                    fut.set_exception(e)
+                    _deliver(fut, exception=e)
                 continue
             self._dispatches += 1
             self._requests += n
             for i, (_, fut) in enumerate(batch):
-                fut.set_result(out[i:i + 1])
+                _deliver(fut, result=out[i:i + 1])
+
+
+def _deliver(fut: Future, result=None, exception=None):
+    """Resolve a future, tolerating client-side cancellation — a
+    set_result on a cancelled Future raises InvalidStateError, which
+    must not kill the worker loop (every later request would hang)."""
+    try:
+        if exception is not None:
+            fut.set_exception(exception)
+        else:
+            fut.set_result(result)
+    except Exception:
+        pass  # cancelled by the client; nothing to deliver
