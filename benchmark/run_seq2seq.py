@@ -36,7 +36,7 @@ SRC_VOCAB = 30000
 TGT_VOCAB = 30000
 
 
-def build_transformer(batch, src_len, tgt_len, dtype):
+def build_transformer(batch, src_len, tgt_len, dtype, remat=False):
     import paddle_tpu as fluid
     from paddle_tpu.models.transformer import transformer_translate
 
@@ -49,7 +49,7 @@ def build_transformer(batch, src_len, tgt_len, dtype):
         logits = transformer_translate(
             src, tgt, SRC_VOCAB, TGT_VOCAB, d_model=512, n_heads=8,
             n_layers=6, dropout_rate=0.0, is_test=False,
-            return_logits=True)
+            return_logits=True, remat=remat)
         logits2d = fluid.layers.reshape(logits, shape=[-1, TGT_VOCAB])
         lbl2d = fluid.layers.reshape(lbl, shape=[-1, 1])
         # fused softmax-xent on logits: the [b*t, 30k] probability tensor
@@ -100,14 +100,20 @@ def build_rnn(batch, src_len, tgt_len, dtype):
     return main, startup, avg
 
 
-def run_one(model, batch, src_len, tgt_len, iters, dtype):
+def run_one(model, batch, src_len, tgt_len, iters, dtype, remat=False):
     import paddle_tpu as fluid
 
     if dtype == "bfloat16":
         # f32 master weights, bf16 compute on the MXU ops (amp.py)
         fluid.amp.enable_bf16()
-    build = build_transformer if model == "transformer" else build_rnn
-    main, startup, avg = build(batch, src_len, tgt_len, dtype)
+    if model == "transformer":
+        main, startup, avg = build_transformer(batch, src_len, tgt_len,
+                                               dtype, remat=remat)
+    else:
+        if remat:
+            raise SystemExit("--remat only applies to the transformer "
+                             "model (the rnn build has no remat path)")
+        main, startup, avg = build_rnn(batch, src_len, tgt_len, dtype)
     r = np.random.RandomState(0)
     if model == "transformer":
         feeds = {
@@ -134,7 +140,7 @@ def run_one(model, batch, src_len, tgt_len, iters, dtype):
                                           iters)
     tokens = batch * (src_len + tgt_len)
     out = {
-        "model": f"seq2seq_{model}", "batch": batch,
+        "model": f"seq2seq_{model}", "batch": batch, "remat": remat,
         "src_len": src_len, "tgt_len": tgt_len, "dtype": dtype,
         "ms_per_batch": round(ms, 2),
         "tokens_per_sec": round(tokens / ms * 1000, 1),
@@ -156,8 +162,12 @@ def main():
     ap.add_argument("--tgt-len", type=int, default=128)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize transformer blocks "
+                         "(bytes-for-FLOPs trade on the memory-bound step)")
     a = ap.parse_args()
-    run_one(a.model, a.batch, a.src_len, a.tgt_len, a.iters, a.dtype)
+    run_one(a.model, a.batch, a.src_len, a.tgt_len, a.iters, a.dtype,
+            remat=a.remat)
 
 
 if __name__ == "__main__":

@@ -125,27 +125,43 @@ def _decoder_block(x, enc_out, d_model, n_heads, d_inner, dropout_rate,
 
 def transformer_encoder(src_ids, vocab_size, d_model=256, n_heads=4,
                         n_layers=2, d_inner=None, max_len=2048,
-                        dropout_rate=0.0, is_test=False):
-    """Bidirectional encoder over [b, s] token ids -> [b, s, d_model]."""
+                        dropout_rate=0.0, is_test=False, remat=False):
+    """Bidirectional encoder over [b, s] token ids -> [b, s, d_model].
+
+    `remat=True` wraps each block in layers.recompute (jax.checkpoint):
+    the block's internal activations are re-run in backward instead of
+    living in HBM — the standard bytes-for-FLOPs trade on a
+    memory-bound training step."""
     d_inner = d_inner or 4 * d_model
     x = _embed(src_ids, vocab_size, d_model, max_len, dropout_rate,
                is_test)
     for _ in range(n_layers):
-        x = _encoder_block(x, d_model, n_heads, d_inner, dropout_rate,
-                           is_test)
+        if remat:
+            x = layers.recompute(
+                lambda x=x: _encoder_block(x, d_model, n_heads, d_inner,
+                                           dropout_rate, is_test))
+        else:
+            x = _encoder_block(x, d_model, n_heads, d_inner, dropout_rate,
+                               is_test)
     return _pre_ln(x)
 
 
 def transformer_decoder(tgt_ids, enc_out, vocab_size, d_model=256,
                         n_heads=4, n_layers=2, d_inner=None, max_len=2048,
-                        dropout_rate=0.0, is_test=False):
+                        dropout_rate=0.0, is_test=False, remat=False):
     """Causal decoder ([b, t] ids, optional [b, s, d] memory) -> [b, t, d]."""
     d_inner = d_inner or 4 * d_model
     x = _embed(tgt_ids, vocab_size, d_model, max_len, dropout_rate,
                is_test)
     for _ in range(n_layers):
-        x = _decoder_block(x, enc_out, d_model, n_heads, d_inner,
-                           dropout_rate, is_test)
+        if remat:
+            x = layers.recompute(
+                lambda x=x: _decoder_block(x, enc_out, d_model, n_heads,
+                                           d_inner, dropout_rate,
+                                           is_test))
+        else:
+            x = _decoder_block(x, enc_out, d_model, n_heads, d_inner,
+                               dropout_rate, is_test)
     return _pre_ln(x)
 
 
@@ -164,7 +180,7 @@ def transformer_lm(ids, vocab_size, d_model=256, n_heads=4, n_layers=2,
 def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
                           d_model=256, n_heads=4, n_layers=2, d_inner=None,
                           max_len=2048, dropout_rate=0.0, is_test=False,
-                          return_logits=False):
+                          return_logits=False, remat=False):
     """Encoder-decoder translation model -> [b, t, tgt_vocab] softmax
     (or raw logits with `return_logits=True` — training should feed
     those to softmax_with_cross_entropy so the [b*t, vocab] probability
@@ -172,10 +188,10 @@ def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
     its backward dominates the step's memory traffic)."""
     enc = transformer_encoder(src_ids, src_vocab, d_model, n_heads,
                               n_layers, d_inner, max_len, dropout_rate,
-                              is_test)
+                              is_test, remat=remat)
     dec = transformer_decoder(tgt_ids, enc, tgt_vocab, d_model, n_heads,
                               n_layers, d_inner, max_len, dropout_rate,
-                              is_test)
+                              is_test, remat=remat)
     logits = layers.fc(input=dec, size=tgt_vocab, num_flatten_dims=2)
     if return_logits:
         return logits
