@@ -90,21 +90,32 @@ def bound_fields(ms_per_step, cost):
 # floor — only well beyond it is a timing artifact
 HBM_UTIL_BOUND = 1.5
 
+# mfu values up to this bound are plausible: VGG-19 bs128 measures 0.645
+# by XLA's flop count (which includes pointwise work) at a
+# SYNC-VALIDATED step time (220.8 ms sync ≈ 114.6 ms step + ~106 ms
+# tunnel RTT), so dense conv stacks genuinely reach the mid-0.6s here.
+# The gate exists to refuse physically impossible numbers (the replay
+# artifacts measure 4-25), not to adjudicate 0.60 vs 0.65.
+MFU_BOUND = 0.72
+
 
 def plausibility(fields, ms_per_step):
     """(ok, reason): physical-plausibility gate for one measured config —
     the defense BENCH_r02 lacked (it published 196,547 img/s, mfu 24.5,
     hbm_util 71.7 from a tunnel dispatch-cache artifact).  A number is
-    implausible if mfu > 0.6 (no dense model on this stack exceeds ~0.5)
-    or hbm_util > HBM_UTIL_BOUND (beyond the chip's memory bandwidth
-    even allowing XLA's fusion double-counting — the ms-below-HBM-floor
-    check is algebraically the same test, so one bound covers both).
+    implausible if mfu > MFU_BOUND (the most compute-dense model
+    measured, VGG-19 bs128, sync-validates at 0.645) or hbm_util >
+    HBM_UTIL_BOUND (beyond the chip's memory bandwidth even allowing
+    XLA's fusion double-counting — the ms-below-HBM-floor check is
+    algebraically the same test, so one bound covers both).
     Off-TPU (no peak specs) everything passes."""
     reasons = []
     mfu = fields.get("mfu")
     hbm_util = fields.get("hbm_util")
-    if mfu is not None and mfu > 0.6:
-        reasons.append(f"mfu {mfu} > 0.6 (beyond bf16 roofline)")
+    if mfu is not None and mfu > MFU_BOUND:
+        reasons.append(f"mfu {mfu} > {MFU_BOUND} (beyond the calibrated "
+                       "empirical band; densest measured model reaches "
+                       "0.645)")
     if hbm_util is not None and hbm_util > HBM_UTIL_BOUND:
         reasons.append(f"hbm_util {hbm_util} > {HBM_UTIL_BOUND} "
                        "(beyond HBM bandwidth incl. fusion over-count)")
