@@ -155,7 +155,15 @@ def _to_device_value(v, device):
     """Feed value -> device arrays (LoDTensor wrapper preserved)."""
     if isinstance(v, LoDTensor):
         return LoDTensor(jax.device_put(np.asarray(v.data), device), v.lod)
-    if isinstance(v, (np.ndarray, jnp.ndarray, int, float, bool, np.generic)):
+    if isinstance(v, jnp.ndarray):
+        # already a jax array: placing it directly avoids a device->host
+        # round-trip and keeps a weak dtype weak (np.asarray would do both)
+        return jax.device_put(v, device)
+    if isinstance(v, (int, float, bool)) and not isinstance(v, np.generic):
+        # same weak-typing rule as _commit below: a Python scalar fed to
+        # a bf16 program must not arrive as a strong f32/i64 array
+        return jax.device_put(v, device)
+    if isinstance(v, (np.ndarray, jnp.ndarray, np.generic)):
         return jax.device_put(np.asarray(v), device)
     return v  # opaque host object
 
@@ -190,7 +198,12 @@ def _commit(v, target):
         return LoDTensor(_commit(v.data, target), v.lod)
     if isinstance(v, jnp.ndarray):
         return jax.device_put(v, target)
-    if isinstance(v, (np.ndarray, int, float, bool, np.generic)):
+    if isinstance(v, (int, float, bool)) and not isinstance(v, np.generic):
+        # Python scalars stay weakly typed (device_put direct): routing
+        # them through np.asarray would mint a strong f64->f32/i64 array
+        # and silently promote bf16 consumers in amp programs
+        return jax.device_put(v, target)
+    if isinstance(v, (np.ndarray, np.generic)):
         return jax.device_put(np.asarray(v), target)
     return v  # opaque host object
 

@@ -21,6 +21,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -311,8 +312,23 @@ struct Registry {
     // use-after-free.  Handlers in read() are woken by the fd shutdown
     // above, handlers in WaitReady by stopping+notify_all; re-notify in
     // the loop in case one re-entered the cv before seeing the flag.
+    // A generous deadline guards the must-wait: a handler stuck in a
+    // syscall the fd shutdown cannot interrupt would otherwise spin this
+    // loop forever with no diagnostic.  Returning with a live handler is
+    // a use-after-free, so past the deadline we report and abort instead
+    // of silently hanging or corrupting memory.
+    auto deadline = Clock::now() + std::chrono::seconds(30);
     while (active_conns.load() > 0) {
       cv.notify_all();
+      if (Clock::now() > deadline) {
+        if (active_conns.load() == 0) break;  // exited during this tick
+        std::fprintf(stderr,
+                     "pt_registry: StopServe timed out after 30s with %d "
+                     "handler thread(s) stuck; aborting to avoid "
+                     "use-after-free\n",
+                     active_conns.load());
+        std::abort();
+      }
       std::this_thread::sleep_for(std::chrono::milliseconds(5));
     }
     // every handler has exited: clear the flag so the in-process

@@ -91,6 +91,15 @@ def make_composite_step(mesh: Mesh, dim: int = 8, hidden: int = 16,
         axis inside the compiled program, then one momentum update.  The
         accumulation count is xs' leading dim (static at trace time), so
         the mean is correct for whatever depth the caller feeds."""
+        if xs.shape[-1] != dim or ys.shape[-1] != dim:
+            # dim/hidden are grown to lcm multiples above so ANY mesh
+            # places cleanly — callers must size data to the EFFECTIVE
+            # dim (read it from params: w1 is [pp, dim, hidden])
+            raise ValueError(
+                f"data feature dim {xs.shape[-1]}/{ys.shape[-1]} != "
+                f"effective model dim {dim} (requested dim grew to "
+                f"lcm(dim, dp) for this mesh; size inputs from "
+                "params[0].shape[1])")
         n_acc = xs.shape[0]
 
         def acc(carry, xy):

@@ -120,7 +120,17 @@ class InferenceServer:
         with self._submit_lock:
             if self._stop:
                 raise RuntimeError("InferenceServer is closed")
-            self._q.put((x, fut))
+            try:
+                # non-blocking while holding the lock: a blocking put on a
+                # full queue (worker stalled) would wedge every submitter
+                # on the lock and deadlock close(), whose failure-drain
+                # path needs the same lock
+                self._q.put_nowait((x, fut))
+            except queue.Full:
+                raise RuntimeError(
+                    "InferenceServer queue full "
+                    f"({self._q.maxsize} pending) — backpressure: retry "
+                    "later or raise max_queue") from None
         return fut
 
     def infer(self, x):
