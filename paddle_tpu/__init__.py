@@ -73,7 +73,11 @@ from .data_feeder import DataFeeder  # noqa: F401
 from .parameters import Parameters  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .core.executor import scope_guard, switch_scope  # noqa: F401
-from .core.framework import Block, Operator  # noqa: F401
+from .core.framework import (  # noqa: F401
+    Block,
+    Operator,
+    pipeline_stage,
+)
 from .core.lod import Tensor  # noqa: F401
 from .memory_optimization_transpiler import memory_optimize  # noqa: F401
 from .parallel.executor import (  # noqa: F401
@@ -81,5 +85,6 @@ from .parallel.executor import (  # noqa: F401
     ParallelExecutor,
     SimpleDistributeTranspiler,
 )
+from .parallel.pipeline_program import PipelineExecutor  # noqa: F401
 
 __version__ = "0.1.0"

@@ -36,6 +36,8 @@ __all__ = [
     "switch_startup_program",
     "unique_name",
     "grad_var_name",
+    "pipeline_stage",
+    "current_pipeline_stage",
 ]
 
 GRAD_SUFFIX = "@GRAD"
@@ -60,6 +62,44 @@ def unique_name(prefix: str) -> str:
 
 def reset_unique_names():
     _name_counters.clear()
+
+
+# ---------------------------------------------------------------------------
+# pipeline stage annotation
+# ---------------------------------------------------------------------------
+
+_pipeline_stage_stack: List[int] = []
+
+
+class pipeline_stage:
+    """`with fluid.pipeline_stage(i): ...` — tag the ops built inside the
+    block with pipeline stage `i`.
+
+    This is the DSL surface of pipeline parallelism: the reference made
+    per-layer device placement a user-config feature of the framework
+    (/root/reference/paddle/gserver/gradientmachines/ParallelNeuralNetwork.h,
+    layer `deviceId` + the `parallel_nn` flag, utils/Flags.cpp:37); here the
+    same reachability is a stage annotation on Program ops, consumed by
+    parallel.PipelineExecutor which runs the annotated trunk as a GPipe
+    schedule over a 'pp' mesh axis (parallel/pipeline.py).  The annotation
+    is inert everywhere else — the serial Executor and ParallelExecutor
+    ignore it, so one Program serves both execution styles.
+    """
+
+    def __init__(self, idx: int):
+        self.idx = int(idx)
+
+    def __enter__(self):
+        _pipeline_stage_stack.append(self.idx)
+        return self
+
+    def __exit__(self, *exc):
+        _pipeline_stage_stack.pop()
+        return False
+
+
+def current_pipeline_stage() -> Optional[int]:
+    return _pipeline_stage_stack[-1] if _pipeline_stage_stack else None
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +244,9 @@ class Operator:
             k: _as_name_list(v) for k, v in (outputs or {}).items()
         }
         self.attrs: Dict = dict(attrs or {})
+        stage = current_pipeline_stage()
+        if stage is not None:
+            self.attrs.setdefault("pipeline_stage", stage)
 
     def input_names(self) -> List[str]:
         return [n for vs in self.inputs.values() for n in vs]

@@ -16,7 +16,10 @@ activations bounded for bf16 training on the MXU.
 """
 from __future__ import annotations
 
+import contextlib
+
 from .. import layers, nets
+from ..core.framework import pipeline_stage
 from ..initializer import NormalInitializer
 
 __all__ = [
@@ -148,32 +151,59 @@ def transformer_encoder(src_ids, vocab_size, d_model=256, n_heads=4,
 
 def transformer_decoder(tgt_ids, enc_out, vocab_size, d_model=256,
                         n_heads=4, n_layers=2, d_inner=None, max_len=2048,
-                        dropout_rate=0.0, is_test=False, remat=False):
-    """Causal decoder ([b, t] ids, optional [b, s, d] memory) -> [b, t, d]."""
+                        dropout_rate=0.0, is_test=False, remat=False,
+                        pipeline_stages=None):
+    """Causal decoder ([b, t] ids, optional [b, s, d] memory) -> [b, t, d].
+
+    `pipeline_stages=S` annotates the block stack with
+    `fluid.pipeline_stage` (n_layers/S consecutive blocks per stage) so
+    the SAME program runs serially or as a GPipe pipeline under
+    parallel.PipelineExecutor over a 'pp' mesh axis — the DSL-reachable
+    counterpart of the reference's per-layer device placement
+    (/root/reference/paddle/gserver/gradientmachines/ParallelNeuralNetwork.h).
+    Embedding stays outside the trunk (the usual GPipe decomposition);
+    the final layer_norm lands in the post section.
+    """
     d_inner = d_inner or 4 * d_model
+    if pipeline_stages:
+        if n_layers % pipeline_stages:
+            raise ValueError(
+                f"n_layers {n_layers} must be a multiple of "
+                f"pipeline_stages {pipeline_stages}")
+        if remat:
+            raise NotImplementedError(
+                "remat inside pipeline stages is redundant: the GPipe "
+                "schedule already recomputes per-microbatch")
     x = _embed(tgt_ids, vocab_size, d_model, max_len, dropout_rate,
                is_test)
-    for _ in range(n_layers):
-        if remat:
-            x = layers.recompute(
-                lambda x=x: _decoder_block(x, enc_out, d_model, n_heads,
-                                           d_inner, dropout_rate,
-                                           is_test))
-        else:
-            x = _decoder_block(x, enc_out, d_model, n_heads, d_inner,
-                               dropout_rate, is_test)
+    for i in range(n_layers):
+        stage = (pipeline_stage(i * pipeline_stages // n_layers)
+                 if pipeline_stages else contextlib.nullcontext())
+        with stage:
+            if remat:
+                x = layers.recompute(
+                    lambda x=x: _decoder_block(x, enc_out, d_model,
+                                               n_heads, d_inner,
+                                               dropout_rate, is_test))
+            else:
+                x = _decoder_block(x, enc_out, d_model, n_heads, d_inner,
+                                   dropout_rate, is_test)
     return _pre_ln(x)
 
 
 def transformer_lm(ids, vocab_size, d_model=256, n_heads=4, n_layers=2,
                    d_inner=None, max_len=2048, dropout_rate=0.0,
-                   is_test=False):
+                   is_test=False, return_logits=False,
+                   pipeline_stages=None):
     """Decoder-only causal language model: [b, s] ids -> [b, s, vocab]
-    next-token softmax probabilities."""
+    next-token softmax probabilities (raw logits with
+    `return_logits=True`; `pipeline_stages` as in transformer_decoder)."""
     h = transformer_decoder(ids, None, vocab_size, d_model, n_heads,
                             n_layers, d_inner, max_len, dropout_rate,
-                            is_test)
+                            is_test, pipeline_stages=pipeline_stages)
     logits = layers.fc(input=h, size=vocab_size, num_flatten_dims=2)
+    if return_logits:
+        return logits
     return layers.softmax(logits)
 
 

@@ -29,6 +29,7 @@ from .pipeline import (  # noqa: F401
     stack_stage_params,
     unmicrobatch,
 )
+from .pipeline_program import PipelineExecutor  # noqa: F401
 from .ring_attention import (  # noqa: F401
     all_to_all_attention,
     attention_reference,

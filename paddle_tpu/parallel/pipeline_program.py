@@ -1,0 +1,583 @@
+"""PipelineExecutor: run a `fluid.Program` under dp x pp pipeline
+parallelism.
+
+This closes the gap between the Program DSL and parallel/pipeline.py's
+GPipe schedule: the reference made per-layer device placement reachable
+from user config (ParallelNeuralNetwork,
+/root/reference/paddle/gserver/gradientmachines/ParallelNeuralNetwork.h
++ .cpp, layer `deviceId`, flag `parallel_nn`
+/root/reference/paddle/utils/Flags.cpp:37); here the user annotates the
+Program's repeated trunk with `fluid.pipeline_stage(i)` and this executor
+runs it as one jitted SPMD program:
+
+  * forward ops before the first staged op ("pre", e.g. embedding) and
+    after the last staged op ("post", e.g. classifier + loss) run on the
+    FULL batch, dp-sharded, exactly as the serial interpreter would run
+    them (same op lowerings, same per-op PRNG derivation);
+  * the staged trunk is validated to be structurally homogeneous (same op
+    sequence per stage), its per-stage parameters are stacked on a
+    leading [pp] axis, and it executes through `spmd_pipeline`
+    (shard_map + ppermute + lax.scan) on microbatched activations;
+  * gradients come from `jax.value_and_grad` of that composed forward —
+    autodiff derives the reverse pipeline schedule — and the Program's
+    OWN optimizer ops then apply the update: stage-0's optimizer op runs
+    once per parameter group on the stacked arrays (elementwise updates
+    are stage-invariant; attrs are validated identical across stages),
+    outer parameters run their op individually.
+
+Constraints (validated with explicit errors): stages must be
+structurally identical with a single activation in/out of fixed shape
+(the usual GPipe decomposition — embedding/classifier live outside the
+trunk); stage count must equal the 'pp' mesh axis; trunk stages must be
+stateless (no persistable writes); grad-transform ops (clip/regularizer)
+are supported for outer params but not for staged params.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.execution import DictEnv, ExecContext, run_op
+from ..core.framework import (GRAD_SUFFIX, Parameter, Variable,
+                              default_startup_program, grad_var_name)
+from ..core.executor import CPUPlace, Executor
+from ..core.scope import Scope
+from .mesh import count_collectives, make_mesh
+from .pipeline import microbatch, spmd_pipeline, unmicrobatch
+
+__all__ = ["PipelineExecutor"]
+
+
+def _attr_sig(attrs: Dict) -> tuple:
+    """Hashable attr signature (pipeline_stage excluded) for comparing
+    ops across stages."""
+    def enc(v):
+        if isinstance(v, np.ndarray):
+            return ("nd", v.shape, str(v.dtype), v.tobytes())
+        if isinstance(v, (list, tuple)):
+            return tuple(enc(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, enc(x)) for k, x in v.items()))
+        return v
+    return tuple(sorted((k, enc(v)) for k, v in attrs.items()
+                        if k != "pipeline_stage"))
+
+
+def _amp_enabled() -> bool:
+    from ..amp import is_bf16_enabled
+    return is_bf16_enabled()
+
+
+class PipelineExecutor:
+    def __init__(
+        self,
+        program,
+        feed_names: Sequence[str],
+        fetch_list: Sequence,
+        mesh,
+        startup_program=None,
+        n_micro: int = 4,
+        batch_axis: str = "dp",
+        stage_axis: str = "pp",
+        shard_optimizer_states: bool = False,
+        seed: int = 0,
+    ):
+        if isinstance(mesh, dict):
+            mesh = make_mesh(mesh)
+        self.mesh: Mesh = mesh
+        self.batch_axis = batch_axis
+        self.stage_axis = stage_axis
+        self.n_micro = int(n_micro)
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in fetch_list
+        ]
+        self._seed = seed
+        self._step = 0
+
+        block = program.global_block()
+        self._persistable = {v.name for v in program.list_vars()
+                             if v.persistable}
+        self._partition(block)
+        self._plan_update(block)
+
+        # --- host-side init, then stack + place -------------------------
+        startup = startup_program or default_startup_program()
+        scope = Scope()
+        Executor(CPUPlace()).run(startup, scope=scope)
+        self._init_states(scope, shard_optimizer_states)
+
+        self._jit_step = self._make_jit_step()
+        self._amp_state = _amp_enabled()
+
+    # ------------------------------------------------------------------
+    # program partitioning
+    # ------------------------------------------------------------------
+    def _partition(self, block):
+        pp = self.mesh.shape[self.stage_axis]
+        ops = block.ops
+        bwd_start = None
+        for i, op in enumerate(ops):
+            outs = op.output_names()
+            if (op.type == "fill_constant" and len(outs) == 1
+                    and outs[0].endswith(GRAD_SUFFIX)):
+                bwd_start = i
+                break
+        if bwd_start is None:
+            raise ValueError(
+                "PipelineExecutor needs a training program: call "
+                "optimizer.minimize(loss) before constructing it")
+        self._bwd_start = bwd_start
+        self._loss_name = ops[bwd_start].output_names()[0][
+            : -len(GRAD_SUFFIX)]
+
+        pre, post = [], []
+        stages: Dict[int, list] = {}
+        mode = "pre"
+        for op in ops[:bwd_start]:
+            s = op.attrs.get("pipeline_stage")
+            if s is None:
+                if mode == "pre":
+                    pre.append(op)
+                else:
+                    mode = "post"
+                    post.append(op)
+            else:
+                if mode == "post":
+                    raise ValueError(
+                        f"op {op.type} tagged pipeline_stage={s} appears "
+                        "after unstaged post-trunk ops — the staged trunk "
+                        "must be contiguous")
+                mode = "stage"
+                stages.setdefault(int(s), []).append(op)
+        if not stages:
+            raise ValueError(
+                "no ops tagged with fluid.pipeline_stage(i) — annotate "
+                "the repeated trunk blocks to pipeline this program")
+        idxs = sorted(stages)
+        if idxs != list(range(len(idxs))):
+            raise ValueError(f"stage indices must be 0..S-1, got {idxs}")
+        if len(idxs) != pp:
+            raise ValueError(
+                f"{len(idxs)} pipeline stages but mesh axis "
+                f"'{self.stage_axis}' has {pp} devices — they must match "
+                "(fold several layers into one stage to reduce the count)")
+        self._pre_ops, self._post_ops = pre, post
+        self._stage_ops = [stages[i] for i in idxs]
+        self._validate_stages(block)
+
+        # persistable writes by pre/post (BN stats, counters) are carried
+        # as rw aux state; staged ops must be stateless
+        self._aux_writes = sorted({
+            n for op in pre + post for n in op.output_names()
+            if n in self._persistable})
+        from ..core import registry as op_registry
+        for s, sops in enumerate(self._stage_ops):
+            bad = [n for op in sops for n in op.output_names()
+                   if n in self._persistable]
+            if bad:
+                raise NotImplementedError(
+                    f"stage {s} writes persistable var(s) {bad}: staged "
+                    "trunk ops must be stateless (keep BN/counters in the "
+                    "pre/post sections)")
+            for op in sops:
+                try:
+                    info = op_registry.get_op_info(op.type)
+                except KeyError:
+                    continue
+                if info.random and not op.attrs.get("is_test", False):
+                    raise NotImplementedError(
+                        f"stage {s} contains stochastic op {op.type!r}: "
+                        "one traced stage body would reuse a fixed PRNG "
+                        "key across stages/microbatches/steps, silently "
+                        "diverging from serial execution — disable "
+                        "dropout in the trunk (or set is_test)")
+
+    def _stage_io(self, ops, block):
+        """(ordered external activation reads, ordered Parameter reads,
+        set of names written) for one stage's op list."""
+        written, ext, params = set(), [], []
+        for op in ops:
+            for n in op.input_names():
+                if not n or n in written or n in ext or n in params:
+                    continue
+                if n in self._persistable:
+                    v = block.var(n)
+                    if not isinstance(v, Parameter):
+                        raise NotImplementedError(
+                            f"stage op {op.type} reads persistable "
+                            f"non-parameter {n!r}: staged trunks may only "
+                            "read activations and their own parameters")
+                    params.append(n)
+                else:
+                    ext.append(n)
+            written.update(op.output_names())
+        return ext, params, written
+
+    def _validate_stages(self, block):
+        pp = len(self._stage_ops)
+        sigs, ios = [], []
+        for sops in self._stage_ops:
+            sigs.append([
+                (op.type, _attr_sig(op.attrs),
+                 tuple(sorted((k, len(v)) for k, v in op.inputs.items())),
+                 tuple(sorted((k, len(v)) for k, v in op.outputs.items())))
+                for op in sops])
+            ios.append(self._stage_io(sops, block))
+        for s in range(1, pp):
+            if sigs[s] != sigs[0]:
+                raise ValueError(
+                    f"pipeline stage {s} is not structurally identical to "
+                    "stage 0 (op sequence/attrs differ) — spmd_pipeline "
+                    "runs ONE traced stage body with per-stage parameters, "
+                    "so every stage must build the same layer stack")
+        self._stage_params: List[List[str]] = [io[1] for io in ios]
+        for s in range(1, pp):
+            if len(self._stage_params[s]) != len(self._stage_params[0]):
+                raise ValueError("per-stage parameter counts differ")
+            for a, b in zip(self._stage_params[0], self._stage_params[s]):
+                va, vb = block.var(a), block.var(b)
+                if tuple(va.shape or ()) != tuple(vb.shape or ()):
+                    raise ValueError(
+                        f"stage param shape mismatch: {a} {va.shape} vs "
+                        f"{b} {vb.shape}")
+
+        # activation plumbing: one in, one out, chained stage to stage
+        consumed_later: Dict[int, set] = {}
+        later = {n for op in self._post_ops for n in op.input_names()}
+        later |= set(self.fetch_names)
+        for s in reversed(range(pp)):
+            consumed_later[s] = set(later)
+            later |= {n for op in self._stage_ops[s]
+                      for n in op.input_names()}
+        self._trunk_in = None
+        self._stage_out: List[str] = []
+        prev_out = None
+        for s in range(pp):
+            ext, _, written = ios[s]
+            if len(ext) != 1:
+                raise ValueError(
+                    f"stage {s} reads {len(ext)} external activations "
+                    f"({ext}): exactly one [batch, ...] activation may "
+                    "cross a stage boundary")
+            outs = sorted(written & consumed_later[s])
+            if len(outs) != 1:
+                raise ValueError(
+                    f"stage {s} emits {len(outs)} activations consumed "
+                    f"downstream ({outs}): exactly one may cross the "
+                    "boundary")
+            if s == 0:
+                self._trunk_in = ext[0]
+            elif ext[0] != prev_out:
+                raise ValueError(
+                    f"stage {s} input {ext[0]!r} is not stage {s-1}'s "
+                    f"output {prev_out!r}")
+            prev_out = outs[0]
+            self._stage_out.append(prev_out)
+        # the traced stage body (stage 0's ops) emits stage 0's boundary
+        # name; the post section consumes the LAST stage's name
+        self._trunk_out = self._stage_out[-1]
+
+    # ------------------------------------------------------------------
+    # update planning (the Program's own optimizer ops)
+    # ------------------------------------------------------------------
+    def _plan_update(self, block):
+        ops = block.ops
+        start = self._bwd_start
+        stage0 = set(self._stage_params[0])
+        stage_rest = {n for sp in self._stage_params[1:] for n in sp}
+        # values the update phase can bind: every persistable EXCEPT
+        # stage params of stages >= 1 (stored stacked under stage-0
+        # names), plus the jax.grad cotangents under canonical names
+        bindable = set(self._persistable) - stage_rest
+        self._trainable = [p.name for p in block.all_parameters()
+                           if p.trainable]
+        grad_names = {grad_var_name(n) for n in self._trainable
+                      if n not in stage_rest}
+        bindable |= grad_names
+
+        plan = []
+        produced = set(bindable)
+        self._group_opt_ops: Dict[str, object] = {}
+        for op in ops[start:]:
+            is_opt = "Param" in op.inputs and "ParamOut" in op.outputs
+            pname = op.inputs["Param"][0] if is_opt else None
+            if is_opt and pname in stage_rest:
+                # covered by the stacked run of stage-0's op; validate
+                plan.append(("skip_stage_opt", op))
+                continue
+            runnable = all((not n) or n in produced
+                           for n in op.input_names())
+            if runnable:
+                plan.append(("run", op))
+                produced.update(op.output_names())
+                if is_opt and pname in stage0:
+                    self._group_opt_ops[pname] = op
+            else:
+                # backward/grad-computation op: replaced by jax.grad
+                # (empty/@EMPTY@ slots are pruned-grad placeholders)
+                tainted_outs = [n for n in op.output_names()
+                                if GRAD_SUFFIX in n
+                                or n in ("", "@EMPTY@")]
+                if len(tainted_outs) != len(op.output_names()) or is_opt:
+                    raise NotImplementedError(
+                        f"update-section op {op.type} "
+                        f"({op.output_names()}) depends on forward "
+                        "activations or unstacked stage state — not "
+                        "supported under PipelineExecutor (grad-transform "
+                        "ops on staged params, per-param hooks)")
+                plan.append(("skip_grad", op))
+        # every stage-rest optimizer op must mirror its stage-0 twin
+        k_of = {}
+        for s, names in enumerate(self._stage_params):
+            for k, n in enumerate(names):
+                k_of[n] = k
+        sig0 = {}
+        for kind, op in plan:
+            if kind == "run" and op.inputs.get("Param", [None])[0] in stage0:
+                sig0[k_of[op.inputs["Param"][0]]] = (op.type,
+                                                     _attr_sig(op.attrs))
+        for kind, op in plan:
+            if kind != "skip_stage_opt":
+                continue
+            k = k_of[op.inputs["Param"][0]]
+            if sig0.get(k) != (op.type, _attr_sig(op.attrs)):
+                raise ValueError(
+                    f"optimizer op for staged param "
+                    f"{op.inputs['Param'][0]} differs from stage 0's "
+                    "(type/attrs) — stacked update would be wrong")
+        missing = [n for n in stage0 if n not in self._group_opt_ops]
+        if missing:
+            raise ValueError(
+                f"staged params {missing} have no optimizer op")
+        self._update_plan = plan
+        # accumulators of stage-0 opt ops: stacked like their params.
+        # slots beyond Param/Grad/LearningRate reference accumulators
+        self._stage_acc: Dict[str, List[str]] = {}
+        for pname, op0 in self._group_opt_ops.items():
+            k = k_of[pname]
+            accs = [n for slot, ns in op0.inputs.items()
+                    if slot not in ("Param", "Grad", "LearningRate")
+                    for n in ns if n in self._persistable]
+            for acc in accs:
+                per_stage = [acc]
+                for s in range(1, len(self._stage_params)):
+                    twin = next(
+                        op for kind, op in self._update_plan
+                        if kind == "skip_stage_opt"
+                        and op.inputs["Param"][0]
+                        == self._stage_params[s][k])
+                    slot = next(sl for sl, ns in op0.inputs.items()
+                                if acc in ns)
+                    per_stage.append(twin.inputs[slot][
+                        op0.inputs[slot].index(acc)])
+                self._stage_acc[acc] = per_stage
+        # beta-pow style shared accumulators must not be stage-stacked
+        # twice; sanity: an acc name appears in exactly one group
+        flat = [n for v in self._stage_acc.values() for n in v]
+        if len(flat) != len(set(flat)):
+            raise NotImplementedError(
+                "optimizer accumulators shared across staged params are "
+                "not supported")
+
+    # ------------------------------------------------------------------
+    # state placement
+    # ------------------------------------------------------------------
+    def _init_states(self, scope, shard_opt):
+        mesh, dp = self.mesh, self.mesh.shape[self.batch_axis]
+        pp_ax, dp_ax = self.stage_axis, self.batch_axis
+        stage0 = self._stage_params[0]
+        stacked_members = {n for sp in self._stage_params[1:] for n in sp}
+        for accs in self._stage_acc.values():
+            stacked_members |= set(accs[1:])
+
+        def val(n):
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"state var {n!r} not produced by the startup program")
+            return np.asarray(v)
+
+        states, shardings = {}, {}
+        self._state_map = {}
+        # stacked parameter groups + their accumulators
+        for k, p0 in enumerate(stage0):
+            stack = np.stack([val(sp[k]) for sp in self._stage_params])
+            states[p0] = stack
+            shardings[p0] = NamedSharding(mesh, P(pp_ax))
+            for s, sp in enumerate(self._stage_params):
+                self._state_map[sp[k]] = ("stacked", p0, s)
+        for acc0, names in self._stage_acc.items():
+            stack = np.stack([val(n) for n in names])
+            states[acc0] = stack
+            spec = [pp_ax] + [None] * (stack.ndim - 1)
+            if (shard_opt and stack.ndim >= 2
+                    and stack.shape[1] % dp == 0 and stack.shape[1] >= dp):
+                spec[1] = dp_ax  # ZeRO-1 on the stacked accumulator
+            shardings[acc0] = NamedSharding(mesh, P(*spec))
+            for s, n in enumerate(names):
+                self._state_map[n] = ("stacked", acc0, s)
+        # every other persistable the program touches
+        for n in sorted(self._persistable):
+            if n in states or n in stacked_members or n in self._state_map:
+                continue
+            if not scope.has_var(n) or scope.find_var(n) is None:
+                continue  # produced mid-program (e.g. aux writes only)
+            v = val(n)
+            spec = P()
+            if (shard_opt and n.endswith("_acc") and v.ndim >= 1
+                    and v.shape[0] % dp == 0 and v.shape[0] >= dp):
+                spec = P(dp_ax)
+            states[n] = v
+            shardings[n] = NamedSharding(mesh, spec)
+            self._state_map[n] = ("direct", n, None)
+        self._state_shardings = shardings
+        self._states = {n: jax.device_put(v, shardings[n])
+                        for n, v in states.items()}
+        self._data_sharding = NamedSharding(mesh, P(self.batch_axis))
+
+    # ------------------------------------------------------------------
+    # the jitted train step
+    # ------------------------------------------------------------------
+    def _make_jit_step(self):
+        mesh = self.mesh
+        stage0 = list(self._stage_params[0])
+        stage_accs = list(self._stage_acc)
+        outer_names = [n for n in self._states
+                       if n not in stage0 and n not in stage_accs]
+        pre_ops = tuple(self._pre_ops)
+        post_ops = tuple(self._post_ops)
+        s0_ops = tuple(self._stage_ops[0])
+        trunk_in, trunk_out = self._trunk_in, self._trunk_out
+        s0_out = self._stage_out[0]
+        loss_name, fetch_names = self._loss_name, self.fetch_names
+        n_micro, batch_axis, stage_axis = (self.n_micro, self.batch_axis,
+                                           self.stage_axis)
+        aux_writes = list(self._aux_writes)
+        plan = tuple(self._update_plan)
+        group_opt = dict(self._group_opt_ops)
+        persistable = set(self._persistable)
+        trainable = [n for n in self._trainable if n in self._states]
+        outer_trainable = [n for n in trainable if n not in stage0]
+
+        def stage_fn(pvals, h):
+            env = DictEnv(dict(zip(stage0, pvals)))
+            env.set(trunk_in, h)
+            ctx = ExecContext(jax.random.key(0), compiled=True)
+            for op in s0_ops:
+                run_op(ctx, op, env)
+            return env.get(s0_out)
+
+        def forward(outer_p, stack_p, rest, feeds, key):
+            env = DictEnv({**rest, **outer_p, **feeds})
+            ctx = ExecContext(key, compiled=True)
+            for op in pre_ops:
+                run_op(ctx, op, env)
+            h = env.get(trunk_in)
+            h = microbatch(h, n_micro)
+            h = spmd_pipeline(stage_fn, tuple(stack_p), h, mesh,
+                              axis=stage_axis, batch_axis=batch_axis)
+            env.set(trunk_out, unmicrobatch(h))
+            for op in post_ops:
+                run_op(ctx, op, env)
+            loss = jnp.sum(env.get(loss_name))
+            fetches = {n: env.get(n) for n in fetch_names}
+            aux_new = {n: env.d[n] for n in aux_writes if n in env.d}
+            return loss, (fetches, aux_new)
+
+        grad_fn = jax.value_and_grad(forward, argnums=(0, 1),
+                                     has_aux=True)
+
+        def step(feeds, states, key):
+            outer_p = {n: states[n] for n in outer_trainable}
+            stack_p = [states[n] for n in stage0]
+            rest = {n: v for n, v in states.items()
+                    if n not in outer_trainable and n not in stage0}
+            (loss, (fetches, aux_new)), (g_outer, g_stack) = grad_fn(
+                outer_p, stack_p, rest, feeds, key)
+
+            # --- the Program's own update ops on the computed grads ----
+            env = DictEnv({**states, **aux_new})
+            for n, g in g_outer.items():
+                env.set(grad_var_name(n), g)
+            for n, g in zip(stage0, g_stack):
+                env.set(grad_var_name(n), g)
+            ctx = ExecContext(jax.random.fold_in(key, 1), compiled=True)
+            for kind, op in plan:
+                if kind == "run":
+                    run_op(ctx, op, env)
+            # env.d already holds aux_new (merged at construction) and
+            # every update-op write; anything untouched keeps its old value
+            new_states = {n: env.d.get(n, states[n]) for n in states}
+            return fetches, loss, new_states
+
+        out_sh = {n: self._state_shardings[n] for n in self._states}
+        return jax.jit(step, out_shardings=(None, None, out_sh),
+                       donate_argnums=(1,))
+
+    def _refresh_amp(self):
+        if _amp_enabled() != self._amp_state:
+            self._jit_step = self._make_jit_step()
+            self._amp_state = _amp_enabled()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def run(self, feed: Dict, fetch_list=None, return_numpy=True):
+        self._refresh_amp()
+        fetch_names = ([v.name if isinstance(v, Variable) else str(v)
+                        for v in fetch_list]
+                       if fetch_list is not None else self.fetch_names)
+        assert fetch_names == self.fetch_names, \
+            "fetch_list must match construction-time fetch_list"
+        dp = self.mesh.shape[self.batch_axis]
+        feeds = {}
+        for n, v in feed.items():
+            v = np.asarray(v)
+            if v.shape[0] % self.n_micro:
+                raise ValueError(
+                    f"batch {v.shape[0]} not divisible by n_micro "
+                    f"{self.n_micro}")
+            if (v.shape[0] // self.n_micro) % dp:
+                raise ValueError(
+                    f"microbatch {v.shape[0] // self.n_micro} not "
+                    f"divisible by the '{self.batch_axis}' axis ({dp})")
+            feeds[n] = jax.device_put(v, self._data_sharding)
+        key = jax.random.fold_in(jax.random.key(self._seed), self._step)
+        self._step += 1
+        fetches, _loss, self._states = self._jit_step(
+            feeds, self._states, key)
+        out = [fetches[n] for n in fetch_names]
+        if return_numpy:
+            out = [np.asarray(v) for v in out]
+        return out
+
+    def state(self, name, return_numpy=True):
+        kind, store, idx = self._state_map[name]
+        v = self._states[store]
+        if kind == "stacked":
+            v = v[idx]
+        return np.asarray(v) if return_numpy else v
+
+    def compiled_collectives(self, feed: Dict) -> Dict[str, int]:
+        """Collective-op counts in the optimized HLO of the train step for
+        `feed`'s shapes (collective-permute = pipeline hops; all-reduce =
+        dp grad sums) — the communication-structure pin used by tests and
+        run_scaling --virtual."""
+        feeds = {
+            n: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                    np.asarray(v).dtype,
+                                    sharding=self._data_sharding)
+            for n, v in feed.items()
+        }
+        key = jax.random.key(self._seed)
+        txt = self._jit_step.lower(feeds, self._states, key) \
+            .compile().as_text()
+        return count_collectives(txt)
