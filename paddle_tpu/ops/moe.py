@@ -1,0 +1,44 @@
+"""Mixture-of-Experts FFN op — the DSL surface of the MoE subsystem.
+
+No reference analogue (SURVEY.md §2.5: expert parallelism absent there);
+the op lowers to the mesh-free GShard math in parallel/moe.py
+(`moe_dense`: top-1/top-2 gating, static capacity, one-hot
+dispatch/combine einsums, batched expert matmuls).  Under
+ParallelExecutor the expert dim shards with
+`param_shardings={"<w_in name>": P("ep"), ...}` and the XLA partitioner
+inserts the ep collectives; the shard_map / all_to_all forms stay
+available for raw-JAX use (parallel.moe_ffn / moe_ffn_a2a).
+
+The auxiliary load-balance loss is a real output: add
+`aux_weight * AuxLoss` to the training loss and the router trains
+toward balance (pinned in tests/test_moe.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.execution import data_of, one
+from ..core.registry import register_op
+
+
+@register_op("moe_ffn",
+             inputs=("X", "GateW", "WIn", "WOut"),
+             outputs=("Out", "AuxLoss"),
+             attrs={"top_k": 1, "capacity_factor": 1.25},
+             diff_inputs=("X", "GateW", "WIn", "WOut"),
+             diff_outputs=("Out", "AuxLoss"))
+def moe_ffn(ctx, ins, attrs):
+    from ..parallel.moe import moe_dense
+
+    xv = one(ins, "X")
+    x = data_of(xv)
+    gate_w = data_of(one(ins, "GateW"))
+    w_in = data_of(one(ins, "WIn"))
+    w_out = data_of(one(ins, "WOut"))
+    lead = x.shape[:-1]
+    flat = x.reshape(-1, x.shape[-1])
+    y, aux = moe_dense(flat, gate_w, w_in, w_out,
+                       capacity_factor=float(attrs["capacity_factor"]),
+                       top_k=int(attrs["top_k"]))
+    return {"Out": y.reshape(*lead, y.shape[-1]),
+            "AuxLoss": jnp.reshape(aux, (1,))}

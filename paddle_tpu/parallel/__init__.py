@@ -21,8 +21,18 @@ from .mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
-from .composite import collective_counts, make_composite_step  # noqa: F401
-from .moe import moe_ffn, moe_gate  # noqa: F401
+from .composite import (  # noqa: F401
+    collective_counts,
+    make_composite_step,
+    make_transformer_composite_step,
+)
+from .moe import (  # noqa: F401
+    load_balance,
+    moe_dense,
+    moe_ffn,
+    moe_ffn_a2a,
+    moe_gate,
+)
 from .pipeline import (  # noqa: F401
     microbatch,
     spmd_pipeline,

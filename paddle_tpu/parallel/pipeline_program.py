@@ -449,9 +449,6 @@ class PipelineExecutor:
     def _make_jit_step(self):
         mesh = self.mesh
         stage0 = list(self._stage_params[0])
-        stage_accs = list(self._stage_acc)
-        outer_names = [n for n in self._states
-                       if n not in stage0 and n not in stage_accs]
         pre_ops = tuple(self._pre_ops)
         post_ops = tuple(self._post_ops)
         s0_ops = tuple(self._stage_ops[0])
@@ -462,8 +459,6 @@ class PipelineExecutor:
                                            self.stage_axis)
         aux_writes = list(self._aux_writes)
         plan = tuple(self._update_plan)
-        group_opt = dict(self._group_opt_ops)
-        persistable = set(self._persistable)
         trainable = [n for n in self._trainable if n in self._states]
         outer_trainable = [n for n in trainable if n not in stage0]
 

@@ -285,8 +285,10 @@ def test_pipeline_strategy_agrees():
     results = {
         "serial": _train_trunk_serial(batches),
         "dp2xpp4": _train_trunk_pp(batches, {"dp": 2, "pp": 4}),
-        "pp4_zero1": _train_trunk_pp(batches, {"dp": 1, "pp": 4},
-                                     shard_opt=True),
+        # dp=2 so ZeRO-1 accumulator sharding is actually exercised
+        # (a size-1 dp axis would make every sharding guard vacuous)
+        "dp2xpp4_zero1": _train_trunk_pp(batches, {"dp": 2, "pp": 4},
+                                         shard_opt=True),
     }
     ref = results["serial"]
     for strategy, params in results.items():
