@@ -370,7 +370,6 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
     into `dirname/checkpoint_<uuid>/` with a {uuid, md5, timestamp,
     trainer_args} meta record, atomically publish it as latest, and GC old
     snapshots beyond `max_keep`.  Returns the checkpoint uuid."""
-    import time
     import uuid as uuid_mod
 
     if max_keep < 0:
@@ -379,6 +378,18 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
     cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
     os.makedirs(cp_dir, exist_ok=True)
     save_persistables(executor, cp_dir, main_program, scope=scope)
+    publish_checkpoint(dirname, cp_uuid, cp_dir, trainer_args, max_keep)
+    return cp_uuid
+
+
+def publish_checkpoint(dirname, cp_uuid, cp_dir, trainer_args=None,
+                       max_keep: int = 3) -> dict:
+    """Finalize a snapshot directory: write the {uuid, md5, timestamp,
+    trainer_args} meta record, atomically publish it as latest, GC old
+    snapshots.  Shared by the serial save_checkpoint and the sharded
+    ParallelExecutor/PipelineExecutor checkpoints."""
+    import time
+
     meta = {
         "uuid": cp_uuid,
         "md5": _md5_of_dir(cp_dir),
@@ -393,7 +404,7 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
         f.write(cp_uuid)
     os.replace(latest_tmp, os.path.join(dirname, LATEST_FILENAME))
     _gc_checkpoints(dirname, keep=max_keep, always_keep={cp_uuid})
-    return cp_uuid
+    return meta
 
 
 def _checkpoints_by_time(dirname):

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.executor import CPUPlace, Executor, program_to_fn
 from ..core.framework import Variable, default_startup_program
 from ..core.scope import Scope
+from .checkpoint import ShardedCheckpointMixin
 from .mesh import make_mesh
 
 
@@ -43,7 +44,7 @@ __all__ = ["ParallelExecutor", "DistributeTranspiler",
            "SimpleDistributeTranspiler"]
 
 
-class ParallelExecutor:
+class ParallelExecutor(ShardedCheckpointMixin):
     def __init__(
         self,
         program,

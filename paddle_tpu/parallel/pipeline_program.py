@@ -47,6 +47,7 @@ from ..core.framework import (GRAD_SUFFIX, Parameter, Variable,
                               default_startup_program, grad_var_name)
 from ..core.executor import CPUPlace, Executor
 from ..core.scope import Scope
+from .checkpoint import ShardedCheckpointMixin
 from .mesh import count_collectives, make_mesh
 from .pipeline import microbatch, spmd_pipeline, unmicrobatch
 
@@ -73,7 +74,7 @@ def _amp_enabled() -> bool:
     return is_bf16_enabled()
 
 
-class PipelineExecutor:
+class PipelineExecutor(ShardedCheckpointMixin):
     def __init__(
         self,
         program,

@@ -60,3 +60,23 @@ def test_two_process_spmd_psum_and_dp_step():
     joined = "\n".join(outs)
     assert "psum across 2 processes / 4 devices OK" in joined
     assert joined.count("matches the full-batch numpy reference OK") == 2
+
+
+@pytest.mark.slow
+def test_structure_scaling_invariants_16():
+    """benchmark/run_structure.py's per-axis collective invariants hold
+    on a 16-device virtual mesh (the 32/64 sweep is published in
+    benchmark/README.md; this pins the tool + invariants in CI)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmark", "run_structure.py"),
+         "--single", "16"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert p.returncode == 0, p.stderr[-2000:]

