@@ -196,6 +196,15 @@ def main():
             out["valid"] = False
             out.setdefault("invalid_reason",
                            "convergence target not reached in budget")
+    # BENCH_BOOK=1: run the 8-model book acceptance matrix in the same
+    # numeric mode (benchmark/run_book.py; ~2 min incl. compiles).  The
+    # matrix is reported, not validity-gating — the headline's validity
+    # stays with its own roofline + convergence gates.  The committed
+    # BOOK_MATRIX_r04.json is the published artifact.
+    if os.environ.get("BENCH_BOOK", "0").lower() in ("1", "true", "yes",
+                                                     "on"):
+        from run_book import run_matrix
+        out["book_matrix"] = run_matrix()
     print(json.dumps(out))
     if not out["valid"]:
         sys.exit(1)

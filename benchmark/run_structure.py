@@ -51,6 +51,10 @@ def _measure(n: int) -> dict:
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the env var alone is NOT enough: the TPU-tunnel site hook
+        # (axon) force-sets jax_platforms at interpreter boot, so the
+        # parent's "run me on cpu" request must be pinned via config
+        # (same dance as tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
@@ -137,7 +141,6 @@ def _measure(n: int) -> dict:
         y, aux = parallel.moe_ffn_a2a(x, gw, wi, wo, ep_mesh, top_k=2)
         return jnp.mean(y * y) + 0.01 * aux
 
-    import functools
     t0 = time.perf_counter()
     g = jax.jit(jax.grad(moe_loss, argnums=(1, 2, 3)))
     txt = g.lower(x, gw, wi, wo).compile().as_text()

@@ -246,11 +246,27 @@ def generic_grad_lower(ctx, ins, attrs, fwd_info):
         return res
 
     primals = {s: fwd_ins[s] for s in diff_slots}
-    _, vjp_fn = jax.vjp(fwd_fn, primals)
+    out_primals, vjp_fn = jax.vjp(fwd_fn, primals)
     cotangents = {}
     for s in out_slots:
         v = ins[s + GRAD]
         cotangents[s] = list(v) if isinstance(v, (list, tuple)) else [v]
+    # jax.vjp demands cotangent avals match the primal outputs exactly;
+    # under amp a downstream grad op may hand back a bf16 cotangent for
+    # an f32 forward output (or vice versa) — cast leaf-wise to match
+    def _cast_like(c, p):
+        pd, cd = data_of(p), data_of(c)
+        if (pd is None or cd is None or not hasattr(cd, "dtype")
+                or cd.dtype == pd.dtype
+                or not jnp.issubdtype(pd.dtype, jnp.floating)):
+            return c
+        if isinstance(c, LoDTensor):
+            return LoDTensor(cd.astype(pd.dtype), c.lod)
+        return cd.astype(pd.dtype)
+
+    for s in out_slots:
+        cotangents[s] = [_cast_like(c, p)
+                         for c, p in zip(cotangents[s], out_primals[s])]
     (gin,) = vjp_fn(cotangents)
     return {s + GRAD: gin[s] for s in diff_slots}
 

@@ -172,14 +172,28 @@ class LayerHelper:
     def append_op(self, *a, **kw):
         return self.block.append_op(*a, **kw)
 
+    # mixed float widths are legal under amp (an embedding path stays
+    # f32 while a matmul path emits bf16); params follow the WIDEST
+    # float so master weights stay f32 — genuinely different kinds
+    # (int vs float) remain an error
+    _FLOAT_WIDTH = {"float64": 4, "float32": 3, "bfloat16": 2,
+                    "float16": 1}
+
     def input_dtype(self, name="input"):
         inputs = self.multiple_input(name)
         dtype = None
         for v in inputs:
-            if dtype is None:
+            if dtype is None or dtype == v.dtype:
                 dtype = v.dtype
-            elif dtype != v.dtype:
-                raise ValueError("all inputs must have the same dtype")
+            elif (str(dtype) in self._FLOAT_WIDTH
+                  and str(v.dtype) in self._FLOAT_WIDTH):
+                if (self._FLOAT_WIDTH[str(v.dtype)]
+                        > self._FLOAT_WIDTH[str(dtype)]):
+                    dtype = v.dtype
+            else:
+                raise ValueError(
+                    f"all inputs must have the same dtype kind "
+                    f"(got {dtype} and {v.dtype})")
         return dtype
 
     def append_bias_op(self, input_var, dim_start=1, dim_end=None):

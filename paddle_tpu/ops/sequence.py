@@ -84,7 +84,35 @@ def sequence_pool(ctx, ins, attrs):
         out = jax.ops.segment_sum(x, seg, nseq) / jnp.sqrt(
             jnp.maximum(lens, 1))
     elif pt == "MAX":
-        out = jax.ops.segment_max(x, seg, nseq)
+        # NOT segment_max: its VJP routes gradient by float equality
+        # (x == max[seg]), and under whole-program XLA:TPU fusion the two
+        # sides can be recomputed at different effective precisions —
+        # false ties then scatter the cotangent into MANY rows (measured:
+        # grads inflated ~100x, an upstream LSTM never learns).  Padded
+        # argmax + take_along_axis keeps the backward a pure integer
+        # gather/scatter, immune to recomputation precision.
+        idx, mask = lod_to_padded_index(lod)
+        feat_dims = x.ndim - 1
+        neg = jnp.asarray(
+            jnp.finfo(x.dtype).min
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min, x.dtype)
+        seq_lens_np = np.asarray(_seq_lens(lod))
+        if idx.shape[1] == 0:  # every sequence empty
+            out = jnp.full((nseq,) + x.shape[1:], neg, x.dtype)
+        else:
+            xp = x[jnp.asarray(idx)]                  # [B, T, ...]
+            m = jnp.asarray(mask).reshape(mask.shape + (1,) * feat_dims)
+            am = jax.lax.stop_gradient(
+                jnp.argmax(jnp.where(m > 0, xp, neg), axis=1))  # [B, ...]
+            out = jnp.take_along_axis(xp, am[:, None], axis=1)[:, 0]
+            if (seq_lens_np == 0).any():
+                # empty sequences: the pad gather aliases row 0 of the
+                # packed tensor — mask to the max identity (segment_max
+                # semantics); where() keeps their gradient exactly zero
+                empty = jnp.asarray(seq_lens_np == 0).reshape(
+                    (-1,) + (1,) * feat_dims)
+                out = jnp.where(empty, neg, out)
     elif pt == "LAST":
         out = x[jnp.asarray([o - 1 for o in lod[1:]])]
     elif pt == "FIRST":
@@ -104,7 +132,10 @@ def sequence_softmax(ctx, ins, attrs):
     x = xv.data.reshape(-1)
     nseq = len(lod) - 1
     seg = jnp.asarray(_segment_ids(lod))
-    smax = jax.ops.segment_max(x, seg, nseq)
+    # stop_gradient: softmax is shift-invariant so the max's gradient
+    # cancels exactly — and segment_max's equality-based VJP is unsafe
+    # under TPU fusion (see sequence_pool MAX above)
+    smax = jax.lax.stop_gradient(jax.ops.segment_max(x, seg, nseq))
     e = jnp.exp(x - smax[seg])
     ssum = jax.ops.segment_sum(e, seg, nseq)
     return {"Out": LoDTensor((e / ssum[seg]).reshape(xv.data.shape),

@@ -69,6 +69,18 @@ class ShardedCheckpointMixin:
         if cp_dir is None:
             return None
         path = os.path.join(cp_dir, STATES_FILENAME)
+        if not os.path.exists(path):
+            # the dir layout is shared with the serial io.save_checkpoint
+            # protocol, so the latest valid snapshot may be a serial one
+            # (persistables files, no sharded npz) — honor the documented
+            # None-or-RuntimeError contract instead of leaking a raw
+            # FileNotFoundError
+            raise RuntimeError(
+                f"latest checkpoint {meta['uuid']} under {dirname} has no "
+                f"{STATES_FILENAME} — it was saved by the serial "
+                "Executor path; restore it with io.restore_checkpoint, "
+                "or point ParallelExecutor at a directory of sharded "
+                "snapshots")
         with np.load(path) as data:
             missing = sorted(set(self._states) - set(data.files))
             if missing:

@@ -240,3 +240,68 @@ def test_sequence_mask():
     np.testing.assert_array_equal(
         np.asarray(got),
         [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1], [0, 0, 0, 0, 0]])
+
+
+def test_sequence_pool_max_grad_single_route_on_ties():
+    """Max-pool backward must route each feature's cotangent to exactly
+    ONE row even under exact ties — the reference kernel records a single
+    MaxIndex per output (sequence_pooling.cc).  This pins the argmax+
+    gather lowering: the previous segment_max VJP split ties by float
+    equality (x == max), which under whole-program XLA:TPU fusion also
+    produced false ties from precision-divergent recomputation and
+    inflated upstream grads ~100x (an LSTM upstream never learned)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        x.stop_gradient = False
+        pooled = fluid.layers.sequence_pool(input=x, pool_type="max")
+        loss = fluid.layers.mean(pooled)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # two sequences; the first has an exact tie in every feature column
+    xd = np.array([[1.0, 2.0, 3.0],
+                   [1.0, 2.0, 3.0],
+                   [5.0, 0.0, 1.0],
+                   [4.0, 6.0, 1.0]], np.float32)
+    xv = fluid.create_lod_tensor(xd, [[2, 2]])
+    g, = exe.run(main, feed={"x": xv}, fetch_list=["x@GRAD"])
+    g = np.asarray(getattr(g, "data", g))
+    # every pooled feature contributes 1/6 (mean of 2x3) to exactly one row
+    np.testing.assert_allclose(g.sum(axis=0), np.full(3, 2 / 6.0),
+                               rtol=1e-6)
+    nonzero_per_col = (np.abs(g) > 0).sum(axis=0)
+    np.testing.assert_array_equal(nonzero_per_col, [2, 2, 2])
+
+
+def test_sequence_pool_max_empty_sequence():
+    """Empty sequences yield the max identity (dtype-min, segment_max
+    semantics) with exactly zero gradient — the pad gather must not
+    alias another sequence's rows (code-review r4 finding)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        x.stop_gradient = False
+        pooled = fluid.layers.sequence_pool(input=x, pool_type="max")
+        loss = fluid.layers.mean(pooled)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xd = np.array([[1.0, 5.0], [3.0, 2.0]], np.float32)
+    xv = fluid.create_lod_tensor(xd, [[0, 2]])  # first sequence EMPTY
+    out, g = exe.run(main, feed={"x": xv},
+                     fetch_list=[pooled.name, "x@GRAD"])
+    out = np.asarray(getattr(out, "data", out))
+    g = np.asarray(getattr(g, "data", g))
+    fmin = np.finfo(np.float32).min
+    np.testing.assert_allclose(out[0], [fmin, fmin])
+    np.testing.assert_allclose(out[1], [3.0, 5.0])
+    # row 0 of x belongs to sequence 2 only; the empty sequence must not
+    # have routed any cotangent into it beyond its own max hits
+    np.testing.assert_allclose(g, [[0.0, 0.25], [0.25, 0.0]])
