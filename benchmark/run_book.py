@@ -52,8 +52,14 @@ def _train_loop(exe, scope, main, startup, batches, fetch_list, check,
     t_c = time.perf_counter()
     seen = set()
     for feed in batches:  # one compile per distinct feed shape
-        key = tuple(sorted((k, getattr(v, "data", v).shape)
-                           for k, v in feed.items()))
+        # the Executor's compile cache keys on the LoD too (aux_data in
+        # the LoDTensor pytree) — two ragged batches with colliding flat
+        # shapes but different LoD are different executables, and an
+        # unprecompiled one would bill its tunnel compile to the clock
+        key = tuple(sorted(
+            (k, getattr(v, "data", v).shape,
+             tuple(map(tuple, getattr(v, "lod", ()) or ())))
+            for k, v in feed.items()))
         if key not in seen:
             seen.add(key)
             exe.run(main, feed=feed, fetch_list=fetch_list, scope=scope)
@@ -498,6 +504,11 @@ RUNNERS = [run_fit_a_line, run_recognize_digits, run_image_classification,
 def run_matrix():
     if AMP:
         fluid.amp.enable_bf16()
+    else:
+        # the host process (e.g. bench.py with BENCH_BOOK=1) may have
+        # amp on from its own headline — the reported "amp" field must
+        # match the mode the matrix actually ran in
+        fluid.amp.disable_bf16()
     results = []
     for fn in RUNNERS:
         res = fn()

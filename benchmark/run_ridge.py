@@ -39,7 +39,8 @@ import numpy as np
 from harness import bound_fields, gated_time_program
 
 
-def build_lm(batch, seq, vocab, d_model, n_heads, n_layers):
+def build_lm(batch, seq, vocab, d_model, n_heads, n_layers,
+             optimizer="momentum"):
     import paddle_tpu as fluid
     from paddle_tpu.models.transformer import transformer_lm
 
@@ -57,7 +58,14 @@ def build_lm(batch, seq, vocab, d_model, n_heads, n_layers):
         # cotangent never round-trip HBM (see run_seq2seq.py)
         cost = fluid.layers.softmax_with_cross_entropy(logits2d, lbl2d)
         avg = fluid.layers.mean(cost)
-        fluid.Adam(learning_rate=1e-4).minimize(avg)
+        if optimizer == "adam":
+            fluid.Adam(learning_rate=1e-4).minimize(avg)
+        else:
+            # momentum (the ResNet headline's optimizer): 8 B/param of
+            # state vs Adam's 12 — at ridge-scale P the Adam carry
+            # double-buffers past HBM, and its extra traffic is pure
+            # denominator for the ai the row exists to demonstrate
+            fluid.Momentum(learning_rate=0.01, momentum=0.9).minimize(avg)
     return main, startup, avg
 
 
@@ -71,12 +79,20 @@ def param_count(vocab, d_model, n_layers, seq):
     return n_layers * per_block + emb + out + pos
 
 
-def run_one(batch, seq, vocab, d_model, n_heads, n_layers, iters):
+def run_one(batch, seq, vocab, d_model, n_heads, n_layers, iters,
+            force_flash=True, optimizer="momentum"):
     import paddle_tpu as fluid
+    from paddle_tpu.core.flags import set_flags
 
     fluid.amp.enable_bf16()
+    if force_flash:
+        # below the kernel's isolated-attention crossover (~2k) the XLA
+        # composition materializes scores+probs f32 for backward — at
+        # ridge-scale d_model that dominates HBM bytes AND memory, so
+        # the training bench always takes the Pallas path
+        set_flags({"flash_min_seq_k": 0})
     main, startup, avg = build_lm(batch, seq, vocab, d_model, n_heads,
-                                  n_layers)
+                                  n_layers, optimizer=optimizer)
     r = np.random.RandomState(0)
     feeds = {
         "ids": r.randint(0, vocab, (batch, seq)).astype(np.int32),
@@ -92,6 +108,7 @@ def run_one(batch, seq, vocab, d_model, n_heads, n_layers, iters):
         "model": "transformer_lm_ridge",
         "d_model": d_model, "n_layers": n_layers, "n_heads": n_heads,
         "seq": seq, "batch": batch, "vocab": vocab,
+        "optimizer": optimizer,
         "params_analytic": p,
         "ms_per_step": round(ms, 2),
         "tokens_per_sec": round(tokens / ms * 1000, 1),
@@ -123,9 +140,16 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=30000)
     ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--optimizer", default="momentum",
+                    choices=["momentum", "adam"])
+    ap.add_argument("--no-force-flash", action="store_true",
+                    help="keep the kernel's own crossover policy (the "
+                         "score-materializing XLA path below seq 2k) — "
+                         "for measuring the delta the forced kernel buys")
     a = ap.parse_args()
     run_one(a.batch, a.seq, a.vocab, a.d_model, a.n_heads, a.n_layers,
-            a.iters)
+            a.iters, force_flash=not a.no_force_flash,
+            optimizer=a.optimizer)
 
 
 if __name__ == "__main__":

@@ -409,6 +409,7 @@ class Executor:
             fp, "seg", seg_idx,
             tuple((n, _aval_key(v)) for n, v in sorted(in_vals.items())),
             get_flag("amp_bf16"),  # amp changes traced compute dtypes
+            get_flag("flash_min_seq_k"),  # changes the traced attn path
         )
         fn = self._cache.get(cache_key)
         if fn is None:
@@ -496,6 +497,7 @@ class Executor:
             tuple(fetch_names),
             str(device),
             get_flag("amp_bf16"),  # amp changes traced compute dtypes
+            get_flag("flash_min_seq_k"),  # changes the traced attn path
         )
         fn = self._cache.get(cache_key)
         if fn is None:

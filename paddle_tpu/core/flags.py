@@ -57,3 +57,13 @@ define_flag("amp_bf16", False,
             "mixed precision: whitelisted MXU ops (mul/matmul/conv) cast "
             "float32 operands to bfloat16; optimizer ops keep float32 "
             "master params (dtype promotion upcasts bf16 grads)")
+define_flag("flash_min_seq_k", -1,
+            "override the flash-attention Pallas/XLA crossover for ops "
+            "that did not set min_seq_k explicitly: -1 = kernel policy "
+            "default (~2k), 0 = always use the Pallas kernel.  Below the "
+            "crossover the XLA composition is faster for ISOLATED "
+            "attention, but in a full training step it materializes "
+            "scores+probs (f32 after the softmax upcast) for backward — "
+            "at large d_model that dominates HBM traffic and memory, so "
+            "training benches force the kernel (run_ridge.py).  Read at "
+            "TRACE time: Executor caches key on it like amp_bf16")

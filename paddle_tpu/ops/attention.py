@@ -28,6 +28,11 @@ def flash_attention_op(ctx, ins, attrs):
     scale = None if attrs.get("default_scale", True) else attrs["scale"]
     kw = {}
     msk = int(attrs.get("min_seq_k", -1))
+    if msk < 0:
+        # per-op attr unset: the process-wide flag may override the
+        # kernel's crossover policy (see core/flags.py flash_min_seq_k)
+        from ..core.flags import get_flag
+        msk = int(get_flag("flash_min_seq_k"))
     if msk >= 0:
         kw["min_seq_k"] = msk
     out = _flash(q, k, v, causal=bool(attrs.get("causal", False)),
