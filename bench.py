@@ -196,13 +196,16 @@ def main():
             out["valid"] = False
             out.setdefault("invalid_reason",
                            "convergence target not reached in budget")
-    # BENCH_BOOK=1: run the 8-model book acceptance matrix in the same
-    # numeric mode (benchmark/run_book.py; ~2 min incl. compiles).  The
-    # matrix is reported, not validity-gating — the headline's validity
-    # stays with its own roofline + convergence gates.  The committed
-    # BOOK_MATRIX_r04.json is the published artifact.
-    if os.environ.get("BENCH_BOOK", "0").lower() in ("1", "true", "yes",
+    # book acceptance matrix (benchmark/run_book.py): the 8 reference
+    # book models trained to their thresholds in this same numeric mode
+    # (~2 min incl. compiles; measured reach times are all <= 21 s, the
+    # 45 s/model cap is 2x margin).  Reported, not validity-gating —
+    # the headline's validity stays with its own roofline + convergence
+    # gates.  BENCH_BOOK=0 skips; BOOK_MATRIX_r04.json is the committed
+    # reference artifact.
+    if os.environ.get("BENCH_BOOK", "1").lower() in ("1", "true", "yes",
                                                      "on"):
+        os.environ.setdefault("BOOK_SECONDS", "45")
         from run_book import run_matrix
         out["book_matrix"] = run_matrix()
     print(json.dumps(out))

@@ -85,12 +85,12 @@ def run_one(batch, seq, vocab, d_model, n_heads, n_layers, iters,
     from paddle_tpu.core.flags import set_flags
 
     fluid.amp.enable_bf16()
-    if force_flash:
-        # below the kernel's isolated-attention crossover (~2k) the XLA
-        # composition materializes scores+probs f32 for backward — at
-        # ridge-scale d_model that dominates HBM bytes AND memory, so
-        # the training bench always takes the Pallas path
-        set_flags({"flash_min_seq_k": 0})
+    # set the flag BOTH ways: the no-force path must measure the kernel's
+    # own crossover policy even after a forced run in the same process
+    set_flags({"flash_min_seq_k": 0 if force_flash else -1})
+    # (force: below the kernel's isolated-attention crossover (~2k) the
+    # XLA composition materializes scores+probs f32 for backward — at
+    # ridge-scale d_model that dominates HBM bytes AND memory)
     main, startup, avg = build_lm(batch, seq, vocab, d_model, n_heads,
                                   n_layers, optimizer=optimizer)
     r = np.random.RandomState(0)

@@ -433,8 +433,13 @@ def roi_pool(ctx, ins, attrs):
     masked = jnp.where(
         mask_h[:, None, :, None, :, None] & mask_w[:, None, None, :, None, :],
         feats[:, :, None, None, :, :], -jnp.inf)        # [R,C,ph,pw,H,W]
-    out = jnp.max(masked.reshape(R, C, ph, pw, H * W), axis=-1)
-    arg = jnp.argmax(masked.reshape(R, C, ph, pw, H * W), axis=-1)
+    masked_r = masked.reshape(R, C, ph, pw, H * W)
+    # route the max through the Argmax indices the op already computes
+    # (reference roi_pool backward does exactly this, roi_pool_op.cu) —
+    # index routing is also immune to the TPU fusion false-tie hazard
+    # of equality-based max VJPs (see ops/reduce.py)
+    arg = jax.lax.stop_gradient(jnp.argmax(masked_r, axis=-1))
+    out = jnp.take_along_axis(masked_r, arg[..., None], axis=-1)[..., 0]
     out = jnp.where(jnp.isfinite(out), out, 0.0)
     return {"Out": out, "Argmax": arg.astype(jnp.int64)}
 

@@ -5,10 +5,46 @@ shape {1}), reduce_op.cc (dim/keep_dim/reduce_all attrs), cum_op.h.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.execution import data_of, one
 from ..core.registry import register_op
+
+
+def _index_routed_extreme(arg_fn):
+    """Max/min reduction whose VJP routes the cotangent by ARGMAX INDEX
+    (gather), not by float equality.  jnp.max's VJP tests
+    `x == broadcast(max)`, and under whole-program XLA:TPU fusion the two
+    sides can recompute at different effective precisions — false ties
+    then duplicate the cotangent into many elements (the sequence_pool
+    MAX bug, see ops/sequence.py).  Also matches the reference kernels'
+    single-index tie routing (reduce_op.h keeps one position).
+    Returns fn(x, axis=axes_tuple_or_None, keepdims=bool)."""
+
+    def reduce(x, axis=None, keepdims=False):
+        if axis is None:
+            flat = x.reshape(-1)
+            i = jax.lax.stop_gradient(arg_fn(flat))
+            out = flat[i]
+            return out.reshape((1,) * x.ndim) if keepdims else out
+        axes = sorted(a if a >= 0 else a + x.ndim for a in axis)
+        keep = [a for a in range(x.ndim) if a not in axes]
+        xt = jnp.transpose(x, keep + axes)
+        kshape = xt.shape[:len(keep)]
+        xt = xt.reshape(kshape + (-1,))
+        i = jax.lax.stop_gradient(arg_fn(xt, axis=-1))
+        out = jnp.take_along_axis(xt, i[..., None], axis=-1)[..., 0]
+        if keepdims:
+            for a in axes:
+                out = jnp.expand_dims(out, a)
+        return out
+
+    return reduce
+
+
+_max_by_index = _index_routed_extreme(jnp.argmax)
+_min_by_index = _index_routed_extreme(jnp.argmin)
 
 
 @register_op("mean", inputs=("X",), outputs=("Out",))
@@ -41,8 +77,8 @@ def _make_reduce(name, fn):
 
 _make_reduce("reduce_sum", jnp.sum)
 _make_reduce("reduce_mean", jnp.mean)
-_make_reduce("reduce_max", jnp.max)
-_make_reduce("reduce_min", jnp.min)
+_make_reduce("reduce_max", _max_by_index)
+_make_reduce("reduce_min", _min_by_index)
 _make_reduce("reduce_prod", jnp.prod)
 
 
@@ -109,4 +145,6 @@ def maxout(ctx, ins, attrs):
     x = data_of(one(ins, "X"))
     n, c, h, w = x.shape
     g = attrs["groups"]
-    return {"Out": jnp.max(x.reshape(n, c // g, g, h, w), axis=2)}
+    # index-routed max: fusion-safe VJP (see _index_routed_extreme)
+    return {"Out": _max_by_index(x.reshape(n, c // g, g, h, w),
+                                 axis=(2,))}

@@ -40,6 +40,13 @@ def _amp_enabled() -> bool:
     from ..amp import is_bf16_enabled
     return is_bf16_enabled()
 
+
+def _trace_flags() -> tuple:
+    """Snapshot of every flag read at TRACE time by op lowerings; a jit
+    built under one snapshot must not serve another."""
+    from ..core.flags import get_flag
+    return (_amp_enabled(), get_flag("flash_min_seq_k"))
+
 __all__ = ["ParallelExecutor", "DistributeTranspiler",
            "SimpleDistributeTranspiler"]
 
@@ -106,7 +113,7 @@ class ParallelExecutor(ShardedCheckpointMixin):
 
         self._step_fn = step
         self._jit_step = self._make_jit_step()
-        self._amp_state = _amp_enabled()
+        self._trace_flags_state = _trace_flags()
 
     def _make_jit_step(self):
         return jax.jit(
@@ -115,13 +122,14 @@ class ParallelExecutor(ShardedCheckpointMixin):
             donate_argnums=(1,),
         )
 
-    def _refresh_amp(self):
-        # the amp flag is read at TRACE time inside op lowerings; identical
-        # input avals would silently reuse an executable traced under the
-        # old flag state, so toggling amp gets a fresh jit cache
-        if _amp_enabled() != self._amp_state:
+    def _refresh_trace_flags(self):
+        # trace-time flags (amp_bf16, flash_min_seq_k) are read inside op
+        # lowerings; identical input avals would silently reuse an
+        # executable traced under the old flag state, so any flip gets a
+        # fresh jit cache (serial Executor: same flags in its cache keys)
+        if _trace_flags() != self._trace_flags_state:
             self._jit_step = self._make_jit_step()
-            self._amp_state = _amp_enabled()
+            self._trace_flags_state = _trace_flags()
 
     # -- sharding policy -----------------------------------------------------
     def _spec_for(self, name, val, param_names, param_shardings,
@@ -149,7 +157,7 @@ class ParallelExecutor(ShardedCheckpointMixin):
 
     # -- execution -----------------------------------------------------------
     def run(self, feed: Dict, fetch_list=None, return_numpy=True):
-        self._refresh_amp()
+        self._refresh_trace_flags()
         fetch_names = ([v.name if isinstance(v, Variable) else str(v)
                         for v in fetch_list]
                        if fetch_list is not None else self.fetch_names)

@@ -74,6 +74,11 @@ def _amp_enabled() -> bool:
     return is_bf16_enabled()
 
 
+def _trace_flags() -> tuple:
+    from .executor import _trace_flags as _tf
+    return _tf()
+
+
 class PipelineExecutor(ShardedCheckpointMixin):
     def __init__(
         self,
@@ -116,7 +121,7 @@ class PipelineExecutor(ShardedCheckpointMixin):
         self._init_states(scope, shard_optimizer_states)
 
         self._jit_step = self._make_jit_step()
-        self._amp_state = _amp_enabled()
+        self._trace_flags_state = _trace_flags()
 
     # ------------------------------------------------------------------
     # program partitioning
@@ -518,16 +523,18 @@ class PipelineExecutor(ShardedCheckpointMixin):
         return jax.jit(step, out_shardings=(None, None, out_sh),
                        donate_argnums=(1,))
 
-    def _refresh_amp(self):
-        if _amp_enabled() != self._amp_state:
+    def _refresh_trace_flags(self):
+        # see parallel/executor.py:_refresh_trace_flags — amp_bf16 and
+        # flash_min_seq_k are read at trace time
+        if _trace_flags() != self._trace_flags_state:
             self._jit_step = self._make_jit_step()
-            self._amp_state = _amp_enabled()
+            self._trace_flags_state = _trace_flags()
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def run(self, feed: Dict, fetch_list=None, return_numpy=True):
-        self._refresh_amp()
+        self._refresh_trace_flags()
         fetch_names = ([v.name if isinstance(v, Variable) else str(v)
                         for v in fetch_list]
                        if fetch_list is not None else self.fetch_names)

@@ -386,3 +386,28 @@ class TestBatchNormLargeMeanF32(OpTest):
 
     def test_output(self):
         self.check_output(atol=5e-3)
+
+
+def test_reduce_max_grad_single_route_on_ties():
+    """reduce_max/min backward routes each output's cotangent to exactly
+    one input element even under exact ties (index routing, not the
+    float-equality VJP that duplicates under TPU fusion — see
+    ops/reduce.py _index_routed_extreme and the sequence_pool MAX bug)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        m = fluid.layers.reduce_max(x, dim=1)
+        loss = fluid.layers.mean(m)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xd = np.array([[2.0, 2.0, 1.0],
+                   [0.0, 3.0, 3.0]], np.float32)
+    g, = exe.run(main, feed={"x": xd}, fetch_list=["x@GRAD"])
+    g = np.asarray(g)
+    # one nonzero per row, each worth 1/2 (mean over 2 rows)
+    np.testing.assert_array_equal((np.abs(g) > 0).sum(axis=1), [1, 1])
+    np.testing.assert_allclose(g.sum(axis=1), [0.5, 0.5])

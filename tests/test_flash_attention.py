@@ -136,3 +136,38 @@ def test_block_shrink_for_unaligned_seqs():
     assert _largest_tile(640, 512) == 128
     assert _largest_tile(2000, 1024) == 0  # not 128-aligned: no tile
     assert _largest_tile(96, 512) == 0
+
+
+def test_flash_min_seq_k_flag_rekeys_executor_cache():
+    """flash_min_seq_k is read at TRACE time (ops/attention.py), so the
+    Executor compile cache must key on it — flipping the flag mid-process
+    must produce a fresh executable, not replay the old trace."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.flags import get_flag, set_flags
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data(name="q", shape=[16, 2, 8], dtype="float32")
+        out = fluid.layers.flash_attention(q, q, q, causal=True)
+        loss = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"q": np.random.RandomState(0).randn(2, 16, 2, 8)
+            .astype(np.float32)}
+    prev = get_flag("flash_min_seq_k")
+    try:
+        set_flags({"flash_min_seq_k": -1})
+        a, = exe.run(main, feed=feed, fetch_list=[loss])
+        n1 = len(exe._cache)
+        # interpret=None + CPU backend -> both settings take the XLA
+        # reference path here, so the VALUES agree; the point is the
+        # cache must not conflate the two trace-time configurations
+        set_flags({"flash_min_seq_k": 0})
+        b, = exe.run(main, feed=feed, fetch_list=[loss])
+        n2 = len(exe._cache)
+    finally:
+        set_flags({"flash_min_seq_k": prev})
+    assert n2 > n1, "flag flip must add a cache entry, not reuse"
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)

@@ -322,3 +322,38 @@ def test_compiled_collectives_pins_dp_structure():
                                     mesh={"dp": 1},
                                     startup_program=startup1)
     assert pe1.compiled_collectives(feed) == {}
+
+
+def test_parallel_executor_retraces_on_trace_flag_flip():
+    """ParallelExecutor must rebuild its jit when a TRACE-time flag
+    (amp_bf16 / flash_min_seq_k) flips — identical input avals would
+    otherwise replay the stale executable (code-review r4 finding)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import parallel
+    from paddle_tpu.core.flags import get_flag, set_flags
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        p = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+    pe = parallel.ParallelExecutor(main, ["x", "y"], [loss],
+                                   mesh={"dp": 2},
+                                   startup_program=startup)
+    feed = {"x": np.zeros((4, 4), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    pe.run(feed)
+    jit0 = pe._jit_step
+    prev = get_flag("flash_min_seq_k")
+    try:
+        set_flags({"flash_min_seq_k": 0 if prev != 0 else -1})
+        pe.run(feed)
+        assert pe._jit_step is not jit0, \
+            "flag flip must rebuild the jitted step"
+    finally:
+        set_flags({"flash_min_seq_k": prev})
