@@ -206,8 +206,12 @@ def main():
     if os.environ.get("BENCH_BOOK", "1").lower() in ("1", "true", "yes",
                                                      "on"):
         os.environ.setdefault("BOOK_SECONDS", "45")
-        from run_book import run_matrix
-        out["book_matrix"] = run_matrix()
+        try:
+            from run_book import run_matrix
+            out["book_matrix"] = run_matrix()
+        except Exception as e:  # a matrix crash must not destroy the
+            out["book_matrix"] = {  # headline artifact — record it
+                "error": f"{type(e).__name__}: {e}"}
     print(json.dumps(out))
     if not out["valid"]:
         sys.exit(1)

@@ -48,6 +48,7 @@ from ..core.framework import (GRAD_SUFFIX, Parameter, Variable,
 from ..core.executor import CPUPlace, Executor
 from ..core.scope import Scope
 from .checkpoint import ShardedCheckpointMixin
+from .executor import _trace_flags
 from .mesh import count_collectives, make_mesh
 from .pipeline import microbatch, spmd_pipeline, unmicrobatch
 
@@ -67,16 +68,6 @@ def _attr_sig(attrs: Dict) -> tuple:
         return v
     return tuple(sorted((k, enc(v)) for k, v in attrs.items()
                         if k != "pipeline_stage"))
-
-
-def _amp_enabled() -> bool:
-    from ..amp import is_bf16_enabled
-    return is_bf16_enabled()
-
-
-def _trace_flags() -> tuple:
-    from .executor import _trace_flags as _tf
-    return _tf()
 
 
 class PipelineExecutor(ShardedCheckpointMixin):
