@@ -193,8 +193,14 @@ def make_transformer_composite_step(mesh: Mesh, vocab: int = 32,
     # d_model must divide by tp (column split) AND the ZeRO-1 velocity
     # specs shard d_model/d_ffn dims over dp (and tp*dp jointly for b1),
     # so grow the head count until d_model is a tp*dp multiple
+    base_width = n_heads * d_head
     n_heads = int(lcm(n_heads, tp * dp))
     d_model = n_heads * d_head
+    # the lcm growth widens the model with the mesh (dp=8 tp=2 ->
+    # d_model 128 vs the base 32); a fixed lr that trains the base
+    # width diverges at 4x it (observed at 32 virtual devices), so
+    # scale 1/width (muP hidden-lr rule) — exactly neutral at base
+    lr = lr * base_width / d_model
     d_ffn = 4 * d_model
     vocab = int(lcm(vocab, dp))
     stage_fn = functools.partial(_tfm_stage_fn, d_head=d_head)
@@ -231,7 +237,10 @@ def make_transformer_composite_step(mesh: Mesh, vocab: int = 32,
     outer = {
         "emb": rnd(vocab, d_model, s=0.1),
         "pos": rnd(seq, d_model, s=0.1),
-        "cls_w": rnd(d_model, vocab, s=0.1),
+        # fan-in scale: with the final standardize in loss_fn this keeps
+        # logits O(1) at ANY lcm-grown width (a fixed scale made the 64-
+        # device d_model-256 step start above uniform loss and diverge)
+        "cls_w": rnd(d_model, vocab, s=float(d_model) ** -0.5),
         "cls_b": jnp.zeros((vocab,), jnp.float32),
     }
     o_specs = {"emb": P(None), "pos": P(), "cls_w": P(), "cls_b": P()}
@@ -257,6 +266,11 @@ def make_transformer_composite_step(mesh: Mesh, vocab: int = 32,
         x = spmd_pipeline(stage_fn, st, x, mesh, batch_axis="dp",
                           param_specs=p_specs)
         x = unmicrobatch(x)
+        # parameterless final norm (pre-LN convention): the residual
+        # stream's magnitude grows with depth/width, and an unnormalized
+        # classifier input is what made the widest meshes diverge
+        x = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+            x.var(-1, keepdims=True) + 1e-6)
         logits = x @ o["cls_w"] + o["cls_b"]            # [B, S, V]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None],
