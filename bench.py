@@ -203,15 +203,22 @@ def main():
     # the headline's validity stays with its own roofline + convergence
     # gates.  BENCH_BOOK=0 skips; BOOK_MATRIX_r04.json is the committed
     # reference artifact.
-    if os.environ.get("BENCH_BOOK", "1").lower() in ("1", "true", "yes",
-                                                     "on"):
+    if (os.environ.get("BENCH_BOOK", "1").lower() in ("1", "true", "yes",
+                                                      "on")
+            and out.get("valid", True)):
+        # skipped when the headline already failed its gates: the matrix
+        # would delay the nonzero exit by ~2 min without changing it
         os.environ.setdefault("BOOK_SECONDS", "45")
+        amp_was = fluid.amp.is_bf16_enabled()
         try:
             from run_book import run_matrix
             out["book_matrix"] = run_matrix()
         except Exception as e:  # a matrix crash must not destroy the
             out["book_matrix"] = {  # headline artifact — record it
                 "error": f"{type(e).__name__}: {e}"}
+        finally:  # run_matrix flips the process-global amp flag
+            (fluid.amp.enable_bf16 if amp_was
+             else fluid.amp.disable_bf16)()
     print(json.dumps(out))
     if not out["valid"]:
         sys.exit(1)

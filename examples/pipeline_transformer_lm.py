@@ -56,7 +56,7 @@ def build():
 
 
 def batch(r, n):
-    ids = r.randint(0, VOCAB - 1, (n, SEQ)).astype(np.int64)
+    ids = r.randint(0, VOCAB, (n, SEQ)).astype(np.int64)
     # learnable synthetic task: next token = (token + 1) mod vocab
     lbl = ((ids + 1) % VOCAB)[:, :, None]
     return {"ids": ids, "lbl": lbl}

@@ -460,9 +460,11 @@ def _gc_checkpoints(dirname, keep: int, always_keep=()):
         shutil.rmtree(path, ignore_errors=True)
 
 
-def latest_checkpoint(dirname):
+def latest_checkpoint(dirname, require=None):
     """-> (checkpoint_dir, meta dict) of the latest valid snapshot, or
-    (None, None)."""
+    (None, None).  `require(cp_dir)` optionally filters candidates (e.g.
+    the sharded restore path requires its npz file); __latest__-pointer
+    preference and md5 verification apply either way."""
     if not os.path.isdir(dirname):
         return None, None
     latest = os.path.join(dirname, LATEST_FILENAME)
@@ -481,6 +483,8 @@ def latest_checkpoint(dirname):
             with open(meta_path) as f:
                 meta = json.load(f)
         except (OSError, ValueError):
+            continue
+        if require is not None and not require(cp_dir):
             continue
         if _md5_of_dir(cp_dir) == meta.get("md5"):
             return cp_dir, meta

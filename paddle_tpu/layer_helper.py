@@ -174,10 +174,12 @@ class LayerHelper:
 
     # mixed float widths are legal under amp (an embedding path stays
     # f32 while a matmul path emits bf16); params follow the WIDEST
-    # float so master weights stay f32 — genuinely different kinds
-    # (int vs float) remain an error
-    _FLOAT_WIDTH = {"float64": 4, "float32": 3, "bfloat16": 2,
-                    "float16": 1}
+    # float so master weights stay f32.  The promotion set is the
+    # amp-relevant trio only — float64 in the mix is a modelling bug
+    # (jax runs with x64 disabled by default, so a f64 param would be
+    # silently downcast), so it stays a hard error, as do genuinely
+    # different kinds (int vs float).
+    _FLOAT_WIDTH = {"float32": 3, "bfloat16": 2, "float16": 1}
 
     def input_dtype(self, name="input"):
         inputs = self.multiple_input(name)
@@ -192,7 +194,8 @@ class LayerHelper:
                     dtype = v.dtype
             else:
                 raise ValueError(
-                    f"all inputs must have the same dtype kind "
+                    f"all inputs must have the same dtype, or mix only "
+                    f"the amp float widths float16/bfloat16/float32 "
                     f"(got {dtype} and {v.dtype})")
         return dtype
 

@@ -12,39 +12,63 @@ from ..core.execution import data_of, one
 from ..core.registry import register_op
 
 
-def _index_routed_extreme(arg_fn):
+def _index_routed_extreme(plain_fn, arg_fn):
     """Max/min reduction whose VJP routes the cotangent by ARGMAX INDEX
-    (gather), not by float equality.  jnp.max's VJP tests
-    `x == broadcast(max)`, and under whole-program XLA:TPU fusion the two
-    sides can recompute at different effective precisions — false ties
-    then duplicate the cotangent into many elements (the sequence_pool
-    MAX bug, see ops/sequence.py).  Also matches the reference kernels'
-    single-index tie routing (reduce_op.h keeps one position).
+    (scatter at one position), not by float equality.  jnp.max's VJP
+    tests `x == broadcast(max)`, and under whole-program XLA:TPU fusion
+    the two sides can recompute at different effective precisions —
+    false ties then duplicate the cotangent into many elements (the
+    sequence_pool MAX bug, see ops/sequence.py).  Also matches the
+    reference kernels' single-index tie routing (reduce_op.h keeps one
+    position).
+
+    custom_vjp keeps the two costs separate: a forward-only (inference)
+    graph runs the plain fused reduction; only a differentiated graph
+    pays the transpose+argmax residual computation.
     Returns fn(x, axis=axes_tuple_or_None, keepdims=bool)."""
 
     def reduce(x, axis=None, keepdims=False):
-        if axis is None:
-            flat = x.reshape(-1)
-            i = jax.lax.stop_gradient(arg_fn(flat))
-            out = flat[i]
-            return out.reshape((1,) * x.ndim) if keepdims else out
-        axes = sorted(a if a >= 0 else a + x.ndim for a in axis)
-        keep = [a for a in range(x.ndim) if a not in axes]
-        xt = jnp.transpose(x, keep + axes)
-        kshape = xt.shape[:len(keep)]
-        xt = xt.reshape(kshape + (-1,))
-        i = jax.lax.stop_gradient(arg_fn(xt, axis=-1))
-        out = jnp.take_along_axis(xt, i[..., None], axis=-1)[..., 0]
-        if keepdims:
-            for a in axes:
-                out = jnp.expand_dims(out, a)
-        return out
+        nd = x.ndim
+        axes = (tuple(range(nd)) if axis is None
+                else tuple(sorted(a if a >= 0 else a + nd for a in axis)))
+        keep = tuple(a for a in range(nd) if a not in axes)
+        perm = keep + axes
+        inv_perm = tuple(int(p) for p in
+                         sorted(range(nd), key=perm.__getitem__))
+        flatlen = 1
+        for a in axes:
+            flatlen *= x.shape[a]
+
+        @jax.custom_vjp
+        def _r(x):
+            return plain_fn(x, axis=axes, keepdims=keepdims)
+
+        def _fwd(x):
+            xt = jnp.transpose(x, perm)
+            kshape = xt.shape[:len(keep)]
+            xf = xt.reshape(kshape + (-1,))
+            i = arg_fn(xf, axis=-1)
+            out = jnp.take_along_axis(xf, i[..., None], axis=-1)[..., 0]
+            if keepdims:
+                for a in axes:
+                    out = jnp.expand_dims(out, a)
+            return out, (i, kshape, xt.shape)
+
+        def _bwd(res, g):
+            i, kshape, tshape = res
+            gf = g.reshape(kshape)
+            scat = (jax.nn.one_hot(i, flatlen, dtype=gf.dtype)
+                    * gf[..., None])
+            return (jnp.transpose(scat.reshape(tshape), inv_perm),)
+
+        _r.defvjp(_fwd, _bwd)
+        return _r(x)
 
     return reduce
 
 
-_max_by_index = _index_routed_extreme(jnp.argmax)
-_min_by_index = _index_routed_extreme(jnp.argmin)
+_max_by_index = _index_routed_extreme(jnp.max, jnp.argmax)
+_min_by_index = _index_routed_extreme(jnp.min, jnp.argmin)
 
 
 @register_op("mean", inputs=("X",), outputs=("Out",))

@@ -65,22 +65,40 @@ class ShardedCheckpointMixin:
         when no usable snapshot exists."""
         from .. import io as _io
 
-        cp_dir, meta = _io.latest_checkpoint(dirname)
+        # the dir layout is shared with the serial io.save_checkpoint
+        # protocol, so the latest valid snapshot may be a serial one
+        # (persistables files, no sharded npz).  Mixed directories
+        # happen (e.g. a serial warm-start save followed by sharded
+        # training snapshots): restore the newest md5-valid snapshot
+        # that DOES carry the sharded npz — warning loudly if that
+        # skips a newer serial snapshot, since resuming from it rewinds
+        # past whatever progress the serial save recorded.
+        cp_dir, meta = _io.latest_checkpoint(
+            dirname, require=lambda d: os.path.exists(
+                os.path.join(d, STATES_FILENAME)))
         if cp_dir is None:
-            return None
-        path = os.path.join(cp_dir, STATES_FILENAME)
-        if not os.path.exists(path):
-            # the dir layout is shared with the serial io.save_checkpoint
-            # protocol, so the latest valid snapshot may be a serial one
-            # (persistables files, no sharded npz) — honor the documented
-            # None-or-RuntimeError contract instead of leaking a raw
-            # FileNotFoundError
+            if (not os.path.isdir(dirname)
+                    or not _io._checkpoints_by_time(dirname)):
+                return None  # empty/absent directory: documented contract
             raise RuntimeError(
-                f"latest checkpoint {meta['uuid']} under {dirname} has no "
-                f"{STATES_FILENAME} — it was saved by the serial "
-                "Executor path; restore it with io.restore_checkpoint, "
-                "or point ParallelExecutor at a directory of sharded "
-                "snapshots")
+                f"no snapshot under {dirname} carries {STATES_FILENAME} — "
+                "it holds serial Executor saves only; restore those with "
+                "io.load_checkpoint, or point ParallelExecutor at a "
+                "directory of sharded snapshots")
+        # cheap newer-serial detection: metadata timestamps only, no md5
+        newer = [m for _, name, m in _io._checkpoints_by_time(dirname)
+                 if m.get("timestamp", 0) > meta.get("timestamp", 0)
+                 and not os.path.exists(os.path.join(
+                     dirname, name, STATES_FILENAME))]
+        if newer:
+            import warnings
+
+            warnings.warn(
+                f"restore_checkpoint: newer snapshot {newer[-1]['uuid']} "
+                f"has no {STATES_FILENAME} (serial save); resuming from "
+                f"older sharded snapshot {meta['uuid']} — training state "
+                "rewinds to it", RuntimeWarning, stacklevel=2)
+        path = os.path.join(cp_dir, STATES_FILENAME)
         with np.load(path) as data:
             missing = sorted(set(self._states) - set(data.files))
             if missing:
