@@ -181,7 +181,18 @@ def run_op(ctx: ExecContext, op, env):
         raise NotImplementedError(f"op '{t}' has no lowering") from None
     import zlib
 
-    op_ctx = ctx.child(zlib.crc32(_op_rng_tag(op, info).encode()))
+    tag_hash = zlib.crc32(_op_rng_tag(op, info).encode())
+    # PipelineExecutor's ONE traced stage body executes stage 0's op
+    # descs for EVERY stage; tag_lookup substitutes the per-stage op's
+    # serial identity (a traced int selected by the stage index) so a
+    # random op in stage s draws exactly what the serial executor's
+    # stage-s op would — see pipeline_program._make_jit_step
+    lookup = getattr(ctx, "tag_lookup", None)
+    if lookup is not None:
+        traced_tag = lookup(op)
+        if traced_tag is not None:
+            tag_hash = traced_tag
+    op_ctx = ctx.child(tag_hash)
     op_ctx.op = op
     op_ctx.env = env
     op_ctx.root = ctx

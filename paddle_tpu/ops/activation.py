@@ -98,6 +98,13 @@ def prelu(ctx, ins, attrs):
                     "fix_seed": False},
              diff_inputs=("X",), diff_outputs=("Out",), random=True)
 def dropout(ctx, ins, attrs):
+    """Batch-position-keyed masks: row i's mask depends only on (op key,
+    global row index), never on the batch's partitioning — so a
+    microbatched / dp-sharded / pipelined execution reproduces the
+    serial masks bit-for-bit.  PipelineExecutor's staged trunk supplies
+    the global row offset (and, under sequence parallelism, a seq-block
+    fold) on the ExecContext; the serial executor supplies neither, which
+    is exactly offset 0 on the full batch."""
     xv = one(ins, "X")
     x = data_of(xv)
     if attrs.get("is_test"):
@@ -106,7 +113,19 @@ def dropout(ctx, ins, attrs):
                 "Mask": jnp.ones_like(x)}
     key = (jax.random.key(attrs["seed"]) if attrs.get("fix_seed")
            else ctx.rng())
-    mask = (jax.random.uniform(key, x.shape) >= attrs["dropout_prob"])
+    root = getattr(ctx, "root", None)
+    rows = getattr(root, "row_offset", 0) + jnp.arange(x.shape[0])
+    seq_block = getattr(root, "rng_seq_block", None)
+
+    def row_u(i):
+        k = jax.random.fold_in(key, i)
+        if seq_block is not None:
+            # sp: each rank draws its own seq block independently
+            # (distribution-equivalent to serial, not bit-equal)
+            k = jax.random.fold_in(k, seq_block)
+        return jax.random.uniform(k, x.shape[1:])
+
+    mask = (jax.vmap(row_u)(rows) >= attrs["dropout_prob"])
     mask = mask.astype(x.dtype)
     return {"Out": with_lod_of(xv, x * mask), "Mask": mask}
 

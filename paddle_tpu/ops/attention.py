@@ -26,6 +26,18 @@ def flash_attention_op(ctx, ins, attrs):
     k = data_of(one(ins, "K"))
     v = data_of(one(ins, "V"))
     scale = None if attrs.get("default_scale", True) else attrs["scale"]
+    # sequence parallelism: when the executor runs this op inside a
+    # shard_map whose ExecContext carries sp_axis (PipelineExecutor's
+    # staged trunk with sp), q/k/v arrive as LOCAL sequence blocks and
+    # attention must ring the K/V shards over that manual axis
+    root = getattr(ctx, "root", None)
+    sp_axis = getattr(root, "sp_axis", None) if root is not None else None
+    if sp_axis:
+        from ..parallel.ring_attention import ring_attention_local
+        out = ring_attention_local(
+            q, k, v, sp_axis, int(root.sp_size),
+            causal=bool(attrs.get("causal", False)), scale=scale)
+        return {"Out": out}
     kw = {}
     msk = int(attrs.get("min_seq_k", -1))
     if msk < 0:

@@ -52,7 +52,8 @@ def unmicrobatch(y):
 
 def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                   axis: str = "pp", batch_axis: str | None = None,
-                  param_specs=None):
+                  param_specs=None, auto_axes: Sequence[str] = (),
+                  seq_axis: str | None = None, with_tick: bool = False):
     """Run `stage_fn` as a `pp`-stage GPipe pipeline.
 
     stage_fn:     (params, activation[mb, ...]) -> activation[mb, ...]
@@ -72,8 +73,26 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                   composition); the stage_fn is then responsible for the
                   tp collectives (e.g. psum over 'tp' after a
                   row-parallel matmul).  Default: P(axis) on every leaf.
+    auto_axes:    mesh axes left OUT of shard_map's manual set: arrays
+                  keep (and propagate) GSPMD shardings over them inside
+                  the stage body, so a tensor-parallel axis needs no
+                  hand-written collectives at all — annotate the stacked
+                  params' non-leading dims with the axis (NamedSharding
+                  at device_put) and XLA inserts the Megatron psum where
+                  sharding propagation demands it.  This is how
+                  PipelineExecutor composes tp with a generic op-lowering
+                  stage body (manual specs can't: op lowerings see global
+                  shapes).  param_specs then must reference only manual
+                  axes (pass the default P(axis)).
+    seq_axis:     optional manual mesh axis to shard the activations'
+                  dim 2 (the sequence dim of a [n_micro, mb, S, ...]
+                  stream) — sequence parallelism; the stage body then
+                  runs on local sequence blocks and its attention op must
+                  use ring collectives over this axis (the
+                  flash_attention lowering does when the ExecContext
+                  carries sp_axis).
     returns:      [n_micro, mb, ...] last-stage outputs (sharded over
-                  `batch_axis` if given, otherwise replicated).
+                  `batch_axis`/`seq_axis` if given, otherwise replicated).
 
     Differentiable end-to-end: grad through this function yields the
     reverse pipeline schedule, with per-stage param grads sharded exactly
@@ -89,7 +108,10 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                 f"stage_params leading dim {leaf.shape[0]} != pipeline "
                 f"axis size {pp}: one stacked stage per '{axis}' device "
                 "(a mismatch would silently drop stages)")
-    x_spec = P(None, batch_axis) if batch_axis else P()
+    if seq_axis:
+        x_spec = P(None, batch_axis, seq_axis)
+    else:
+        x_spec = P(None, batch_axis) if batch_axis else P()
     if param_specs is None:
         param_specs = P(axis)
     else:
@@ -99,11 +121,28 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
                 raise ValueError(
                     f"param_specs leaf {spec} must lead with the pipeline "
                     f"axis {axis!r} (stacked stage dim)")
+    sm_kwargs = {}
+    if auto_axes:
+        manual = set(mesh.axis_names) - set(auto_axes)
+        missing = set(auto_axes) - set(mesh.axis_names)
+        if missing:
+            raise ValueError(f"auto_axes {missing} not in mesh axes "
+                             f"{mesh.axis_names}")
+        for spec in jax.tree_util.tree_leaves(
+                (param_specs, x_spec),
+                is_leaf=lambda s: isinstance(s, P)):
+            bad = set(spec) & set(auto_axes)
+            if bad:
+                raise ValueError(
+                    f"spec {spec} references auto axis {bad}: auto-axis "
+                    "sharding comes from the arrays' NamedShardings, not "
+                    "from shard_map specs")
+        sm_kwargs["axis_names"] = manual
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(param_specs, x_spec),
-        out_specs=x_spec)
+        out_specs=x_spec, **sm_kwargs)
     def _run(params_blk, xs):
         stage = jax.lax.axis_index(axis)
         params_local = jax.tree_util.tree_map(lambda p: p[0], params_blk)
@@ -114,15 +153,22 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
         state0 = jax.lax.stop_gradient(xs[0])
         state0 = jax.lax.pcast(state0, (axis,), to="varying")
 
-        def tick(state, xt):
+        def tick(state, xt_t):
+            xt, t = xt_t
             # stage 0 ingests from the stream; others from the neighbor
             inp = jnp.where(stage == 0, xt, state)
-            out = stage_fn(params_local, inp)
+            # with_tick: stage_fn(params, x, tick_index) — the schedule
+            # position, from which a stage derives its microbatch index
+            # (t - stage) for e.g. per-microbatch PRNG offsets
+            out = (stage_fn(params_local, inp, t) if with_tick
+                   else stage_fn(params_local, inp))
             nxt = jax.lax.ppermute(
                 out, axis, [(i, (i + 1) % pp) for i in range(pp)])
             return nxt, out
 
-        _, ys = jax.lax.scan(tick, state0, stream)
+        _, ys = jax.lax.scan(
+            tick, state0,
+            (stream, jnp.arange(stream.shape[0], dtype=jnp.int32)))
         # keep only the last stage's real emissions (drop the pp-1 warm-up
         # ticks BEFORE the psum so bubble outputs never cross the ICI),
         # then psum over the (otherwise-zero) mask to replicate them

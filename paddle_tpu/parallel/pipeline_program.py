@@ -25,6 +25,46 @@ runs it as one jitted SPMD program:
     are stage-invariant; attrs are validated identical across stages),
     outer parameters run their op individually.
 
+Tensor and sequence parallelism compose in the SAME program the
+TPU-native way:
+
+  * `tp_axis='tp'` Megatron-splits every staged weight by the
+    alternation rule (see `_derive_tp_specs`) and leaves the tp axis in
+    GSPMD-auto mode inside the pipeline's shard_map
+    (spmd_pipeline auto_axes) — op lowerings keep seeing global shapes
+    and XLA's sharding propagation inserts the tp psum after
+    row-parallel matmuls.  No lowering knows tp exists.
+  * `sp_axis='sp'` shards the trunk activations' sequence dim; the
+    flash_attention lowering detects the manual sp axis on its
+    ExecContext and runs ring attention (parallel/ring_attention.py
+    ring_attention_local) — K/V blocks rotate over ICI while every
+    other trunk op runs on its local sequence block unchanged.
+
+So one `fluid.layers` Program trains under dp x pp x tp (x sp) with the
+Program's own optimizer ops — the full composition the reference needed
+three subsystems for (MultiGradientMachine x ParallelNeuralNetwork x
+sharded pservers).
+
+Stochastic and stateful ops in the trunk (the reference accepted ANY
+layer under per-layer placement — dropout and batch-norm included):
+
+  * dropout IS supported: masks are batch-position-keyed (each row's
+    mask depends only on the op key and the row's GLOBAL batch index,
+    ops/activation.py) and the stage body substitutes each stage's
+    SERIAL op identity into the key derivation (stage_tags +
+    ExecContext.tag_lookup), so a pipelined transformer with dropout
+    reproduces the serial run bit-for-bit — pinned in
+    tests/test_pipeline.py.  Under sp, each rank additionally folds its
+    seq-block index (independent, distribution-equivalent to serial).
+  * batch-norm stays OUT of the staged trunk by design: its running
+    stats are persistable writes, and a cross-microbatch running mean
+    inside one scanned schedule would make stage output depend on
+    schedule order — the very nondeterminism BN's own batch statistics
+    already cause across dp.  The supported placements: BN in pre/post
+    (full-batch semantics, aux-state carried), or stateless
+    normalization (layer_norm) in the trunk — which is also the
+    transformer convention.  Other stochastic ops error with guidance.
+
 Constraints (validated with explicit errors): stages must be
 structurally identical with a single activation in/out of fixed shape
 (the usual GPipe decomposition — embedding/classifier live outside the
@@ -81,6 +121,9 @@ class PipelineExecutor(ShardedCheckpointMixin):
         n_micro: int = 4,
         batch_axis: str = "dp",
         stage_axis: str = "pp",
+        tp_axis: Optional[str] = None,
+        sp_axis: Optional[str] = None,
+        param_shardings: Optional[Dict[str, P]] = None,
         shard_optimizer_states: bool = False,
         seed: int = 0,
     ):
@@ -89,6 +132,15 @@ class PipelineExecutor(ShardedCheckpointMixin):
         self.mesh: Mesh = mesh
         self.batch_axis = batch_axis
         self.stage_axis = stage_axis
+        for ax, what in ((tp_axis, "tp_axis"), (sp_axis, "sp_axis")):
+            if ax is not None and ax not in mesh.shape:
+                raise ValueError(f"{what}={ax!r} is not a mesh axis "
+                                 f"(mesh has {tuple(mesh.shape)})")
+        self.tp_axis = tp_axis if (tp_axis
+                                   and mesh.shape[tp_axis] > 1) else None
+        self.sp_axis = sp_axis if (sp_axis
+                                   and mesh.shape[sp_axis] > 1) else None
+        self._param_shardings = dict(param_shardings or {})
         self.n_micro = int(n_micro)
         self.program = program
         self.feed_names = list(feed_names)
@@ -103,6 +155,24 @@ class PipelineExecutor(ShardedCheckpointMixin):
         self._persistable = {v.name for v in program.list_vars()
                              if v.persistable}
         self._partition(block)
+        self.tp_param_specs = (self._derive_tp_specs(block)
+                               if self.tp_axis else {})
+        if self.sp_axis:
+            shp = tuple(block.var(self._trunk_in).shape or ())
+            if (len(shp) >= 2 and shp[1] > 0
+                    and shp[1] % self.mesh.shape[self.sp_axis]):
+                raise ValueError(
+                    f"trunk activation {self._trunk_in!r} sequence dim "
+                    f"{shp[1]} does not divide the '{self.sp_axis}' axis "
+                    f"({self.mesh.shape[self.sp_axis]})")
+            if any(op.type == "softmax" for op in self._stage_ops[0]):
+                raise NotImplementedError(
+                    "the staged trunk contains a softmax op — composed "
+                    "(score-materializing) attention computes over the "
+                    "LOCAL sequence block under sequence parallelism "
+                    "and would silently truncate the context; use the "
+                    "flash_attention path (no attention-weight dropout) "
+                    "in an sp trunk")
         self._plan_update(block)
 
         # --- host-side init, then stack + place -------------------------
@@ -137,6 +207,7 @@ class PipelineExecutor(ShardedCheckpointMixin):
 
         pre, post = [], []
         stages: Dict[int, list] = {}
+        self._trunk_has_random = False
         mode = "pre"
         for op in ops[:bwd_start]:
             s = op.attrs.get("pipeline_stage")
@@ -190,12 +261,19 @@ class PipelineExecutor(ShardedCheckpointMixin):
                 except KeyError:
                     continue
                 if info.random and not op.attrs.get("is_test", False):
+                    if op.type == "dropout":
+                        # supported: batch-position-keyed masks + per-
+                        # stage serial op tags make the pipelined draw
+                        # bit-identical to serial (see _make_jit_step)
+                        self._trunk_has_random = True
+                        continue
                     raise NotImplementedError(
                         f"stage {s} contains stochastic op {op.type!r}: "
-                        "one traced stage body would reuse a fixed PRNG "
-                        "key across stages/microbatches/steps, silently "
-                        "diverging from serial execution — disable "
-                        "dropout in the trunk (or set is_test)")
+                        "only dropout has the batch-position-keyed "
+                        "derivation that keeps one traced stage body "
+                        "consistent with serial execution — run other "
+                        "stochastic ops in the pre/post sections (or "
+                        "set is_test)")
 
     def _stage_io(self, ops, block):
         """(ordered external activation reads, ordered Parameter reads,
@@ -283,6 +361,80 @@ class PipelineExecutor(ShardedCheckpointMixin):
         self._trunk_out = self._stage_out[-1]
 
     # ------------------------------------------------------------------
+    # tensor-parallel spec derivation (Megatron alternation)
+    # ------------------------------------------------------------------
+    def _derive_tp_specs(self, block) -> Dict[str, P]:
+        """Walk stage 0's ops and assign each staged parameter a
+        tensor-parallel PartitionSpec (WITHOUT the leading pp dim) by the
+        Megatron alternation rule: a matmul consuming a feature-replicated
+        activation splits its weight column-wise (output features over
+        tp, activation becomes feature-sharded); a matmul consuming a
+        feature-sharded activation splits row-wise (contraction over tp —
+        XLA's sharding propagation inserts the psum — and the activation
+        returns to replicated).  Biases follow their activation; LN
+        params stay replicated (full-feature op on the replicated
+        residual stream).  This reproduces Megatron's column->row split
+        for attention (wq/wk/wv col, wo row) and FFN (w1 col, w2 row) on
+        the DSL transformer block, and degrades to alternating col/row
+        on a plain fc trunk.
+
+        The specs are APPLIED purely as NamedShardings on the stacked
+        arrays: the stage body runs under shard_map with the tp axis in
+        GSPMD-auto mode (spmd_pipeline auto_axes), so op lowerings keep
+        seeing global shapes and the compiler places the collectives —
+        no manual psum in any lowering.  Reference capability:
+        /root/reference/paddle/gserver/gradientmachines/
+        ParallelNeuralNetwork.h (per-layer placement); the composition
+        itself is beyond-reference (SURVEY.md §2.5)."""
+        tp = self.tp_axis
+        specs: Dict[str, P] = {}
+        tagged = set()  # activations whose feature dim is tp-sharded
+        param0 = set(self._stage_params[0])
+        for op in self._stage_ops[0]:
+            outs = op.output_names()
+            if op.type == "mul":
+                x = op.inputs["X"][0]
+                y = op.inputs["Y"][0]
+                if y in param0:
+                    if y in specs:
+                        raise NotImplementedError(
+                            f"staged param {y!r} is read by two matmuls "
+                            "— tp auto-split needs a single role per "
+                            "weight (pass tp_axis=None or restructure)")
+                    if x in tagged:
+                        specs[y] = P(tp, None)      # row-parallel
+                    else:
+                        specs[y] = P(None, tp)      # column-parallel
+                        tagged.update(outs)
+                    continue
+            elif op.type == "elementwise_add":
+                x = op.inputs.get("X", [None])[0]
+                y = op.inputs.get("Y", [None])[0]
+                if y in param0:                     # bias
+                    new = P(tp) if x in tagged else P()
+                    if y in specs and specs[y] != new:
+                        raise NotImplementedError(
+                            f"staged bias {y!r} is consumed by adds with "
+                            "different feature shardings — tp auto-split "
+                            "needs a single role per param (pass "
+                            "tp_axis=None or restructure)")
+                    specs[y] = new
+                    if x in tagged:
+                        tagged.update(outs)
+                    continue
+            elif op.type == "layer_norm":
+                # full-feature op on the replicated stream: params (and
+                # output) replicated.  A tp-sharded input here would make
+                # GSPMD all-gather — correct but wasteful; the pre-LN
+                # trunk never produces one.
+                continue
+            # default: feature sharding propagates through elementwise /
+            # reshape / transpose / attention ops
+            if any(n in tagged for n in op.input_names()):
+                tagged.update(outs)
+        return specs
+
+    # ------------------------------------------------------------------
     # update planning (the Program's own optimizer ops)
     # ------------------------------------------------------------------
     def _plan_update(self, block):
@@ -358,12 +510,14 @@ class PipelineExecutor(ShardedCheckpointMixin):
         # accumulators of stage-0 opt ops: stacked like their params.
         # slots beyond Param/Grad/LearningRate reference accumulators
         self._stage_acc: Dict[str, List[str]] = {}
+        self._acc_owner: Dict[str, str] = {}
         for pname, op0 in self._group_opt_ops.items():
             k = k_of[pname]
             accs = [n for slot, ns in op0.inputs.items()
                     if slot not in ("Param", "Grad", "LearningRate")
                     for n in ns if n in self._persistable]
             for acc in accs:
+                self._acc_owner[acc] = pname
                 per_stage = [acc]
                 for s in range(1, len(self._stage_params)):
                     twin = next(
@@ -402,22 +556,57 @@ class PipelineExecutor(ShardedCheckpointMixin):
                     f"state var {n!r} not produced by the startup program")
             return np.asarray(v)
 
+        def tp_padded(p0, shape):
+            """The param's tp spec (pipeline_stage dim EXCLUDED) padded
+            with Nones to len(shape); only divisible dims keep the tp
+            axis (GSPMD pads otherwise — correct but wasteful on the
+            tiny virtual-mesh shapes)."""
+            ndim = len(shape)
+            spec = list(self.tp_param_specs.get(p0, ())) if self.tp_axis \
+                else []
+            spec += [None] * (ndim - len(spec))
+            tp_n = mesh.shape[self.tp_axis] if self.tp_axis else 1
+            return [None if (s == self.tp_axis and shape[i] % tp_n)
+                    else s for i, s in enumerate(spec[:ndim])]
+
+        unknown = sorted(k for k in self._param_shardings
+                         if k not in self._persistable)
+        if unknown:
+            raise ValueError(
+                f"param_shardings names {unknown} are not persistable "
+                "vars of this program (typo?)")
+        staged_keys = sorted(k for k in self._param_shardings
+                             if k in set(stage0) or k in stacked_members)
+        if staged_keys:
+            raise ValueError(
+                f"param_shardings entries {staged_keys} name STAGED "
+                "params — staged weights are sharded by the tp_axis "
+                "derivation (tp_param_specs), not per-name specs")
+
         states, shardings = {}, {}
         self._state_map = {}
         # stacked parameter groups + their accumulators
         for k, p0 in enumerate(stage0):
             stack = np.stack([val(sp[k]) for sp in self._stage_params])
             states[p0] = stack
-            shardings[p0] = NamedSharding(mesh, P(pp_ax))
+            shardings[p0] = NamedSharding(
+                mesh, P(pp_ax, *tp_padded(p0, stack.shape[1:])))
             for s, sp in enumerate(self._stage_params):
                 self._state_map[sp[k]] = ("stacked", p0, s)
         for acc0, names in self._stage_acc.items():
             stack = np.stack([val(n) for n in names])
             states[acc0] = stack
-            spec = [pp_ax] + [None] * (stack.ndim - 1)
-            if (shard_opt and stack.ndim >= 2
-                    and stack.shape[1] % dp == 0 and stack.shape[1] >= dp):
-                spec[1] = dp_ax  # ZeRO-1 on the stacked accumulator
+            # accumulator shards exactly like its param (same shape),
+            # plus ZeRO-1: the first still-free dim additionally shards
+            # over dp when divisible
+            spec = [pp_ax] + tp_padded(self._acc_owner.get(acc0, acc0),
+                                       stack.shape[1:])
+            if shard_opt:
+                for i in range(1, stack.ndim):
+                    if (spec[i] is None and stack.shape[i] % dp == 0
+                            and stack.shape[i] >= dp):
+                        spec[i] = dp_ax
+                        break
             shardings[acc0] = NamedSharding(mesh, P(*spec))
             for s, n in enumerate(names):
                 self._state_map[n] = ("stacked", acc0, s)
@@ -428,10 +617,20 @@ class PipelineExecutor(ShardedCheckpointMixin):
             if not scope.has_var(n) or scope.find_var(n) is None:
                 continue  # produced mid-program (e.g. aux writes only)
             v = val(n)
-            spec = P()
-            if (shard_opt and n.endswith("_acc") and v.ndim >= 1
-                    and v.shape[0] % dp == 0 and v.shape[0] >= dp):
-                spec = P(dp_ax)
+            spec = self._param_shardings.get(n)
+            if spec is None:
+                # accumulator inherits its parameter's explicit spec
+                # (same policy as ParallelExecutor._spec_for)
+                for pname, ps in self._param_shardings.items():
+                    if (n.startswith(pname + "_") and n.endswith("_acc")
+                            and tuple(v.shape) and len(ps) <= v.ndim):
+                        spec = ps
+                        break
+            if spec is None:
+                spec = P()
+                if (shard_opt and n.endswith("_acc") and v.ndim >= 1
+                        and v.shape[0] % dp == 0 and v.shape[0] >= dp):
+                    spec = P(dp_ax)
             states[n] = v
             shardings[n] = NamedSharding(mesh, spec)
             self._state_map[n] = ("direct", n, None)
@@ -459,13 +658,62 @@ class PipelineExecutor(ShardedCheckpointMixin):
         trainable = [n for n in self._trainable if n in self._states]
         outer_trainable = [n for n in trainable if n not in stage0]
 
-        def stage_fn(pvals, h):
-            env = DictEnv(dict(zip(stage0, pvals)))
-            env.set(trunk_in, h)
-            ctx = ExecContext(jax.random.key(0), compiled=True)
-            for op in s0_ops:
-                run_op(ctx, op, env)
-            return env.get(s0_out)
+        tp_axis, sp_axis = self.tp_axis, self.sp_axis
+        has_random = self._trunk_has_random
+
+        # per-(stage, op) SERIAL rng tags: the one traced stage body runs
+        # stage 0's op descs for every stage, so a random op (dropout)
+        # must derive its key from the op identity the SERIAL executor
+        # would use for THAT stage — rows of this table enter the
+        # shard_map split over pp and tag_lookup selects by position
+        import zlib
+
+        from ..core import registry as op_registry
+        from ..core.execution import _op_rng_tag
+        stage_tags = np.zeros((len(self._stage_ops), len(s0_ops)),
+                              np.int32)
+        for s, sops in enumerate(self._stage_ops):
+            for j, op in enumerate(sops):
+                info = op_registry.get_op_info(op.type)
+                stage_tags[s, j] = (
+                    zlib.crc32(_op_rng_tag(op, info).encode())
+                    & 0x7FFFFFFF)
+        op_pos = {id(op): j for j, op in enumerate(s0_ops)}
+
+        def make_stage_fn(key):
+            def stage_fn(pvals, h, t):
+                *param_vals, tag_row = pvals
+                env = DictEnv(dict(zip(stage0, param_vals)))
+                env.set(trunk_in, h)
+                ctx = ExecContext(
+                    key if has_random else jax.random.key(0),
+                    compiled=True)
+                if sp_axis:
+                    # the attention lowering rings K/V over this axis
+                    ctx.sp_axis = sp_axis
+                    ctx.sp_size = mesh.shape[sp_axis]
+                if has_random:
+                    ctx.tag_lookup = lambda op: (
+                        tag_row[op_pos[id(op)]]
+                        if id(op) in op_pos else None)
+                    # global row offset of this (microbatch, dp shard):
+                    # dropout keys masks by batch position, so the
+                    # pipelined draw equals the serial full-batch draw
+                    mb_loc = h.shape[0]
+                    dp = mesh.shape[batch_axis]
+                    micro = jnp.clip(
+                        t - jax.lax.axis_index(stage_axis), 0,
+                        n_micro - 1)
+                    ctx.row_offset = (
+                        micro * (mb_loc * dp)
+                        + jax.lax.axis_index(batch_axis) * mb_loc)
+                    if sp_axis:
+                        ctx.rng_seq_block = jax.lax.axis_index(sp_axis)
+                for op in s0_ops:
+                    run_op(ctx, op, env)
+                return env.get(s0_out)
+
+            return stage_fn
 
         def forward(outer_p, stack_p, rest, feeds, key):
             env = DictEnv({**rest, **outer_p, **feeds})
@@ -474,8 +722,12 @@ class PipelineExecutor(ShardedCheckpointMixin):
                 run_op(ctx, op, env)
             h = env.get(trunk_in)
             h = microbatch(h, n_micro)
-            h = spmd_pipeline(stage_fn, tuple(stack_p), h, mesh,
-                              axis=stage_axis, batch_axis=batch_axis)
+            h = spmd_pipeline(make_stage_fn(key),
+                              (*stack_p, jnp.asarray(stage_tags)), h,
+                              mesh, axis=stage_axis,
+                              batch_axis=batch_axis,
+                              auto_axes=(tp_axis,) if tp_axis else (),
+                              seq_axis=sp_axis, with_tick=True)
             env.set(trunk_out, unmicrobatch(h))
             for op in post_ops:
                 run_op(ctx, op, env)

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_attention", "all_to_all_attention", "attention_reference"]
+__all__ = ["ring_attention", "ring_attention_local",
+           "all_to_all_attention", "attention_reference"]
 
 
 def _block_attn(q, k, v, scale, causal, q_off, kv_off):
@@ -63,6 +64,47 @@ def _merge(acc, new):
     return out, m, d_a * ca + d_n * cn
 
 
+def ring_attention_local(q_blk, k_blk, v_blk, axis: str, n: int,
+                         causal: bool = False, scale: float = None):
+    """The ring-attention BODY: call it inside an enclosing `shard_map`
+    where `axis` (size `n`) is a manual mesh axis and q/k/v arrive as the
+    LOCAL [batch, seq/n, heads, dim] sequence blocks.  Used by
+    `ring_attention` below and by the flash_attention op lowering when a
+    PipelineExecutor stage runs with sequence parallelism (sp composed
+    with pp/dp/tp in one program)."""
+    scale = scale if scale is not None else q_blk.shape[-1] ** -0.5
+    blk = q_blk.shape[1]
+    kv_blk = k_blk.shape[1]
+    idx = jax.lax.axis_index(axis)
+    q_off = idx * blk
+
+    def body(i, carry):
+        acc, k_cur, v_cur, src = carry
+        kv_off = src * kv_blk
+        new = _block_attn(q_blk, k_cur, v_cur, scale, causal,
+                          q_off, kv_off)
+        acc = _merge(acc, new)
+        # rotate kv to the next ring position (one ICI hop)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return acc, k_nxt, v_nxt, (src - 1) % n
+
+    # the initial carry must match the body's varying-manual-axes type
+    # (the merge makes it vary over EVERY manual axis q varies over —
+    # not just `axis`: under PipelineExecutor the enclosing shard_map is
+    # also manual over dp/pp), so build the zeros FROM q_blk and let
+    # them inherit its vma instead of pcast-ing a fixed axis list
+    mvec = jnp.transpose(q_blk[..., 0], (0, 2, 1))       # [b, h, blk]
+    acc0 = (jnp.zeros_like(q_blk),
+            jnp.full_like(mvec, -jnp.inf),
+            jnp.zeros_like(mvec))
+    (out, m, denom), _, _, _ = jax.lax.fori_loop(
+        0, n, body, (acc0, k_blk, v_blk, idx))
+    denom = jnp.maximum(denom, 1e-20)
+    return out / denom.transpose(0, 2, 1)[..., None]
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                    causal: bool = False, scale: float = None):
     """Attention with sequence sharded over `axis`.
@@ -70,42 +112,16 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     q/k/v: [batch, seq, heads, dim] GLOBAL arrays (sharded or to-be-sharded
     on dim 1).  Returns the attention output with the same layout."""
     n = mesh.shape[axis]
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
     seq = q.shape[1]
     assert seq % n == 0, "seq length must divide the sp axis"
-    blk = seq // n
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None))
     def _ring(q_blk, k_blk, v_blk):
-        idx = jax.lax.axis_index(axis)
-        q_off = idx * blk
-
-        def body(i, carry):
-            acc, k_cur, v_cur, src = carry
-            kv_off = src * blk
-            new = _block_attn(q_blk, k_cur, v_cur, scale, causal,
-                              q_off, kv_off)
-            acc = _merge(acc, new)
-            # rotate kv to the next ring position (one ICI hop)
-            perm = [(j, (j + 1) % n) for j in range(n)]
-            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return acc, k_nxt, v_nxt, (src - 1) % n
-        b, _, h, d = q_blk.shape
-        acc0 = (jnp.zeros((b, blk, h, d), q_blk.dtype),
-                jnp.full((b, h, blk), -jnp.inf, q_blk.dtype),
-                jnp.zeros((b, h, blk), q_blk.dtype))
-        # constants are device-invariant; the loop carry becomes
-        # device-varying after the first merge — pcast to match
-        acc0 = jax.tree_util.tree_map(
-            lambda a: jax.lax.pcast(a, (axis,), to="varying"), acc0)
-        (out, m, denom), _, _, _ = jax.lax.fori_loop(
-            0, n, body, (acc0, k_blk, v_blk, idx))
-        denom = jnp.maximum(denom, 1e-20)
-        return out / denom.transpose(0, 2, 1)[..., None]
+        return ring_attention_local(q_blk, k_blk, v_blk, axis, n,
+                                    causal=causal, scale=scale)
 
     return _ring(q, k, v)
 
