@@ -125,8 +125,13 @@ class PipelineExecutor(ShardedCheckpointMixin):
         sp_axis: Optional[str] = None,
         param_shardings: Optional[Dict[str, P]] = None,
         shard_optimizer_states: bool = False,
+        schedule: str = "gpipe",
         seed: int = 0,
     ):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
+        self.schedule = schedule
         if isinstance(mesh, dict):
             mesh = make_mesh(mesh)
         self.mesh: Mesh = mesh
@@ -174,6 +179,8 @@ class PipelineExecutor(ShardedCheckpointMixin):
                     "flash_attention path (no attention-weight dropout) "
                     "in an sp trunk")
         self._plan_update(block)
+        if self.schedule == "1f1b":
+            self._validate_1f1b(block)
 
         # --- host-side init, then stack + place -------------------------
         startup = startup_program or default_startup_program()
@@ -359,6 +366,59 @@ class PipelineExecutor(ShardedCheckpointMixin):
         # the traced stage body (stage 0's ops) emits stage 0's boundary
         # name; the post section consumes the LAST stage's name
         self._trunk_out = self._stage_out[-1]
+
+    # ------------------------------------------------------------------
+    # 1F1B section analysis
+    # ------------------------------------------------------------------
+    def _validate_1f1b(self, block):
+        """Under the 1F1B schedule the POST section (classifier + loss)
+        runs per microbatch on the LAST stage, inside the schedule scan
+        (spmd_pipeline_1f1b last_fn), so the backward wave can start
+        while later microbatches are still in flight.  That imposes two
+        structural requirements checked here: the post section may not
+        write persistables (its per-microbatch execution would apply
+        stateful updates n_micro times, e.g. BN stats), and any
+        pre-section float activation consumed by post would need its
+        gradient routed around the pipeline (not supported — keep such
+        paths wholly in pre or post).  It also assumes the Program's
+        loss is a batch MEAN (the book convention): per-microbatch
+        losses are combined as sum/ (n_micro * dp [* sp]), which equals
+        the serial value exactly for mean losses — pinned by the
+        serial-equality tests."""
+        if self.sp_axis:
+            raise NotImplementedError(
+                "schedule='1f1b' with sp_axis: the per-microbatch post "
+                "section would see a sequence-sharded trunk output "
+                "against full-sequence labels — shard the labels or use "
+                "schedule='gpipe' (which runs post on the gathered "
+                "full batch) for sequence-parallel runs")
+        post_writes = {n for op in self._post_ops for n in
+                       op.output_names()}
+        post_aux = sorted(post_writes & set(self._persistable))
+        if post_aux:
+            raise NotImplementedError(
+                f"schedule='1f1b': post section writes persistable "
+                f"var(s) {post_aux} — per-microbatch post execution "
+                "would apply them n_micro times (keep BN/counters in "
+                "pre, or use schedule='gpipe')")
+        pre_written = {n for op in self._pre_ops for n in
+                       op.output_names()}
+        post_reads = {n for op in self._post_ops for n in
+                      op.input_names()}
+        side = sorted(
+            n for n in post_reads
+            if n in pre_written and n not in self._persistable
+            and n != self._trunk_out and n)
+        self._side_vars = side
+        bad = [n for n in side
+               if str(block.var(n).dtype).startswith(("float",
+                                                      "bfloat"))]
+        if bad:
+            raise NotImplementedError(
+                f"schedule='1f1b': float pre-section output(s) {bad} "
+                "are consumed by the post section — their gradient "
+                "would bypass the pipeline (not supported; use "
+                "schedule='gpipe' or restructure)")
 
     # ------------------------------------------------------------------
     # tensor-parallel spec derivation (Megatron alternation)
@@ -642,34 +702,27 @@ class PipelineExecutor(ShardedCheckpointMixin):
     # ------------------------------------------------------------------
     # the jitted train step
     # ------------------------------------------------------------------
-    def _make_jit_step(self):
-        mesh = self.mesh
-        stage0 = list(self._stage_params[0])
-        pre_ops = tuple(self._pre_ops)
-        post_ops = tuple(self._post_ops)
-        s0_ops = tuple(self._stage_ops[0])
-        trunk_in, trunk_out = self._trunk_in, self._trunk_out
-        s0_out = self._stage_out[0]
-        loss_name, fetch_names = self._loss_name, self.fetch_names
-        n_micro, batch_axis, stage_axis = (self.n_micro, self.batch_axis,
-                                           self.stage_axis)
-        aux_writes = list(self._aux_writes)
-        plan = tuple(self._update_plan)
-        trainable = [n for n in self._trainable if n in self._states]
-        outer_trainable = [n for n in trainable if n not in stage0]
-
-        tp_axis, sp_axis = self.tp_axis, self.sp_axis
-        has_random = self._trunk_has_random
-
-        # per-(stage, op) SERIAL rng tags: the one traced stage body runs
-        # stage 0's op descs for every stage, so a random op (dropout)
-        # must derive its key from the op identity the SERIAL executor
-        # would use for THAT stage — rows of this table enter the
-        # shard_map split over pp and tag_lookup selects by position
+    def _make_stage_fn_factory(self):
+        """-> make_stage_fn(key) -> stage_fn(pvals, h, t), shared by the
+        GPipe and 1F1B schedules.  The per-(stage, op) SERIAL rng-tag
+        table is a closed-over constant indexed by the stage's
+        axis_index: the one traced stage body runs stage 0's op descs for
+        every stage, so a random op (dropout) must derive its key from
+        the op identity the SERIAL executor would use for THAT stage
+        (ExecContext.tag_lookup)."""
         import zlib
 
         from ..core import registry as op_registry
         from ..core.execution import _op_rng_tag
+
+        mesh = self.mesh
+        stage0 = list(self._stage_params[0])
+        s0_ops = tuple(self._stage_ops[0])
+        trunk_in, s0_out = self._trunk_in, self._stage_out[0]
+        n_micro, batch_axis, stage_axis = (self.n_micro, self.batch_axis,
+                                           self.stage_axis)
+        sp_axis = self.sp_axis
+        has_random = self._trunk_has_random
         stage_tags = np.zeros((len(self._stage_ops), len(s0_ops)),
                               np.int32)
         for s, sops in enumerate(self._stage_ops):
@@ -682,8 +735,7 @@ class PipelineExecutor(ShardedCheckpointMixin):
 
         def make_stage_fn(key):
             def stage_fn(pvals, h, t):
-                *param_vals, tag_row = pvals
-                env = DictEnv(dict(zip(stage0, param_vals)))
+                env = DictEnv(dict(zip(stage0, pvals)))
                 env.set(trunk_in, h)
                 ctx = ExecContext(
                     key if has_random else jax.random.key(0),
@@ -693,6 +745,8 @@ class PipelineExecutor(ShardedCheckpointMixin):
                     ctx.sp_axis = sp_axis
                     ctx.sp_size = mesh.shape[sp_axis]
                 if has_random:
+                    tag_row = jnp.asarray(stage_tags)[
+                        jax.lax.axis_index(stage_axis)]
                     ctx.tag_lookup = lambda op: (
                         tag_row[op_pos[id(op)]]
                         if id(op) in op_pos else None)
@@ -715,6 +769,33 @@ class PipelineExecutor(ShardedCheckpointMixin):
 
             return stage_fn
 
+        return make_stage_fn
+
+    def _make_jit_step(self):
+        if self.schedule == "1f1b":
+            return self._make_jit_step_1f1b()
+        return self._make_jit_step_gpipe()
+
+    def _make_jit_step_gpipe(self):
+        mesh = self.mesh
+        stage0 = list(self._stage_params[0])
+        pre_ops = tuple(self._pre_ops)
+        post_ops = tuple(self._post_ops)
+        s0_ops = tuple(self._stage_ops[0])
+        trunk_in, trunk_out = self._trunk_in, self._trunk_out
+        s0_out = self._stage_out[0]
+        loss_name, fetch_names = self._loss_name, self.fetch_names
+        n_micro, batch_axis, stage_axis = (self.n_micro, self.batch_axis,
+                                           self.stage_axis)
+        aux_writes = list(self._aux_writes)
+        plan = tuple(self._update_plan)
+        trainable = [n for n in self._trainable if n in self._states]
+        outer_trainable = [n for n in trainable if n not in stage0]
+
+        tp_axis, sp_axis = self.tp_axis, self.sp_axis
+        has_random = self._trunk_has_random
+        make_stage_fn = self._make_stage_fn_factory()
+
         def forward(outer_p, stack_p, rest, feeds, key):
             env = DictEnv({**rest, **outer_p, **feeds})
             ctx = ExecContext(key, compiled=True)
@@ -722,8 +803,7 @@ class PipelineExecutor(ShardedCheckpointMixin):
                 run_op(ctx, op, env)
             h = env.get(trunk_in)
             h = microbatch(h, n_micro)
-            h = spmd_pipeline(make_stage_fn(key),
-                              (*stack_p, jnp.asarray(stage_tags)), h,
+            h = spmd_pipeline(make_stage_fn(key), tuple(stack_p), h,
                               mesh, axis=stage_axis,
                               batch_axis=batch_axis,
                               auto_axes=(tp_axis,) if tp_axis else (),
@@ -760,6 +840,135 @@ class PipelineExecutor(ShardedCheckpointMixin):
             # env.d already holds aux_new (merged at construction) and
             # every update-op write; anything untouched keeps its old value
             new_states = {n: env.d.get(n, states[n]) for n in states}
+            return fetches, loss, new_states
+
+        out_sh = {n: self._state_shardings[n] for n in self._states}
+        return jax.jit(step, out_shardings=(None, None, out_sh),
+                       donate_argnums=(1,))
+
+    def _make_jit_step_1f1b(self):
+        """The 1F1B schedule (parallel/pipeline.spmd_pipeline_1f1b): one
+        scan interleaves forward and backward microbatches with vjp
+        residuals in an O(pp) ring buffer — the long-n_micro /
+        tight-HBM configuration.  The post section runs per microbatch
+        as the schedule's last_fn (its params' grads accumulate inside
+        the scan); pre-section grads come from the schedule's dx through
+        jax.vjp of the pre ops; fetches are recomputed exactly on the
+        full batch from the collected last-stage outputs (dropout's
+        batch-position keying makes the recompute bit-identical to the
+        per-microbatch draws)."""
+        from .pipeline import spmd_pipeline_1f1b
+
+        mesh = self.mesh
+        stage0 = list(self._stage_params[0])
+        pre_ops = tuple(self._pre_ops)
+        post_ops = tuple(self._post_ops)
+        trunk_in, trunk_out = self._trunk_in, self._trunk_out
+        loss_name, fetch_names = self._loss_name, self.fetch_names
+        n_micro, batch_axis, stage_axis = (self.n_micro, self.batch_axis,
+                                           self.stage_axis)
+        aux_writes = list(self._aux_writes)
+        plan = tuple(self._update_plan)
+        trainable = [n for n in self._trainable if n in self._states]
+        outer_trainable = [n for n in trainable if n not in stage0]
+        tp_axis, sp_axis = self.tp_axis, self.sp_axis
+        make_stage_fn = self._make_stage_fn_factory()
+
+        pre_reads = {n for op in pre_ops for n in op.input_names()}
+        post_reads = {n for op in post_ops for n in op.input_names()}
+        pre_params = [n for n in outer_trainable if n in pre_reads]
+        post_params = [n for n in outer_trainable if n in post_reads]
+        # non-trainable states the post section reads (closure, replicated)
+        post_rest = [n for n in sorted(post_reads)
+                     if n in self._states and n not in post_params
+                     and n not in stage0]
+        y_names = ([n for n in self.feed_names if n in post_reads]
+                   + self._side_vars)
+        dp = mesh.shape[batch_axis]
+        sp = mesh.shape[sp_axis] if sp_axis else 1
+        # batch-mean loss combination (see _validate_1f1b)
+        scale = 1.0 / (n_micro * dp * sp)
+
+        def make_last_fn(key, lrest):
+            def last_fn(lp, h, y, m):
+                env = DictEnv({**lrest, **lp, **y})
+                env.set(trunk_out, h)
+                ctx = ExecContext(key, compiled=True)
+                mb_loc = h.shape[0]
+                ctx.row_offset = (m * (mb_loc * dp)
+                                  + jax.lax.axis_index(batch_axis)
+                                  * mb_loc)
+                if sp_axis:
+                    ctx.rng_seq_block = jax.lax.axis_index(sp_axis)
+                for op in post_ops:
+                    run_op(ctx, op, env)
+                return jnp.sum(env.get(loss_name)) * scale
+
+            return last_fn
+
+        def step(feeds, states, key):
+            stack_p = [states[n] for n in stage0]
+            rest = {n: v for n, v in states.items()
+                    if n not in outer_trainable and n not in stage0}
+            pre_p = {n: states[n] for n in pre_params}
+            lp = {n: states[n] for n in post_params}
+            lrest = {n: states[n] for n in post_rest}
+
+            # full-batch pre pass: trunk input, side values, pre aux
+            env = DictEnv({**rest,
+                           **{n: states[n] for n in outer_trainable},
+                           **feeds})
+            ctx = ExecContext(key, compiled=True)
+            for op in pre_ops:
+                run_op(ctx, op, env)
+            aux_new = {n: env.d[n] for n in aux_writes if n in env.d}
+            x_mb = microbatch(env.get(trunk_in), n_micro)
+            y_mb = {n: microbatch(env.get(n), n_micro) for n in y_names}
+
+            loss_sum, outs, g_stack, g_last, dx = spmd_pipeline_1f1b(
+                make_stage_fn(key), make_last_fn(key, lrest),
+                tuple(stack_p), lp, x_mb, y_mb, mesh, axis=stage_axis,
+                batch_axis=batch_axis,
+                auto_axes=(tp_axis,) if tp_axis else (),
+                seq_axis=sp_axis, with_tick=True)
+
+            # pre-section grads from the schedule's input cotangents
+            # (XLA CSEs this re-trace with the pre pass above: same key,
+            # same ops, same operands)
+            def pre_fn(pp_):
+                env2 = DictEnv({**rest, **lp, **pp_, **feeds})
+                ctx2 = ExecContext(key, compiled=True)
+                for op in pre_ops:
+                    run_op(ctx2, op, env2)
+                return env2.get(trunk_in)
+
+            _, pre_vjp = jax.vjp(pre_fn, pre_p)
+            (g_pre,) = pre_vjp(unmicrobatch(dx))
+
+            # fetches: exact full-batch post on the collected outputs
+            env.set(trunk_out, unmicrobatch(outs))
+            for op in post_ops:
+                run_op(ctx, op, env)
+            loss = jnp.sum(env.get(loss_name))
+            fetches = {n: env.get(n) for n in fetch_names}
+
+            # --- the Program's own update ops on the computed grads ----
+            envU = DictEnv({**states, **aux_new})
+            for n in outer_trainable:
+                g = None
+                if n in g_pre:
+                    g = g_pre[n]
+                if n in g_last:
+                    g = g_last[n] if g is None else g + g_last[n]
+                if g is not None:
+                    envU.set(grad_var_name(n), g)
+            for n, g in zip(stage0, g_stack):
+                envU.set(grad_var_name(n), g)
+            ctxU = ExecContext(jax.random.fold_in(key, 1), compiled=True)
+            for kind, op in plan:
+                if kind == "run":
+                    run_op(ctxU, op, envU)
+            new_states = {n: envU.d.get(n, states[n]) for n in states}
             return fetches, loss, new_states
 
         out_sh = {n: self._state_shardings[n] for n in self._states}
