@@ -410,6 +410,8 @@ class Executor:
             tuple((n, _aval_key(v)) for n, v in sorted(in_vals.items())),
             get_flag("amp_bf16"),  # amp changes traced compute dtypes
             get_flag("flash_min_seq_k"),  # changes the traced attn path
+            get_flag("flash_pack_heads"),  # changes the traced kernel
+            get_flag("flash_block_q"), get_flag("flash_block_k"),
         )
         fn = self._cache.get(cache_key)
         if fn is None:
@@ -498,6 +500,8 @@ class Executor:
             str(device),
             get_flag("amp_bf16"),  # amp changes traced compute dtypes
             get_flag("flash_min_seq_k"),  # changes the traced attn path
+            get_flag("flash_pack_heads"),  # changes the traced kernel
+            get_flag("flash_block_q"), get_flag("flash_block_k"),
         )
         fn = self._cache.get(cache_key)
         if fn is None:

@@ -67,3 +67,19 @@ define_flag("flash_min_seq_k", -1,
             "at large d_model that dominates HBM traffic and memory, so "
             "training benches force the kernel (run_ridge.py).  Read at "
             "TRACE time: Executor caches key on it like amp_bf16")
+define_flag("flash_block_q", -1,
+            "override the flash kernel's shape-keyed Q block size "
+            "(-1 = the measured table in kernels/flash_attention."
+            "_select_blocks); tuning/benchmark hook, read at TRACE time")
+define_flag("flash_block_k", -1,
+            "override the flash kernel's shape-keyed K block size "
+            "(-1 = the measured table); tuning/benchmark hook, read at "
+            "TRACE time")
+define_flag("flash_pack_heads", True,
+            "fold head PAIRS into the 128-lane dim inside the flash "
+            "kernel when head_dim == 64 (and the head count is even): "
+            "[b*h, s, 64] tiles fill only half the TPU lane dimension — "
+            "the r4 ridge rows measured 58% throughput lost to it.  "
+            "Packed layout loads/stores [block, 128] tiles; the online "
+            "softmax runs per packed head on block-diagonal scores.  "
+            "Read at TRACE time like flash_min_seq_k")

@@ -43,13 +43,32 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 
 
+def _select_blocks(sq: int, sk: int, d: int) -> tuple[int, int]:
+    """(block_q, block_k) keyed on the attention shape — the r4 ridge
+    work measured the 512x1024 defaults (tuned at seq 4096 / d 128)
+    leaving throughput on the table at longer sequences: at seq 8192,
+    d 128 the fwd+bwd layer step runs +8% at 1024x2048 (2048x2048 fails
+    to compile: the f32 score tile alone is 16 MB of VMEM).  Larger K
+    blocks amortize the per-step rescale bookkeeping, and the benefit
+    grows with how many K blocks stream past a resident Q tile."""
+    if sk >= 8192:
+        return 1024, 2048
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, nk):
+                *, scale, causal, block_q, block_k, nk, pack, d_head):
+    """pack >= 2 folds `pack` heads side-by-side in the trailing dim
+    (q/k/v tiles [block, pack*d_head]): loads/stores fill the 128-lane
+    dim even at d_head 64, and the online softmax runs per packed head
+    on its own [block_q, block_k] score tile (block-diagonal — heads
+    never mix).  m/l scratch columns are banded per head."""
     i, j = pl.program_id(1), pl.program_id(2)
+    cw = 128 // pack  # scratch column band per packed head
 
     @pl.when(j == 0)
     def _init():
@@ -65,26 +84,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+            keep = rows >= cols
+        for hs in range(pack):
+            sl = slice(hs * d_head, (hs + 1) * d_head)
+            s = jax.lax.dot_general(
+                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = jnp.where(keep, s, NEG_INF)
+            m_prev = m_ref[:, hs * cw:hs * cw + 1]
+            l_prev = l_ref[:, hs * cw:hs * cw + 1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:, sl] = acc_ref[:, sl] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:, hs * cw:(hs + 1) * cw] = jnp.broadcast_to(
+                m_new, (block_q, cw))
+            l_ref[:, hs * cw:(hs + 1) * cw] = jnp.broadcast_to(
+                l_new, (block_q, cw))
 
     if causal:
         # skip K/V blocks strictly above the diagonal of this query tile
@@ -96,11 +122,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(j == nk - 1)
     def _finish():
-        l = l_ref[:, :1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        # (block_q, 1) tile: trailing unit dim keeps the layout TPU-legal
-        lse_ref[0] = m_ref[:, :1] + jnp.log(l_safe)
+        for hs in range(pack):
+            sl = slice(hs * d_head, (hs + 1) * d_head)
+            l = l_ref[:, hs * cw:hs * cw + 1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, :, sl] = (acc_ref[:, sl] / l_safe).astype(o_ref.dtype)
+            # (block_q, pack) tile: one lse column per packed head
+            lse_ref[0, :, hs:hs + 1] = (m_ref[:, hs * cw:hs * cw + 1]
+                                        + jnp.log(l_safe))
 
 
 def _kv_index_map(causal, block_q, block_k):
@@ -117,12 +146,14 @@ def _kv_index_map(causal, block_q, block_k):
         b, jnp.minimum(j, (i * block_q + (block_q - 1)) // block_k), 0)
 
 
-def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
-    bh, sq, d = q.shape
+def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret,
+                pack=1):
+    bh, sq, d = q.shape          # d = pack * d_head (packed layout)
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k, nk=nk)
+                             block_q=block_q, block_k=block_k, nk=nk,
+                             pack=pack, d_head=d // pack)
     kv_map = _kv_index_map(causal, block_q, block_k)
     o, lse = pl.pallas_call(
         kern,
@@ -134,11 +165,11 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, pack), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, pack), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -155,7 +186,8 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, block_q, block_k, nk):
+               dq_acc, *, scale, causal, block_q, block_k, nk, pack,
+               d_head):
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -168,23 +200,29 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]        # (block_q, 1)
-        delta = delta_ref[0]    # (block_q, 1)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        lse = lse_ref[0]        # (block_q, pack)
+        delta = delta_ref[0]    # (block_q, pack)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dq_acc[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            keep = rows >= cols
+        for hs in range(pack):
+            sl = slice(hs * d_head, (hs + 1) * d_head)
+            s = jax.lax.dot_general(
+                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = jnp.where(keep, s, NEG_INF)
+            p = jnp.exp(s - lse[:, hs:hs + 1])
+            dp = jax.lax.dot_general(
+                do[:, sl], v[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, hs:hs + 1]) * scale
+            dq_acc[:, sl] += jax.lax.dot_general(
+                ds.astype(k.dtype), k[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(j * block_k <= i * block_q + (block_q - 1))
@@ -200,7 +238,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, block_q, block_k, nq):
+                *, scale, causal, block_q, block_k, nq, pack, d_head):
     # grid = (bh, k_blocks, q_blocks): q innermost so dk/dv scratch persists
     i, j = pl.program_id(1), pl.program_id(2)   # i: k block, j: q block
 
@@ -215,27 +253,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]        # (1, block_q) — transposed layout
-        delta = delta_ref[0]    # (1, block_q)
-        # transposed tile: rows = k positions, cols = q positions
-        st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32) * scale
+        lse = lse_ref[0]        # (pack, block_q) — transposed layout
+        delta = delta_ref[0]    # (pack, block_q)
         if causal:
             krows = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 0)
             qcols = j * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 1)
-            st = jnp.where(qcols >= krows, st, NEG_INF)
-        pt = jnp.exp(st - lse)
-        dv_acc[:] += jax.lax.dot_general(
-            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        dst = pt * (dpt - delta) * scale
-        dk_acc[:] += jax.lax.dot_general(
-            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            keep = qcols >= krows
+        for hs in range(pack):
+            sl = slice(hs * d_head, (hs + 1) * d_head)
+            # transposed tile: rows = k positions, cols = q positions
+            st = jax.lax.dot_general(
+                k[:, sl], q[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                st = jnp.where(keep, st, NEG_INF)
+            pt = jnp.exp(st - lse[hs:hs + 1, :])
+            dv_acc[:, sl] += jax.lax.dot_general(
+                pt.astype(do.dtype), do[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dpt = jax.lax.dot_general(
+                v[:, sl], do[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dst = pt * (dpt - delta[hs:hs + 1, :]) * scale
+            dk_acc[:, sl] += jax.lax.dot_general(
+                dst.astype(q.dtype), q[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     if causal:
         # a k block gets gradient only from q blocks at/below its diagonal
@@ -252,29 +296,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                interpret):
-    bh, sq, d = q.shape
+                interpret, pack=1):
+    bh, sq, d = q.shape          # d = pack * d_head
     sk = k.shape[1]
+    d_head = d // pack
     nq, nk = sq // block_q, sk // block_k
-    # lse arrives as (bh, sq, 1); delta gets the same trailing-unit layout,
-    # plus (bh, 1, sq) transposed copies for the dkv kernel's k-major tiles
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-                    keepdims=True)
+    # lse arrives as (bh, sq, pack); delta matches (per packed head),
+    # plus (bh, pack, sq) transposed copies for the dkv kernel's k-major
+    # tiles
+    delta = jnp.sum(
+        (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+            bh, sq, pack, d_head),
+        axis=-1)
     lse_t = jnp.transpose(lse, (0, 2, 1))
     delta_t = jnp.transpose(delta, (0, 2, 1))
 
     kv_map = _kv_index_map(causal, block_q, block_k)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          pack=pack, d_head=d_head),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, pack), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, pack), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -309,15 +358,16 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          pack=pack, d_head=d_head),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), q_map),
-            pl.BlockSpec((1, 1, block_q), q_vec_map),
-            pl.BlockSpec((1, 1, block_q), q_vec_map),
+            pl.BlockSpec((1, pack, block_q), q_vec_map),
+            pl.BlockSpec((1, pack, block_q), q_vec_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -340,21 +390,23 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 # custom_vjp wrapper over [bh, seq, d]
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, pack):
+    o, _ = _fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                       interpret, pack)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, pack):
+    o, lse = _fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                         interpret, pack)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, pack, res, do):
     q, k, v, o, lse = res
     return _bwd_pallas(q, k, v, o, lse, do, scale, causal,
-                       block_q, block_k, interpret)
+                       block_q, block_k, interpret, pack)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -396,7 +448,7 @@ def _largest_tile(seq, block, align=128):
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    block_q=None, block_k=None,
                     interpret=None, min_seq_k=MIN_PALLAS_SEQ_K):
     """Flash attention over [batch, seq, heads, head_dim] tensors.
 
@@ -405,10 +457,20 @@ def flash_attention(q, k, v, causal=False, scale=None,
     (unless `interpret=True` asks for the pallas interpreter, e.g. tests),
     when the sequence doesn't tile onto MXU-aligned blocks, or when the
     K/V length is below `min_seq_k` (where the XLA composition measures
-    faster; pass min_seq_k=0 to force the kernel).
+    faster; pass min_seq_k=0 to force the kernel).  Block sizes default
+    to the shape-keyed measured table (`_select_blocks`); explicit
+    block_q/block_k override it.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    from ..core.flags import get_flag
+    sel_q, sel_k = _select_blocks(sq, sk, d)
+    if int(get_flag("flash_block_q")) > 0:
+        sel_q = int(get_flag("flash_block_q"))
+    if int(get_flag("flash_block_k")) > 0:
+        sel_k = int(get_flag("flash_block_k"))
+    block_q = sel_q if block_q is None else block_q
+    block_k = sel_k if block_k is None else block_k
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     scale_v = float(d ** -0.5 if scale is None else scale)
@@ -437,7 +499,25 @@ def flash_attention(q, k, v, causal=False, scale=None,
     if (pltpu is None or not tiles_ok
             or k.shape != (b, sk, h, d) or v.shape != (b, sk, h, d)):
         return flash_attention_reference(q, k, v, causal, scale_v)
-    fold = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, -1, d)
-    o = _flash(fold(q), fold(k), fold(v), scale_v, bool(causal),
-               block_q, block_k, interp)
-    return jnp.transpose(o.reshape(b, h, sq, d), (0, 2, 1, 3))
+    # head-pair packing: at d_head 64 the [block, d] tiles fill half the
+    # 128-lane dim; folding two heads side-by-side ([b*h/2, s, 128])
+    # fills the lanes for every load/store while the per-head score
+    # tiles stay block-diagonal inside the kernel (flash_pack_heads)
+    pack = 2 if (d == 64 and h % 2 == 0
+                 and bool(get_flag("flash_pack_heads"))) else 1
+
+    def fold(x, s_len):
+        x = jnp.transpose(x, (0, 2, 1, 3))           # [b, h, s, d]
+        if pack == 1:
+            return x.reshape(b * h, s_len, d)
+        x = x.reshape(b, h // pack, pack, s_len, d)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4))
+        return x.reshape(b * h // pack, s_len, pack * d)
+
+    o = _flash(fold(q, sq), fold(k, sk), fold(v, sk), scale_v,
+               bool(causal), block_q, block_k, interp, pack)
+    if pack == 1:
+        return jnp.transpose(o.reshape(b, h, sq, d), (0, 2, 1, 3))
+    o = o.reshape(b, h // pack, sq, pack, d)
+    o = jnp.transpose(o, (0, 1, 3, 2, 4)).reshape(b, h, sq, d)
+    return jnp.transpose(o, (0, 2, 1, 3))

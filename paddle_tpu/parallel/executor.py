@@ -45,7 +45,9 @@ def _trace_flags() -> tuple:
     """Snapshot of every flag read at TRACE time by op lowerings; a jit
     built under one snapshot must not serve another."""
     from ..core.flags import get_flag
-    return (_amp_enabled(), get_flag("flash_min_seq_k"))
+    return (_amp_enabled(), get_flag("flash_min_seq_k"),
+            get_flag("flash_pack_heads"), get_flag("flash_block_q"),
+            get_flag("flash_block_k"))
 
 __all__ = ["ParallelExecutor", "DistributeTranspiler",
            "SimpleDistributeTranspiler"]
