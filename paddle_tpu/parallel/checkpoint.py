@@ -30,39 +30,140 @@ class ShardedCheckpointMixin:
 
     def save_checkpoint(self, dirname, trainer_args=None,
                         max_keep: int = 3) -> str:
-        """Gather the sharded training state (params + optimizer
-        accumulators, incl. ZeRO-1 shards) to host and snapshot it under
-        `dirname` with {uuid, md5, timestamp} meta.  Returns the uuid."""
+        """Snapshot the sharded training state (params + optimizer
+        accumulators, incl. ZeRO-1 shards) under `dirname` with
+        {uuid, md5, timestamp} meta.  Returns the uuid.
+
+        Single-process: gathers each global array to host and writes one
+        npz.  Multi-process SPMD: EACH process writes only its
+        addressable shards (data + global index slices) to its own
+        `sharded_states.pK_of_N.npz` — the reference pserver's
+        per-shard snapshot discipline
+        (/root/reference/go/pserver/service.go:120-203) — then process 0
+        alone computes the md5 over the assembled directory and
+        publishes the meta/__latest__ pointer, with sync_global_devices
+        barriers standing in for etcd's coordination.  Requires a
+        filesystem shared by all processes (the normal checkpoint
+        setup), because restore may re-shard across a different process
+        count."""
         from .. import io as _io
 
-        if jax.process_count() > 1:
-            # multi-process SPMD: shards of a global Array live on other
-            # processes (np.asarray would raise non-addressable) and
-            # every process would race the __latest__ pointer.  The
-            # multi-host story is per-process orbax-style sharding or
-            # the pserver path's own snapshots — out of scope here.
-            raise NotImplementedError(
-                "save_checkpoint is single-controller: call it from a "
-                "1-process run (multi-host saves need a gather + "
-                "process-0 publish)")
-        cp_uuid = uuid_mod.uuid4().hex
+        nproc = jax.process_count()
+        if nproc == 1:
+            cp_uuid = uuid_mod.uuid4().hex
+            cp_dir = os.path.join(dirname,
+                                  f"{_io.CHECKPOINT_PREFIX}_{cp_uuid}")
+            os.makedirs(cp_dir, exist_ok=True)
+            host = {n: np.asarray(v) for n, v in self._states.items()}
+            np.savez(os.path.join(cp_dir, STATES_FILENAME), **host)
+            args = dict(trainer_args or {})
+            args.setdefault("step", self._step)
+            args.setdefault("mesh_axes", dict(self.mesh.shape))
+            _io.publish_checkpoint(dirname, cp_uuid, cp_dir, args,
+                                   max_keep)
+            return cp_uuid
+
+        from jax.experimental import multihost_utils
+
+        pid = jax.process_index()
+        # all processes must agree on the uuid: broadcast process 0's
+        raw = np.frombuffer(uuid_mod.uuid4().bytes, np.uint8)
+        raw = np.asarray(
+            multihost_utils.broadcast_one_to_all(raw), np.uint8)
+        cp_uuid = raw.tobytes().hex()
         cp_dir = os.path.join(dirname,
                               f"{_io.CHECKPOINT_PREFIX}_{cp_uuid}")
         os.makedirs(cp_dir, exist_ok=True)
-        host = {n: np.asarray(v) for n, v in self._states.items()}
-        np.savez(os.path.join(cp_dir, STATES_FILENAME), **host)
-        args = dict(trainer_args or {})
-        args.setdefault("step", self._step)
-        args.setdefault("mesh_axes", dict(self.mesh.shape))
-        _io.publish_checkpoint(dirname, cp_uuid, cp_dir, args, max_keep)
+        payload = {}
+        for n, arr in self._states.items():
+            for i, sh in enumerate(arr.addressable_shards):
+                if sh.replica_id != 0:
+                    continue  # one copy of replicated shards per process
+                idx = tuple(
+                    (0 if s.start is None else int(s.start),
+                     arr.shape[d] if s.stop is None else int(s.stop))
+                    for d, s in enumerate(sh.index))
+                payload[f"{n}//{i}//data"] = np.asarray(sh.data)
+                payload[f"{n}//{i}//index"] = np.asarray(idx, np.int64)
+            payload[f"{n}//shape"] = np.asarray(arr.shape, np.int64)
+            payload[f"{n}//dtype"] = np.asarray(
+                str(np.dtype(arr.dtype)))
+        np.savez(os.path.join(cp_dir,
+                              f"sharded_states.p{pid}_of_{nproc}.npz"),
+                 **payload)
+        # every shard file must exist before process 0 hashes the dir
+        multihost_utils.sync_global_devices(f"ckpt_save_{cp_uuid}")
+        if pid == 0:
+            args = dict(trainer_args or {})
+            args.setdefault("step", self._step)
+            args.setdefault("mesh_axes", dict(self.mesh.shape))
+            args.setdefault("n_processes", nproc)
+            _io.publish_checkpoint(dirname, cp_uuid, cp_dir, args,
+                                   max_keep)
+        multihost_utils.sync_global_devices(f"ckpt_pub_{cp_uuid}")
         return cp_uuid
+
+    @staticmethod
+    def _has_sharded_states(d) -> bool:
+        if os.path.exists(os.path.join(d, STATES_FILENAME)):
+            return True
+        return any(n.startswith("sharded_states.p") and n.endswith(".npz")
+                   for n in os.listdir(d))
+
+    @staticmethod
+    def _load_shard_files(cp_dir):
+        """Assemble {name: full host array} from the per-process shard
+        files written by a multi-process save (any process count)."""
+        import glob
+
+        files = sorted(glob.glob(
+            os.path.join(cp_dir, "sharded_states.p*_of_*.npz")))
+        n_expect = int(files[0].rsplit("_of_", 1)[1].split(".")[0])
+        if len(files) != n_expect:
+            raise RuntimeError(
+                f"checkpoint {cp_dir} holds {len(files)} shard files "
+                f"but was written by {n_expect} processes — incomplete "
+                "snapshot (md5 should have caught this)")
+        shapes, dtypes, pieces = {}, {}, {}
+        for f in files:
+            with np.load(f) as z:
+                for key in z.files:
+                    head, kind = key.rsplit("//", 1)
+                    if kind == "shape":
+                        shapes[head] = tuple(int(x) for x in z[key])
+                    elif kind == "dtype":
+                        dtypes[head] = str(z[key])
+                    elif kind == "data":
+                        name = head.rsplit("//", 1)[0]
+                        pieces.setdefault(name, []).append(
+                            (z[head + "//index"], z[key]))
+        out = {}
+        for n, shape in shapes.items():
+            full = np.empty(shape, np.dtype(dtypes[n]))
+            seen = np.zeros(shape, bool) if shape else None
+            for idx, data in pieces.get(n, []):
+                sl = tuple(slice(int(a), int(b)) for a, b in idx)
+                full[sl] = data
+                if seen is not None:
+                    seen[sl] = True
+            if shape and not seen.all():
+                raise RuntimeError(
+                    f"checkpoint var {n!r}: shard files do not cover "
+                    "the full array (corrupt or partial save)")
+            if not shape:  # 0-d: single replica-0 shard
+                for idx, data in pieces.get(n, []):
+                    full[()] = data
+            out[n] = full
+        return out
 
     def restore_checkpoint(self, dirname):
         """Restore the latest valid (md5-verified) snapshot under
         `dirname` onto THIS executor's mesh — the saved arrays are
-        global, so a different dp size just re-places them.  Restores
-        the RNG step counter too.  Returns the snapshot meta, or None
-        when no usable snapshot exists."""
+        global (single-process npz) or re-assembled from per-process
+        shard files (multi-process save), so a different dp size OR
+        process count just re-places them.  Restores the RNG step
+        counter too.  Returns the snapshot meta, or None when no usable
+        snapshot exists."""
         from .. import io as _io
 
         # the dir layout is shared with the serial io.save_checkpoint
@@ -70,12 +171,11 @@ class ShardedCheckpointMixin:
         # (persistables files, no sharded npz).  Mixed directories
         # happen (e.g. a serial warm-start save followed by sharded
         # training snapshots): restore the newest md5-valid snapshot
-        # that DOES carry the sharded npz — warning loudly if that
+        # that DOES carry sharded state — warning loudly if that
         # skips a newer serial snapshot, since resuming from it rewinds
         # past whatever progress the serial save recorded.
         cp_dir, meta = _io.latest_checkpoint(
-            dirname, require=lambda d: os.path.exists(
-                os.path.join(d, STATES_FILENAME)))
+            dirname, require=self._has_sharded_states)
         if cp_dir is None:
             if (not os.path.isdir(dirname)
                     or not _io._checkpoints_by_time(dirname)):
@@ -99,26 +199,30 @@ class ShardedCheckpointMixin:
                 f"older sharded snapshot {meta['uuid']} — training state "
                 "rewinds to it", RuntimeWarning, stacklevel=2)
         path = os.path.join(cp_dir, STATES_FILENAME)
-        with np.load(path) as data:
-            missing = sorted(set(self._states) - set(data.files))
-            if missing:
-                raise RuntimeError(
-                    f"checkpoint {meta['uuid']} lacks state var(s) "
-                    f"{missing} — was it saved from a different "
-                    "program/strategy?")
-            bad_shape = [
-                (n, data[n].shape, tuple(self._states[n].shape))
-                for n in self._states
-                if tuple(data[n].shape) != tuple(self._states[n].shape)]
-            if bad_shape:
-                raise RuntimeError(
-                    f"checkpoint {meta['uuid']} shape mismatch (saved vs "
-                    f"current): {bad_shape} — same names, different "
-                    "architecture?")
-            self._states = {
-                n: jax.device_put(data[n], self._state_shardings[n])
-                for n in self._states
-            }
+        if os.path.exists(path):
+            with np.load(path) as z:
+                data = {n: z[n] for n in z.files}
+        else:
+            data = self._load_shard_files(cp_dir)
+        missing = sorted(set(self._states) - set(data))
+        if missing:
+            raise RuntimeError(
+                f"checkpoint {meta['uuid']} lacks state var(s) "
+                f"{missing} — was it saved from a different "
+                "program/strategy?")
+        bad_shape = [
+            (n, data[n].shape, tuple(self._states[n].shape))
+            for n in self._states
+            if tuple(data[n].shape) != tuple(self._states[n].shape)]
+        if bad_shape:
+            raise RuntimeError(
+                f"checkpoint {meta['uuid']} shape mismatch (saved vs "
+                f"current): {bad_shape} — same names, different "
+                "architecture?")
+        self._states = {
+            n: jax.device_put(data[n], self._state_shardings[n])
+            for n in self._states
+        }
         self._step = int(meta.get("trainer_args", {})
                          .get("step", self._step))
         return meta

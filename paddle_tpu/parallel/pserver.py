@@ -17,11 +17,13 @@ optimize block ran) mirror listen_and_serv_op.cc:78-175.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
 from typing import Dict, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import LoDTensor, SelectedRows
@@ -145,11 +147,24 @@ class VariableServer:
     """
 
     def __init__(self, optimize_program, scope, executor, fan_in: int = 1,
-                 sync: bool = True):
+                 sync: bool = True, snapshot_dir: Optional[str] = None,
+                 snapshot_every: int = 0):
         self.program = optimize_program
         self.scope = scope
         self.exe = executor
         self.fan_in = fan_in
+        # per-shard checkpointing (reference go/pserver/service.go:
+        # 120-203,346: each pserver snapshots ITS OWN shard with
+        # {uuid, md5, timestamp} meta and restores on restart).  Each
+        # server gets its OWN snapshot_dir; every `snapshot_every`
+        # optimize rounds (sync) / applied updates (async) the shard's
+        # persistables are written through io.publish_checkpoint.  On
+        # construction an existing valid snapshot is restored into the
+        # scope automatically — a replacement pserver claiming the slot
+        # resumes the shard where its predecessor died.
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = int(snapshot_every)
+        self._updates_since_snapshot = 0
         # sync=False: ASGD — each received grad applies immediately, no
         # barrier round (reference go/pserver SendGrad semantics /
         # legacy --async_pserver; sync barriers become no-ops)
@@ -166,6 +181,8 @@ class VariableServer:
         self._threads = []
         self._stopping = False
         self.port = None
+        if snapshot_dir:
+            self.restore_snapshot()
         if not sync and self.program is not None:
             # validate the optimize program HERE, where the user can see
             # the error — a raise inside a handler thread would surface to
@@ -271,18 +288,93 @@ class VariableServer:
         finally:
             conn.close()
 
+    # -- per-shard snapshot (go/pserver/service.go:120-203) -----------------
+    def _shard_vars(self):
+        if self.program is None:
+            return {}
+        out = {}
+        for v in self.program.list_vars():
+            if not v.persistable or not self.scope.has_var(v.name):
+                continue
+            val = self.scope.find_var(v.name)
+            if val is None:
+                continue
+            out[v.name] = np.asarray(val)
+        return out
+
+    def snapshot(self, max_keep: int = 3) -> Optional[str]:
+        """Write this server's shard (its persistable params +
+        accumulators) under snapshot_dir with {uuid, md5, timestamp}
+        meta.  Returns the uuid, or None when no snapshot_dir is set."""
+        if not self.snapshot_dir:
+            return None
+        with self._lock:
+            data = (self._shard_vars(), self._round)
+        return self._write_snapshot(data, max_keep)
+
+    def _write_snapshot(self, data, max_keep: int = 3) -> str:
+        """Disk side of snapshot(): runs WITHOUT the lock (npz write +
+        md5-of-dir can take seconds on a big shard; trainer handler
+        threads must not stall behind it)."""
+        import uuid as uuid_mod
+
+        from .. import io as _io
+
+        host, rnd = data
+        cp_uuid = uuid_mod.uuid4().hex
+        cp_dir = os.path.join(self.snapshot_dir,
+                              f"{_io.CHECKPOINT_PREFIX}_{cp_uuid}")
+        os.makedirs(cp_dir, exist_ok=True)
+        np.savez(os.path.join(cp_dir, "pserver_shard.npz"), **host)
+        _io.publish_checkpoint(self.snapshot_dir, cp_uuid, cp_dir,
+                               {"round": rnd}, max_keep)
+        return cp_uuid
+
+    def restore_snapshot(self):
+        """Load the latest valid shard snapshot (if any) into the scope.
+        Returns the snapshot meta or None."""
+        from .. import io as _io
+
+        cp_dir, meta = _io.latest_checkpoint(
+            self.snapshot_dir,
+            require=lambda d: os.path.exists(
+                os.path.join(d, "pserver_shard.npz")))
+        if cp_dir is None:
+            return None
+        with np.load(os.path.join(cp_dir, "pserver_shard.npz")) as z:
+            for n in z.files:
+                self.scope.set_var(n, jnp.asarray(z[n]))
+        self._round = int(meta.get("trainer_args", {}).get("round", 0))
+        return meta
+
+    def _maybe_snapshot_data(self):
+        """Host copies of the shard when a snapshot is due (caller holds
+        self._lock); the caller performs the disk write AFTER releasing
+        the lock so trainer handler threads never stall behind I/O."""
+        if not self.snapshot_dir or self.snapshot_every <= 0:
+            return None
+        self._updates_since_snapshot += 1
+        if self._updates_since_snapshot < self.snapshot_every:
+            return None
+        self._updates_since_snapshot = 0
+        return (self._shard_vars(), self._round)
+
     def _barrier(self):
+        snap = None
         with self._lock:
             self._barriers += 1
             if self._barriers >= self.fan_in:
                 self._run_optimize()
                 self._barriers = 0
                 self._round += 1
+                snap = self._maybe_snapshot_data()
                 self._lock.notify_all()
             else:
                 rnd = self._round
                 while self._round == rnd and not self._stopping:
                     self._lock.wait(timeout=0.1)
+        if snap is not None:
+            self._write_snapshot(snap)
 
     def _slice_program(self, keep):
         from ..core.framework import Program
@@ -341,6 +433,7 @@ class VariableServer:
         self._async_built = True
 
     def _apply_async(self, name, value):
+        snap = None
         with self._lock:
             self.scope.set_var(name, value)
             if self.program is None:
@@ -350,6 +443,7 @@ class VariableServer:
             if prog is not None:
                 self.exe.run(prog, scope=self.scope)
                 self._async_seen.add(name)
+                snap = self._maybe_snapshot_data()
                 if isinstance(value, SelectedRows):
                     # applied rows must not survive to the next arrival
                     self.scope.erase(name)
@@ -360,6 +454,8 @@ class VariableServer:
                     and self._async_seen >= self._async_grads):
                 self.exe.run(self._async_epilogue, scope=self.scope)
                 self._async_seen.clear()
+        if snap is not None:
+            self._write_snapshot(snap)
 
     def _run_optimize(self):
         # sum per-trainer grads into the canonical grad var, then run the

@@ -353,3 +353,49 @@ def test_variable_server_async_rejects_multi_grad_op():
     # thread would surface to trainers only as a dropped connection
     with pytest.raises(ValueError, match="multi-grad"):
         VariableServer(prog, scope, exe, sync=False)
+
+
+def test_pserver_shard_snapshot_and_restart(tmp_path):
+    """Per-shard checkpoint (VERDICT r4 next #4, reference
+    go/pserver/service.go:120-203,346): the server snapshots its OWN
+    shard every `snapshot_every` optimize rounds with {uuid, md5,
+    timestamp} meta; a replacement server pointed at the same
+    snapshot_dir restores the shard and continues where the dead one
+    stopped."""
+    snap = str(tmp_path / "shard0")
+    scope = fluid.Scope()
+    scope.set_var("w", np.ones(4, np.float32))
+    scope.set_var("pserver_lr", np.asarray([0.1], np.float32))
+    exe = fluid.Executor(fluid.CPUPlace())
+    server = VariableServer(_sgd_program("w", "w@GRAD", 0.1), scope, exe,
+                            fan_in=1, snapshot_dir=snap,
+                            snapshot_every=2)
+    port = server.serve(0)
+    c = VariableClient(f"127.0.0.1:{port}", client_id="t0")
+    for _ in range(4):   # 4 rounds -> 2 snapshots
+        c.send_var("w@GRAD", np.full(4, 1.0, np.float32))
+        c.send_batch_barrier()
+    w4 = np.asarray(c.get_var("w"))
+    np.testing.assert_allclose(w4, np.full(4, 1.0 - 4 * 0.1), rtol=1e-6)
+    c.close()
+    server.stop()   # the "crash"
+
+    # replacement server: fresh scope (stale init values), same dir
+    scope2 = fluid.Scope()
+    scope2.set_var("w", np.ones(4, np.float32))
+    scope2.set_var("pserver_lr", np.asarray([0.1], np.float32))
+    server2 = VariableServer(_sgd_program("w", "w@GRAD", 0.1), scope2,
+                             exe, fan_in=1, snapshot_dir=snap,
+                             snapshot_every=2)
+    # restored to the round-4 snapshot, not the fresh init
+    np.testing.assert_allclose(np.asarray(scope2.find_var("w")), w4,
+                               rtol=1e-6)
+    assert server2._round == 4
+    port2 = server2.serve(0)
+    c2 = VariableClient(f"127.0.0.1:{port2}", client_id="t0")
+    c2.send_var("w@GRAD", np.full(4, 1.0, np.float32))
+    c2.send_batch_barrier()
+    w5 = np.asarray(c2.get_var("w"))
+    np.testing.assert_allclose(w5, np.full(4, 1.0 - 5 * 0.1), rtol=1e-6)
+    c2.close()
+    server2.stop()
