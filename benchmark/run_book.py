@@ -18,12 +18,19 @@ Method, per model:
   * train until the chapter's threshold is reached or the budget
     (BOOK_SECONDS per model, default 120 s post-compile) expires.
 
+Every row carries a `data` tag (r5): the classic 8 rows are tiny
+SYNTHETIC configs (the claim is numeric-mode convergence, not SOTA);
+two additional rows train on REAL corpora that need no network —
+fit_a_line_real (the diabetes study) and recognize_digits_real (the
+UCI optical handwritten digits), both shipped inside scikit-learn and
+evaluated on held-out splits (VERDICT r4 next #5).
+
 Prints ONE JSON line:
-  {"metric": "book_convergence_matrix", "reached": "8/8", "amp": true,
+  {"metric": "book_convergence_matrix", "reached": "10/10", "amp": true,
    "models": [{model, metric, target, value, reached, steps, seconds,
-               compile_seconds}, ...]}
+               compile_seconds, data}, ...]}
 Exit status 1 if any model misses its threshold.  `bench.py` embeds this
-matrix when BENCH_BOOK=1; the committed BOOK_MATRIX_r04.json is the
+matrix when BENCH_BOOK=1; the committed BOOK_MATRIX_r{N}.json is the
 published artifact for the round.
 """
 import json
@@ -91,8 +98,14 @@ def _train_loop(exe, scope, main, startup, batches, fetch_list, check,
             "compile_seconds": round(compile_s, 1)}
 
 
-def _result(name, metric, target, r):
-    r.update({"model": name, "metric": metric, "target": target})
+def _result(name, metric, target, r, data="synthetic"):
+    """`data` tags the row's corpus honestly: the classic 8 rows train
+    tiny synthetic configs (the claim is numeric-mode convergence, not
+    SOTA); the *_real rows train on real corpora that ship offline
+    inside scikit-learn (dataset/uci_digits.py, dataset/diabetes.py) —
+    VERDICT r4 next #5."""
+    r.update({"model": name, "metric": metric, "target": target,
+              "data": data})
     return r
 
 
@@ -496,9 +509,92 @@ def run_machine_translation():
     return _result("machine_translation_seq2seq", "xent_loss<", 1.0, res)
 
 
+# ── REAL-corpus rows (offline: corpora ship inside scikit-learn) ───────
+def run_fit_a_line_real():
+    """book/01 on REAL data: linear regression on the diabetes study
+    (442 real patients, 10 standardized features; dataset/diabetes.py).
+    Threshold mse < 0.65 of target variance — the corpus' linear-model
+    ceiling is R^2 ~ 0.5, so 0.65 means the fit is most of the way to
+    the best linear model, measured on the HELD-OUT split."""
+    from paddle_tpu.dataset import diabetes
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[10], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=pred, label=y)
+        avg = fluid.layers.mean(cost)
+        test_prog = main.clone(for_test=True)
+        fluid.SGD(learning_rate=0.03).minimize(avg)
+    (tr_x, tr_y), (te_x, te_y) = diabetes.load_data()
+    batches = [{"x": tr_x[i:i + 64], "y": tr_y[i:i + 64]}
+               for i in range(0, 320, 64)]
+    test_feed = {"x": te_x, "y": te_y}  # ALL 89 held-out rows
+    exe, scope = fluid.Executor(fluid.TPUPlace()), fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    def check(h):
+        v, = exe.run(test_prog, feed=test_feed, fetch_list=[avg],
+                     scope=scope)
+        v = float(np.asarray(v).reshape(-1)[0])
+        return v, v < 0.65
+
+    res = _train_loop(exe, scope, main, startup, batches, [avg], check,
+                      max_steps=400,
+                      extra_precompile=[(test_prog, test_feed, [avg])])
+    return _result("fit_a_line_real", "test_mse<", 0.65, res,
+                   data="real (diabetes study, sklearn bundle)")
+
+
+def run_recognize_digits_real():
+    """book/02 on REAL data: the UCI optical handwritten digits (1,797
+    real scans at 8x8; dataset/uci_digits.py), conv-pool + softmax,
+    accuracy measured on the HELD-OUT 360 digits."""
+    from paddle_tpu import nets
+    from paddle_tpu.dataset import uci_digits
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        cp = nets.simple_img_conv_pool(
+            input=img, filter_size=3, num_filters=16, pool_size=2,
+            pool_stride=2, act="relu")
+        pred = fluid.layers.fc(input=cp, size=10, act="softmax")
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        test_prog = main.clone(for_test=True)
+        fluid.Adam(learning_rate=0.003).minimize(avg)
+    (tr_x, tr_y), (te_x, te_y) = uci_digits.load_data()
+    batches = [{"img": tr_x[i:i + 128].reshape(-1, 1, 8, 8),
+                "label": tr_y[i:i + 128][:, None]}
+               for i in range(0, 1280, 128)]
+    test_feed = {"img": te_x.reshape(-1, 1, 8, 8),
+                 "label": te_y[:, None]}  # ALL 360 held-out digits
+    exe, scope = fluid.Executor(fluid.TPUPlace()), fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    def check(h):
+        _, a = exe.run(test_prog, feed=test_feed,
+                       fetch_list=[avg, acc], scope=scope)
+        a = float(np.asarray(a).reshape(-1)[0])
+        return a, a > 0.9
+
+    res = _train_loop(exe, scope, main, startup, batches, [avg, acc],
+                      check, max_steps=400,
+                      extra_precompile=[(test_prog, test_feed,
+                                         [avg, acc])])
+    return _result("recognize_digits_real", "test_acc>", 0.9, res,
+                   data="real (UCI optical digits, sklearn bundle)")
+
+
 RUNNERS = [run_fit_a_line, run_recognize_digits, run_image_classification,
            run_word2vec, run_recommender_system, run_understand_sentiment,
-           run_label_semantic_roles, run_machine_translation]
+           run_label_semantic_roles, run_machine_translation,
+           run_fit_a_line_real, run_recognize_digits_real]
 
 
 def run_matrix():

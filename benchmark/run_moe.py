@@ -1,0 +1,182 @@
+"""On-chip MoE-transformer perf + capacity/drop trade (VERDICT r4 #6).
+
+MoE's perf story was previously a virtual-CPU dryrun only; this runner
+measures a DSL MoE transformer LM (fluid.layers blocks with
+layers.moe_ffn replacing the dense FFN, top-2 GShard gating) training
+on the real chip through the gated scan-in-program instrument, and
+sweeps capacity_factor to expose the trade no artifact reported before
+r5: smaller capacity buffers run faster but DROP more overflow tokens.
+The drop fields are computed at an UNTRAINED router on gaussian
+activations (the worst case static capacity must absorb at this
+(T, E, capacity_factor) point — field names say so); the
+trained-routing-state story is dryrun_multichip section 6, which trains
+the aux loss and asserts weight_drop shrinks.
+
+FLOPs convention: analytic 6*N*P_active (active params per token: the
+top-2 expert pair, not the full expert bank) for mfu_analytic, plus the
+XLA-counted mfu/roofline fields for cross-row comparability — both
+under harness.plausibility.
+
+Usage: python benchmark/run_moe.py [--d-model 1024] [--experts 8]
+       [--sweep]   (sweep: capacity_factor x {1.0, 1.25, 1.5, 2.0})
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from harness import bound_fields, gated_time_program
+
+
+def build_moe_lm(batch, seq, vocab, d_model, n_heads, n_layers, experts,
+                 top_k, capacity_factor, aux_weight=0.01):
+    import paddle_tpu as fluid
+    from paddle_tpu import nets
+    from paddle_tpu.initializer import NormalInitializer
+    from paddle_tpu.models.transformer import _pre_ln, _proj
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[seq], dtype="int64")
+        lbl = fluid.layers.data(name="lbl", shape=[seq, 1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[vocab, d_model],
+            param_attr={"initializer": NormalInitializer(0.0, 0.02)})
+        pos = fluid.layers.create_parameter(
+            shape=[seq, d_model], dtype=emb.dtype,
+            default_initializer=NormalInitializer(0.0, 0.02))
+        x = fluid.layers.elementwise_add(emb, pos, axis=1)
+        aux_total = None
+        for _ in range(n_layers):
+            ln_x = _pre_ln(x)
+            q = _proj(ln_x, d_model)
+            k = _proj(ln_x, d_model)
+            v = _proj(ln_x, d_model)
+            att = nets.scaled_dot_product_attention(
+                q, k, v, num_heads=n_heads, causal=True)
+            x = fluid.layers.elementwise_add(x, _proj(att, d_model))
+            f, aux = fluid.layers.moe_ffn(
+                _pre_ln(x), num_experts=experts, top_k=top_k,
+                capacity_factor=capacity_factor)
+            x = fluid.layers.elementwise_add(x, f)
+            aux_total = (aux if aux_total is None
+                         else fluid.layers.elementwise_add(aux_total, aux))
+        x = _pre_ln(x)
+        logits = fluid.layers.fc(input=x, size=vocab, num_flatten_dims=2)
+        cost = fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.reshape(logits, shape=[-1, vocab]),
+            fluid.layers.reshape(lbl, shape=[-1, 1]))
+        avg = fluid.layers.mean(cost)
+        aux_mean = fluid.layers.scale(aux_total,
+                                      scale=aux_weight / n_layers)
+        loss = fluid.layers.elementwise_add(avg, aux_mean)
+        fluid.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def active_param_count(vocab, d_model, n_layers, experts, top_k, seq):
+    """Active params per token: 4 attention projections + top_k experts'
+    FFN pair (d x 4d twice) + router, + embeddings/classifier."""
+    d_inner = 4 * d_model
+    per_block = (4 * d_model * d_model
+                 + top_k * 2 * d_model * d_inner
+                 + d_model * experts)
+    return (n_layers * per_block + 2 * vocab * d_model + seq * d_model)
+
+
+def run_one(batch, seq, vocab, d_model, n_heads, n_layers, experts,
+            top_k, capacity_factor, iters):
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.parallel.moe import drop_rate, load_balance
+
+    fluid.amp.enable_bf16()
+    set_flags({"flash_min_seq_k": 0})
+    main, startup, loss = build_moe_lm(batch, seq, vocab, d_model,
+                                       n_heads, n_layers, experts,
+                                       top_k, capacity_factor)
+    r = np.random.RandomState(0)
+    feeds = {
+        "ids": r.randint(0, vocab, (batch, seq)).astype(np.int32),
+        "lbl": r.randint(0, vocab, (batch, seq, 1)).astype(np.int32),
+    }
+    tokens = batch * seq
+    p_active = active_param_count(vocab, d_model, n_layers, experts,
+                                  top_k, seq)
+    ms, cost, fields = gated_time_program(
+        main, startup, feeds, loss.name, iters,
+        model_flops_per_step=6.0 * tokens * p_active)
+    out = {
+        "model": "moe_transformer_lm",
+        "d_model": d_model, "n_layers": n_layers, "n_heads": n_heads,
+        "experts": experts, "top_k": top_k,
+        "capacity_factor": capacity_factor,
+        "seq": seq, "batch": batch, "vocab": vocab,
+        "params_active": p_active,
+        "ms_per_step": round(ms, 2),
+        "tokens_per_sec": round(tokens / ms * 1000, 1),
+        "mfu_analytic": fields.get("mfu"),
+    }
+    out.update(fields)
+    from harness import plausibility, roofline_from_cost
+    xla = roofline_from_cost(ms, cost)
+    out["mfu"] = xla.get("mfu")
+    out["tflops"] = xla.get("tflops")
+    out.update(bound_fields(ms, cost))
+    ok, reason = plausibility(out, ms)
+    if not ok:
+        out["valid"] = False
+        out["invalid_reason"] = reason
+    # routing diagnostics at an UNTRAINED gate on gaussian activations
+    # of the same (T, D, E): the worst-case drop static capacity must
+    # absorb at this capacity_factor, NOT the benchmarked model's
+    # trained routing state (dryrun section 6 covers that, training the
+    # aux loss and asserting weight_drop shrinks)
+    rr = np.random.RandomState(1)
+    xs = jnp.asarray(rr.randn(tokens, d_model).astype(np.float32))
+    gw = jnp.asarray(rr.randn(d_model, experts).astype(np.float32)
+                     * 0.02)
+    out["untrained_imbalance"] = round(
+        float(load_balance(xs, gw)["imbalance"]), 3)
+    dr = drop_rate(xs, gw, capacity_factor=capacity_factor, top_k=top_k)
+    out["untrained_assignment_drop"] = round(dr["assignment_drop"], 4)
+    out["untrained_weight_drop"] = round(dr["weight_drop"], 4)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--n-layers", type=int, default=6)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=30000)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--capacity-factor", type=float, default=1.25)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--sweep", action="store_true",
+                    help="sweep capacity_factor to show the "
+                         "drop/throughput trade")
+    a = ap.parse_args()
+    cfs = ([1.0, 1.25, 1.5, 2.0] if a.sweep else [a.capacity_factor])
+    rows = [run_one(a.batch, a.seq, a.vocab, a.d_model, a.n_heads,
+                    a.n_layers, a.experts, a.top_k, cf, a.iters)
+            for cf in cfs]
+    for row in rows:
+        print(json.dumps(row))
+    if any(not r.get("valid", True) for r in rows):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

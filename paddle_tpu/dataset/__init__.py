@@ -11,10 +11,16 @@ serves DETERMINISTIC SYNTHETIC data with the same schema
 (shapes/dtypes/vocab accessors), so models and book tests exercise
 identical code paths offline.  `PADDLE_TPU_DATASET=real` makes a failed
 download an error instead of a fallback.
+
+Two REAL corpora need no network at all (they ship inside scikit-learn):
+`uci_digits` (1,797 real 8x8 handwritten digits) and `diabetes` (442
+real patient regression rows) — the offline `data: real` convergence
+evidence (benchmark/run_book.py tags every row with its data source).
 """
 from . import (  # noqa: F401
     cifar,
     conll05,
+    diabetes,
     flowers,
     imdb,
     imikolov,
@@ -22,6 +28,7 @@ from . import (  # noqa: F401
     movielens,
     mq2007,
     sentiment,
+    uci_digits,
     uci_housing,
     voc2012,
     wmt14,

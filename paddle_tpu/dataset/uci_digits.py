@@ -1,0 +1,56 @@
+"""uci_digits: REAL handwritten digits, available fully offline.
+
+The UCI "Optical Recognition of Handwritten Digits" test corpus — 1,797
+real scanned digits at 8x8 resolution — ships INSIDE scikit-learn
+(`sklearn.datasets.load_digits`), so unlike the reference's 28x28 MNIST
+(python/paddle/v2/dataset/mnist.py, network download) this real corpus
+needs no egress at all.  It exists to give the convergence artifacts a
+`data: real` row in offline environments (VERDICT r4 next #5): the
+recognize-digits book model trains on actual human handwriting here,
+with mnist.py remaining the reference-parity 28x28 path when the
+network allows.
+
+Samples follow the mnist.py convention: (image float32 [64] scaled to
+[-1, 1], label int).  Deterministic 80/20 train/test split.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached
+
+__all__ = ["train", "test", "load_data"]
+
+
+@cached
+def load_data():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    # pixel values are 0..16 ink counts; scale to [-1, 1] like mnist.py
+    x = (d.data.astype(np.float32) / 8.0) - 1.0
+    y = d.target.astype(np.int64)
+    # deterministic shuffle so the split is class-balanced
+    idx = np.random.RandomState(42).permutation(len(y))
+    x, y = x[idx], y[idx]
+    n_train = int(len(y) * 0.8)
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def _reader(part):
+    def reader():
+        (xs, ys) = load_data()[part]
+        for i in range(len(ys)):
+            yield xs[i], int(ys[i])
+
+    return reader
+
+
+def train():
+    """1,437 real training digits as (image[64] in [-1,1], label)."""
+    return _reader(0)
+
+
+def test():
+    """360 held-out real digits."""
+    return _reader(1)

@@ -27,6 +27,7 @@ from .composite import (  # noqa: F401
     make_transformer_composite_step,
 )
 from .moe import (  # noqa: F401
+    drop_rate,
     load_balance,
     moe_dense,
     moe_ffn,

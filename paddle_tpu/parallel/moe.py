@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["moe_gate", "moe_dense", "moe_ffn", "moe_ffn_a2a",
-           "load_balance"]
+           "load_balance", "drop_rate"]
 
 
 def moe_gate(x, gate_w, num_experts: int, capacity: int, top_k: int = 1):
@@ -201,3 +201,41 @@ def load_balance(x, gate_w) -> dict:
     frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1),
                                    gate_w.shape[1]), axis=0)
     return {"frac": frac, "imbalance": jnp.max(frac) * gate_w.shape[1]}
+
+
+def drop_rate(x, gate_w, capacity_factor: float = 1.25, top_k: int = 1,
+              capacity: int = None, shards: int = 1) -> dict:
+    """What static capacity actually costs at this routing state.
+
+    An imbalanced router (load_balance imbalance > 1) overflows its hot
+    experts' capacity buffers and the overflow tokens are DROPPED
+    (their expert output is zero; the residual stream carries them) —
+    the metric no artifact reported before r5.  Returns:
+      assignment_drop  fraction of the T*top_k routing assignments that
+                       lost their capacity slot
+      weight_drop      fraction of total combine WEIGHT lost (second
+                       choices carry less gate weight, so this is the
+                       output-relevant number)
+    `shards` > 1 evaluates per-source capacity (the moe_ffn_a2a layout:
+    C_loc per shard, hot-expert overflow drops locally)."""
+    E = gate_w.shape[1]
+    T = x.shape[0]
+    assert T % shards == 0, f"tokens {T} must divide shards {shards}"
+    t_loc = T // shards
+    cap = (_capacity(t_loc, E, capacity_factor, top_k)
+           if capacity is None else capacity)
+    assigned = kept = weight = weight_kept = 0.0
+    for s in range(shards):
+        xb = x[s * t_loc:(s + 1) * t_loc]
+        dispatch, combine, _ = moe_gate(xb, gate_w, E, cap, top_k)
+        probs = jax.nn.softmax((xb @ gate_w).astype(jnp.float32), -1)
+        top = jax.lax.top_k(probs, top_k)[0]
+        if top_k == 2:
+            top = top / jnp.maximum(top.sum(-1, keepdims=True), 1e-9)
+        assigned += t_loc * top_k
+        kept += jnp.sum(dispatch)
+        weight += jnp.sum(top)
+        weight_kept += jnp.sum(combine)
+    return {"capacity": cap,
+            "assignment_drop": float(1.0 - kept / assigned),
+            "weight_drop": float(1.0 - weight_kept / weight)}
