@@ -78,8 +78,10 @@ define_flag("flash_block_k", -1,
 define_flag("flash_pack_heads", True,
             "fold head PAIRS into the 128-lane dim inside the flash "
             "kernel when head_dim == 64 (and the head count is even): "
-            "[b*h, s, 64] tiles fill only half the TPU lane dimension — "
-            "the r4 ridge rows measured 58% throughput lost to it.  "
-            "Packed layout loads/stores [block, 128] tiles; the online "
-            "softmax runs per packed head on block-diagonal scores.  "
+            "loads/stores then move full-lane [block, 128] tiles and "
+            "the online softmax runs per packed head on block-diagonal "
+            "scores.  Measured step-level NEUTRAL on v5e (r5, "
+            "RIDGE_r05.json): the d_head-64 penalty is the MXU "
+            "contraction width of the per-head matmuls, which packing "
+            "loads cannot fix — prefer d_head 128 architecturally.  "
             "Read at TRACE time like flash_min_seq_k")

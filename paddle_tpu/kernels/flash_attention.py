@@ -507,17 +507,16 @@ def flash_attention(q, k, v, causal=False, scale=None,
                  and bool(get_flag("flash_pack_heads"))) else 1
 
     def fold(x, s_len):
-        x = jnp.transpose(x, (0, 2, 1, 3))           # [b, h, s, d]
-        if pack == 1:
-            return x.reshape(b * h, s_len, d)
-        x = x.reshape(b, h // pack, pack, s_len, d)
-        x = jnp.transpose(x, (0, 1, 3, 2, 4))
+        # ADJACENT heads pair up by a pure reshape ((h, d) dims are
+        # contiguous), so packing costs exactly the transposes the
+        # unpacked path already pays — and the one real transpose now
+        # moves a full-128-lane last dim instead of a half-filled one
+        x = x.reshape(b, s_len, h // pack, pack * d)
+        x = jnp.transpose(x, (0, 2, 1, 3))
         return x.reshape(b * h // pack, s_len, pack * d)
 
     o = _flash(fold(q, sq), fold(k, sk), fold(v, sk), scale_v,
                bool(causal), block_q, block_k, interp, pack)
-    if pack == 1:
-        return jnp.transpose(o.reshape(b, h, sq, d), (0, 2, 1, 3))
-    o = o.reshape(b, h // pack, sq, pack, d)
-    o = jnp.transpose(o, (0, 1, 3, 2, 4)).reshape(b, h, sq, d)
-    return jnp.transpose(o, (0, 2, 1, 3))
+    o = jnp.transpose(o.reshape(b, h // pack, sq, pack * d),
+                      (0, 2, 1, 3))
+    return o.reshape(b, sq, h, d)
