@@ -49,6 +49,10 @@ AMP = os.environ.get("BENCH_AMP", "1").lower() in ("1", "true", "yes",
 # (2535 vs 2359 img/s; XLA's layout assignment already places batch in
 # the vector lanes where C < 128, see benchmark/README.md)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
+# BENCH_REMAT=1: rematerialize every residual block (jax.checkpoint) —
+# the bytes-for-FLOPs trade for this memory-bound model
+REMAT = os.environ.get("BENCH_REMAT", "0").lower() in ("1", "true",
+                                                       "yes", "on")
 # ResNet-50 fwd at 224x224 is ~4.1 GMACs = ~8.2 GFLOPs (2*MACs — the MFU
 # convention); train ~= 3x fwd.  Cross-check: XLA's own cost analysis
 # counts 22.5 GFLOP/img for the whole train step
@@ -65,7 +69,7 @@ def build_resnet50_train(batch, dtype):
         img = fluid.layers.data(name="img", shape=img_shape, dtype=dtype)
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")
         predict = resnet_imagenet(img, class_dim=1000, depth=50,
-                                  data_format=LAYOUT)
+                                  data_format=LAYOUT, remat=REMAT)
         cost = fluid.layers.cross_entropy(input=predict, label=label)
         avg_cost = fluid.layers.mean(cost)
         fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg_cost)
@@ -185,6 +189,7 @@ def main():
         "batch": BATCH,
         "amp": AMP,
         "layout": LAYOUT,
+        "remat": REMAT,
         "ms_per_step": round(ms, 2),
     }
     out.update(fields)

@@ -805,10 +805,34 @@ def recompute(fn, name=None):
                                lod_level=getattr(v, "lod_level", 0))
         pv.stop_gradient = v.stop_gradient
         outs.append(pv)
+    # persistable writes inside the segment (BN running stats, counters)
+    # must survive: without forwarding them the jax.checkpoint lowering
+    # would silently drop the state updates of every rematerialized
+    # batch_norm — carried as extra (non-user-visible) outputs
+    result_names = {v.name for v in out_vars}
+    state_writes = []
+
+    def collect_state(block):
+        # recurse like walk() above: a BN inside nested control flow
+        # writes its stats from a deeper block
+        for op_ in block.ops:
+            for n in op_.output_names():
+                try:
+                    prog_var = block.var(n)  # ancestor-walking lookup
+                except KeyError:
+                    continue
+                if (prog_var.persistable and n not in result_names
+                        and n not in state_writes):
+                    state_writes.append(n)
+            for a in op_.attrs.values():
+                if isinstance(a, dict) and "__block__" in a:
+                    collect_state(program.blocks[a["__block__"]])
+
+    collect_state(sub)
     parent.append_op(
         "recompute",
         {"X": reads},
-        {"Out": [v.name for v in outs]},
+        {"Out": [v.name for v in outs] + state_writes},
         {"sub_block": {"__block__": sub.idx},
-         "output_names": [v.name for v in outs]})
+         "output_names": [v.name for v in outs] + state_writes})
     return outs[0] if single else outs

@@ -59,18 +59,34 @@ def bottleneck(input, ch_in, ch_out, stride, is_test=False,
 
 
 def _layer_warp(block_func, input, ch_in, ch_out, count, stride,
-                is_test=False, data_format="NCHW"):
-    res = block_func(input, ch_in, ch_out, stride, is_test, data_format)
+                is_test=False, data_format="NCHW", remat=False):
+    def blk(x, ci, st):
+        if remat:
+            # rematerialized residual block: its internal conv/BN
+            # activations re-run in backward instead of living in HBM —
+            # the bytes-for-FLOPs trade for a memory-bound conv net
+            # (BN running-stat writes survive; layers.recompute carries
+            # persistable writes out of the segment)
+            return layers.recompute(
+                lambda: block_func(x, ci, ch_out, st, is_test,
+                                   data_format))
+        return block_func(x, ci, ch_out, st, is_test, data_format)
+
+    res = blk(input, ch_in, stride)
     for _ in range(1, count):
         ch_in_cur = ch_out * (4 if block_func is bottleneck else 1)
-        res = block_func(res, ch_in_cur, ch_out, 1, is_test, data_format)
+        res = blk(res, ch_in_cur, 1)
     return res
 
 
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
-                    data_format="NCHW"):
+                    data_format="NCHW", remat=False):
     """ResNet-50/101/152 (bottleneck) for 224x224 input; data_format
-    "NHWC" runs channels-last — the TPU-native conv layout."""
+    "NHWC" runs channels-last — the TPU-native conv layout.  `remat=True`
+    wraps every residual block in layers.recompute (jax.checkpoint):
+    block-internal activations are recomputed in backward — the HBM
+    lever for this memory-bound model (benchmark/README.md bytes
+    analysis; BENCH_REMAT=1 measures it)."""
     cfg = {
         50: ([3, 4, 6, 3], bottleneck),
         101: ([3, 4, 23, 3], bottleneck),
@@ -90,7 +106,7 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
     for i, (count, ch_out) in enumerate(zip(stages, [64, 128, 256, 512])):
         stride = 1 if i == 0 else 2
         res = _layer_warp(block, res, ch_in, ch_out, count, stride, is_test,
-                          data_format)
+                          data_format, remat=remat)
         ch_in = ch_out * expansion
     pool2 = layers.pool2d(input=res, pool_type="avg", global_pooling=True,
                           data_format=data_format)

@@ -202,3 +202,46 @@ def test_recompute_multiple_outputs_and_interpreter():
         got, = exe.run(main, feed={"x": xs}, fetch_list=[s],
                        compiled=compiled)
         np.testing.assert_allclose(np.asarray(got), 5.0 * xs)
+
+
+def test_recompute_carries_persistable_writes():
+    """BN running stats written INSIDE a rematerialized segment must
+    survive it (r5): jax.checkpoint re-runs the segment in backward, so
+    the lowering forwards every persistable write as an extra output —
+    without it the stats silently freeze at init."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core.framework import reset_unique_names
+
+    reset_unique_names()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 8, 8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+
+        def seg():
+            h = fluid.layers.conv2d(input=x, num_filters=4,
+                                    filter_size=3, padding=1, act=None)
+            return fluid.layers.batch_norm(h, act="relu")
+
+        h = fluid.layers.recompute(seg)
+        logits = fluid.layers.fc(input=h, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    stats = [v.name for v in main.list_vars()
+             if v.persistable and (".mean" in v.name or ".var" in v.name)]
+    assert stats, "BN stats not found"
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    r = np.random.RandomState(0)
+    feed = {"x": r.rand(6, 4, 8, 8).astype(np.float32),
+            "y": r.randint(0, 3, (6, 1)).astype(np.int64)}
+    l0 = exe.run(main, feed=feed, fetch_list=[loss], scope=sc)[0]
+    mean_name = next(n for n in stats if ".mean" in n)
+    m1 = np.asarray(sc.find_var(mean_name)).copy()
+    assert np.abs(m1).max() > 1e-6, "stats frozen at init"
+    exe.run(main, feed=feed, fetch_list=[loss], scope=sc)
+    m2 = np.asarray(sc.find_var(mean_name))
+    assert np.abs(m2 - m1).max() > 1e-8, "stats did not update on step 2"
