@@ -386,12 +386,26 @@ class PipelineExecutor(ShardedCheckpointMixin):
         the serial value exactly for mean losses — pinned by the
         serial-equality tests."""
         if self.sp_axis:
-            raise NotImplementedError(
-                "schedule='1f1b' with sp_axis: the per-microbatch post "
-                "section would see a sequence-sharded trunk output "
-                "against full-sequence labels — shard the labels or use "
-                "schedule='gpipe' (which runs post on the gathered "
-                "full batch) for sequence-parallel runs")
+            # the per-microbatch post section sees a sequence-sharded
+            # trunk output, so every y-stream input (labels etc.) must
+            # carry the SAME seq dim at position 1 to shard alongside it
+            trunk_shape = tuple(block.var(self._trunk_in).shape or ())
+            seq = trunk_shape[1] if len(trunk_shape) > 1 else None
+            post_reads = {n for op in self._post_ops for n in
+                          op.input_names()}
+            y_like = [n for n in self.feed_names if n in post_reads]
+            bad = []
+            for n in y_like:
+                shp = tuple(block.var(n).shape or ())
+                if len(shp) < 2 or shp[1] != seq:
+                    bad.append((n, shp))
+            if bad:
+                raise NotImplementedError(
+                    f"schedule='1f1b' with sp_axis: post-section "
+                    f"input(s) {bad} lack the trunk's sequence dim "
+                    f"{seq} at position 1, so they cannot shard with "
+                    "the sequence-parallel trunk output — use "
+                    "schedule='gpipe' (post on the gathered full batch)")
         post_writes = {n for op in self._post_ops for n in
                        op.output_names()}
         post_aux = sorted(post_writes & set(self._persistable))

@@ -9,7 +9,8 @@ extra schedule steps (bubble_fraction documents both).
 Pins: the raw schedule's loss/grads/dx equal GPipe+autodiff to float32
 round-off on pp-only and dp x pp meshes; PipelineExecutor(schedule=
 '1f1b') trains the DSL transformer to the SAME losses and parameters as
-the serial Executor (with and without dropout, and composed with tp);
+the serial Executor (with and without dropout, composed with tp and
+with sp — labels seq-shard alongside the trunk);
 invalid configurations error with guidance.
 """
 import numpy as np
@@ -207,3 +208,48 @@ def test_1f1b_rejects_stateful_post():
     out = pe.run({"x": r.randn(16, 8).astype(np.float32),
                   "y": r.randint(0, 4, (16, 1)).astype(np.int64)})
     assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_executor_1f1b_composes_with_sp():
+    """r5 follow-on: sequence parallelism under 1F1B — the y streams
+    (labels) shard their seq dim alongside the trunk output, so the
+    per-microbatch post section runs fully on local sequence blocks."""
+    batches = _batches()
+    _, serial = _serial(2, 0.0, batches)
+    reset_unique_names()
+    pm, ps, loss, _ = _build_lm(2)
+    pe = parallel.PipelineExecutor(
+        pm, ["ids", "lab"], [loss], mesh={"dp": 2, "pp": 2, "sp": 2},
+        startup_program=ps, n_micro=2, sp_axis="sp", schedule="1f1b")
+    for i, t in batches:
+        pe.run({"ids": i, "lab": t})
+    delta = max(float(np.abs(pe.state(n) - serial[n]).max())
+                for n in serial)
+    assert delta < 1e-4, delta
+
+
+def test_1f1b_sp_rejects_seqless_labels():
+    """A post-section input without the trunk's seq dim cannot shard
+    with the sp trunk — rejected with guidance."""
+    def build():
+        pm, ps = fluid.Program(), fluid.Program()
+        with fluid.program_guard(pm, ps):
+            ids = fluid.layers.data(name="ids", shape=[S], dtype="int64")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            lg = transformer_lm(ids, V, d_model=D, n_heads=2, n_layers=2,
+                                max_len=S, return_logits=True,
+                                pipeline_stages=2)
+            pooled = fluid.layers.reduce_mean(lg, dim=[1])
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(pooled, y))
+            fluid.Momentum(learning_rate=0.05, momentum=0.9) \
+                .minimize(loss)
+        return pm, ps, loss
+
+    reset_unique_names()
+    pm, ps, loss = build()
+    with pytest.raises(NotImplementedError, match="sequence dim"):
+        parallel.PipelineExecutor(
+            pm, ["ids", "y"], [loss], mesh={"dp": 2, "pp": 2, "sp": 2},
+            startup_program=ps, n_micro=2, sp_axis="sp",
+            schedule="1f1b")
