@@ -261,13 +261,10 @@ def spmd_pipeline_1f1b(stage_fn: Callable, last_fn: Callable,
         x_spec = P(None, batch_axis, seq_axis)
     else:
         x_spec = P(None, batch_axis) if batch_axis else P()
-    if seq_axis:
-        # y streams ride with the trunk's sequence sharding: every leaf
-        # must be [n_micro, mb, S, ...] with S the trunk's seq dim (the
-        # executor validates this before choosing sp + 1f1b)
-        y_spec = P(None, batch_axis, seq_axis)
-    else:
-        y_spec = P(None, batch_axis) if batch_axis else P()
+    # y streams ride exactly with the trunk activations' sharding: under
+    # sp every leaf must be [n_micro, mb, S, ...] with S the trunk's
+    # seq dim (the executor validates this before choosing sp + 1f1b)
+    y_spec = x_spec
     sm_kwargs = {}
     if auto_axes:
         sm_kwargs["axis_names"] = set(mesh.axis_names) - set(auto_axes)

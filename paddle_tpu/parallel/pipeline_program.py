@@ -385,27 +385,8 @@ class PipelineExecutor(ShardedCheckpointMixin):
         losses are combined as sum/ (n_micro * dp [* sp]), which equals
         the serial value exactly for mean losses — pinned by the
         serial-equality tests."""
-        if self.sp_axis:
-            # the per-microbatch post section sees a sequence-sharded
-            # trunk output, so every y-stream input (labels etc.) must
-            # carry the SAME seq dim at position 1 to shard alongside it
-            trunk_shape = tuple(block.var(self._trunk_in).shape or ())
-            seq = trunk_shape[1] if len(trunk_shape) > 1 else None
-            post_reads = {n for op in self._post_ops for n in
-                          op.input_names()}
-            y_like = [n for n in self.feed_names if n in post_reads]
-            bad = []
-            for n in y_like:
-                shp = tuple(block.var(n).shape or ())
-                if len(shp) < 2 or shp[1] != seq:
-                    bad.append((n, shp))
-            if bad:
-                raise NotImplementedError(
-                    f"schedule='1f1b' with sp_axis: post-section "
-                    f"input(s) {bad} lack the trunk's sequence dim "
-                    f"{seq} at position 1, so they cannot shard with "
-                    "the sequence-parallel trunk output — use "
-                    "schedule='gpipe' (post on the gathered full batch)")
+        post_reads = {n for op in self._post_ops for n in
+                      op.input_names()}
         post_writes = {n for op in self._post_ops for n in
                        op.output_names()}
         post_aux = sorted(post_writes & set(self._persistable))
@@ -417,8 +398,6 @@ class PipelineExecutor(ShardedCheckpointMixin):
                 "pre, or use schedule='gpipe')")
         pre_written = {n for op in self._pre_ops for n in
                        op.output_names()}
-        post_reads = {n for op in self._post_ops for n in
-                      op.input_names()}
         side = sorted(
             n for n in post_reads
             if n in pre_written and n not in self._persistable
@@ -433,6 +412,35 @@ class PipelineExecutor(ShardedCheckpointMixin):
                 "are consumed by the post section — their gradient "
                 "would bypass the pipeline (not supported; use "
                 "schedule='gpipe' or restructure)")
+        if self.sp_axis:
+            # the per-microbatch post section sees a sequence-sharded
+            # trunk output, so EVERY y-stream leaf (post-read feeds AND
+            # pre-produced side vars) must carry the same seq dim at
+            # position 1 to shard alongside it.  The check is
+            # positional and by-size (the [B, S, ...] batch-major
+            # convention) — a non-sequence dim that coincidentally
+            # equals S would pass; the serial-equality tests are the
+            # backstop for such programs.  The combination also
+            # assumes the post section is SEQ-LOCAL up to the final
+            # batch-mean (true of the reshape + softmax_xent + mean
+            # shape; a post op reducing ACROSS positions would compute
+            # per-shard reductions — covered by the same tests).
+            out_shape = tuple(block.var(self._trunk_out).shape or ())
+            seq = out_shape[1] if len(out_shape) > 1 else None
+            y_like = ([n for n in self.feed_names if n in post_reads]
+                      + side)
+            bad = []
+            for n in y_like:
+                shp = tuple(block.var(n).shape or ())
+                if len(shp) < 2 or shp[1] != seq:
+                    bad.append((n, shp))
+            if bad:
+                raise NotImplementedError(
+                    f"schedule='1f1b' with sp_axis: post-section "
+                    f"input(s) {bad} lack the trunk output's sequence "
+                    f"dim {seq} at position 1, so they cannot shard "
+                    "with the sequence-parallel trunk output — use "
+                    "schedule='gpipe' (post on the gathered full batch)")
 
     # ------------------------------------------------------------------
     # tensor-parallel spec derivation (Megatron alternation)
