@@ -1,5 +1,13 @@
 """Composed dp x pp x tp training step: ZeRO-1 + gradient accumulation.
 
+STATUS (r5): the raw-jax TEST ORACLE for the composed mesh.  The
+production path is `parallel.PipelineExecutor(tp_axis=..., sp_axis=...,
+schedule=...)`, which runs the USER'S fluid.layers Program under the
+same composition (pipeline_program.py; pinned against serial in
+tests/test_pipeline_tp.py and tests/test_1f1b.py).  This module's
+hand-built models remain the independent twin those tests and the
+dryrun compare collective structure against.
+
 The configuration a real pod runs is not one parallelism axis but their
 product: batch sharded over 'dp', the layer stack split over 'pp'
 (GPipe, parallel/pipeline.py), each stage's matmuls Megatron-split over
