@@ -10,6 +10,8 @@ device count N this tool compiles, on an N-device virtual CPU mesh:
   dp    — ResNet training step, {dp: N}           (ParallelExecutor)
   pp    — transformer LM from the DSL, {dp: N/4, pp: 4}
           (PipelineExecutor, GPipe schedule)
+  pp_1f1b — the SAME program under schedule='1f1b' (r5): fwd and
+          reverse-cotangent hops in one scan, >=2 permutes asserted
   comp  — composed transformer, {dp: N/4, pp: 2, tp: 2} + ZeRO-1 +
           grad accumulation (make_transformer_composite_step)
   ep    — MoE all_to_all dispatch, {ep: N}
@@ -92,26 +94,35 @@ def _measure(n: int) -> dict:
     V, S, D = 8, 8, 8
     pdp = max(1, n // 4)
     reset_unique_names()
-    pm, ps = fluid.Program(), fluid.Program()
-    with fluid.program_guard(pm, ps):
-        ids = fluid.layers.data(name="ids", shape=[S], dtype="int64")
-        lab = fluid.layers.data(name="lab", shape=[S, 1], dtype="int64")
-        lg = transformer_lm(ids, V, d_model=D, n_heads=2, n_layers=4,
-                            max_len=S, return_logits=True,
-                            pipeline_stages=4)
-        pl = fluid.layers.mean(
-            fluid.layers.softmax_with_cross_entropy(
-                fluid.layers.reshape(lg, shape=[-1, V]),
-                fluid.layers.reshape(lab, shape=[-1, 1])))
-        fluid.Momentum(learning_rate=0.05, momentum=0.9).minimize(pl)
-    t0 = time.perf_counter()
-    ppe = parallel.PipelineExecutor(
-        pm, ["ids", "lab"], [pl], mesh={"dp": pdp, "pp": 4},
-        startup_program=ps, n_micro=2)
+    def build_pp_program():
+        pm, ps = fluid.Program(), fluid.Program()
+        with fluid.program_guard(pm, ps):
+            ids = fluid.layers.data(name="ids", shape=[S], dtype="int64")
+            lab = fluid.layers.data(name="lab", shape=[S, 1],
+                                    dtype="int64")
+            lg = transformer_lm(ids, V, d_model=D, n_heads=2, n_layers=4,
+                                max_len=S, return_logits=True,
+                                pipeline_stages=4)
+            pl = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    fluid.layers.reshape(lg, shape=[-1, V]),
+                    fluid.layers.reshape(lab, shape=[-1, 1])))
+            fluid.Momentum(learning_rate=0.05, momentum=0.9).minimize(pl)
+        return pm, ps, pl
+
     pfeed = {"ids": r.randint(0, V, (2 * pdp, S)).astype(np.int64),
              "lab": r.randint(0, V, (2 * pdp, S, 1)).astype(np.int64)}
-    out["pp"] = ppe.compiled_collectives(pfeed)
-    out["pp_compile_s"] = round(time.perf_counter() - t0, 2)
+    # SAME program under both schedules — a one-sided config edit would
+    # silently compare different models
+    for sched, key in (("gpipe", "pp"), ("1f1b", "pp_1f1b")):
+        reset_unique_names()
+        pm, ps, pl = build_pp_program()
+        t0 = time.perf_counter()
+        ppe = parallel.PipelineExecutor(
+            pm, ["ids", "lab"], [pl], mesh={"dp": pdp, "pp": 4},
+            startup_program=ps, n_micro=2, schedule=sched)
+        out[key] = ppe.compiled_collectives(pfeed)
+        out[key + "_compile_s"] = round(time.perf_counter() - t0, 2)
 
     # ---- comp: composed dp x pp x tp transformer --------------------
     cdp = max(1, n // 4)
@@ -159,6 +170,11 @@ def check_invariants(row: dict) -> list:
         bad.append(f"N={row['n']} dp: unexpected permutes {row['dp']}")
     if row["pp"].get("collective-permute", 0) < 1:
         bad.append(f"N={row['n']} pp: no pipeline permute {row['pp']}")
+    # 1f1b runs fwd AND reverse hops inside one scan: at least the fwd
+    # permute plus the reverse-cotangent permute
+    if row["pp_1f1b"].get("collective-permute", 0) < 2:
+        bad.append(f"N={row['n']} pp_1f1b: missing fwd+bwd permutes "
+                   f"{row['pp_1f1b']}")
     if row["comp"].get("collective-permute", 0) < 1 or \
             row["comp"].get("all-reduce", 0) < 1:
         bad.append(f"N={row['n']} comp: structure missing {row['comp']}")
@@ -207,7 +223,7 @@ def main():
     # at small dp (measured: 8 at dp=4 vs 7 at dp=8/16), so comp pins
     # the planned classes (all-reduce = dp grads + tp psums, all-to-all,
     # all-gather) exactly and permutes as a +-1 band
-    for key in ("pp", "ep"):
+    for key in ("pp", "pp_1f1b", "ep"):
         counts = {json.dumps(r[key], sort_keys=True) for r in rows}
         if len(counts) > 1:
             failures.append(
@@ -224,16 +240,17 @@ def main():
             failures.append(f"comp: permute count drifts with N: {perms}")
 
     hdr = ("| N | dp (ResNet) | pp (DSL transformer) | "
-           "comp (dp x pp2 x tp2) | ep (MoE a2a) | compile s "
+           "pp 1f1b | comp (dp x pp2 x tp2) | ep (MoE a2a) | compile s "
            "(dp/pp/comp/ep) |")
     print(hdr)
-    print("|" + "---|" * 6)
+    print("|" + "---|" * 7)
     for r in rows:
         fmt = lambda d: ", ".join(f"{k.replace('collective-', '')}:{v}"
                                   for k, v in sorted(d.items())) or "none"
         print(f"| {r['n']} | {fmt(r['dp'])} | {fmt(r['pp'])} | "
-              f"{fmt(r['comp'])} | {fmt(r['ep'])} | "
+              f"{fmt(r['pp_1f1b'])} | {fmt(r['comp'])} | {fmt(r['ep'])} | "
               f"{r['dp_compile_s']}/{r['pp_compile_s']}/"
+              f"{r['pp_1f1b_compile_s']}/"
               f"{r['comp_compile_s']}/{r['ep_compile_s']} |")
     if a.json:
         with open(a.json, "w") as f:
