@@ -13,6 +13,7 @@ import time
 from typing import List, Optional, Sequence
 
 from paddle_tpu import native
+from paddle_tpu.core.resilience import RetryPolicy, fault_injector
 
 
 def _declare(l):
@@ -114,9 +115,16 @@ class Master:
         self._l.pt_master_stop(self._h)
 
     def __del__(self):
-        if getattr(self, "_h", None):
-            self._l.pt_master_destroy(self._h)
-            self._h = None
+        # interpreter shutdown may have torn down ctypes/native state in
+        # any order; destroying twice or raising from __del__ would turn
+        # a clean exit into "Exception ignored in" noise
+        try:
+            h = getattr(self, "_h", None)
+            if h:
+                self._h = None
+                self._l.pt_master_destroy(h)
+        except Exception:
+            pass
 
 
 class MasterClient:
@@ -126,34 +134,57 @@ class MasterClient:
     (whose state comes back from its snapshot)."""
 
     def __init__(self, addr: str, retry_interval: float = 0.2,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.host, port = addr.rsplit(":", 1)
         self.port = int(port)
         self.retry_interval = retry_interval
         self.timeout = timeout
+        # legacy kwargs map onto the policy: retry_interval seeds the
+        # backoff, timeout bounds the whole retry sequence (the old flat
+        # 50 x retry_interval loop is the from_env default's ancestor)
+        self.policy = retry_policy or RetryPolicy.from_env(
+            "MASTER_RETRY", max_attempts=50, base_delay=retry_interval,
+            max_delay=max(retry_interval, 2.0), deadline=timeout)
         self._sock = None
         self._f = None
 
     def _connect(self):
         if self._sock is not None:
             return
+        fault_injector().fire("master.connect")
         self._sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout
         )
         self._f = self._sock.makefile("rw", newline="\n")
 
     def _reset(self):
-        try:
-            if self._sock:
-                self._sock.close()
-        except OSError:
-            pass
+        # close the buffered file FIRST (with its flush suppressed):
+        # closing only the socket leaves _f to flush buffered bytes at GC
+        # time, which raises into "Exception ignored" noise during
+        # interpreter shutdown when the server died mid-roundtrip
+        f, s = self._f, self._sock
         self._sock = self._f = None
+        for obj in (f, s):
+            try:
+                if obj is not None:
+                    obj.close()
+            except (OSError, ValueError):
+                pass
 
     def _roundtrip(self, req: str, read_payload=False):
-        for _ in range(50):
+        state = self.policy.begin()
+        while True:
             try:
                 self._connect()
+                raw = req.encode()
+                data = fault_injector().mangle("master.send", raw)
+                if data != raw:
+                    # injected mid-write crash / wire corruption: ship
+                    # the mangled frame so the server sees it, then fail
+                    # our side like the sender died
+                    self._sock.sendall(data)
+                    raise OSError("fault injection: mangled frame")
                 self._f.write(req)
                 self._f.flush()
                 line = self._f.readline()
@@ -170,10 +201,11 @@ class MasterClient:
                             break
                         payload.append(ln.rstrip("\n"))
                 return line.rstrip("\n"), payload
-            except OSError:
+            except OSError as e:
                 self._reset()
-                time.sleep(self.retry_interval)
-        raise OSError(f"master at {self.host}:{self.port} unreachable")
+                state.record(e, what=(f"master at {self.host}:{self.port} "
+                                      "unreachable"))
+                state.sleep()
 
     def set_dataset(self, chunks: Sequence[str], chunks_per_task: int = 1):
         req = f"SET {chunks_per_task} {len(chunks)}\n" + "".join(
@@ -208,9 +240,16 @@ class MasterClient:
     def close(self):
         self._reset()
 
+    def __del__(self):
+        try:
+            self._reset()
+        except Exception:
+            pass
+
 
 def task_record_reader(client, chunk_reader, poll_interval: float = 0.05,
-                       stop_after_pass: bool = True):
+                       stop_after_pass: bool = True,
+                       on_chunk_error: str = "raise"):
     """Elastic reader: pull tasks from the master, yield records from each
     chunk via `chunk_reader(chunk) -> iterable`, ack on success, nack on
     error (reference v2/reader/creator.py:60-117 cloud_reader +
@@ -219,7 +258,17 @@ def task_record_reader(client, chunk_reader, poll_interval: float = 0.05,
     One call iterates one dataset pass: it stops when the master rolls over
     to a new pass (status 2 on a later get_task) — so a fresh call starts
     the next pass, matching the epoch-per-call reader convention.
+
+    `on_chunk_error` decides what happens after a failing chunk_reader is
+    nacked (`task_failed`, so the master re-dispatches the task and
+    discards it after failure_max nacks — service.go processFailedTask):
+    "raise" propagates and kills this reader (a second reader picks the
+    task up); "skip" moves on to the next task, so one surviving reader
+    can drive a poisoned task to discard and still finish the pass.
     """
+    if on_chunk_error not in ("raise", "skip"):
+        raise ValueError(f"on_chunk_error={on_chunk_error!r}: "
+                         "expected 'raise' or 'skip'")
 
     def reader():
         while True:
@@ -236,7 +285,17 @@ def task_record_reader(client, chunk_reader, poll_interval: float = 0.05,
                     yield from chunk_reader(chunk)
             except Exception:
                 client.task_failed(tid)
-                raise
+                if on_chunk_error == "raise":
+                    raise
+                # a nack that DISCARDED the task may have drained the
+                # pass (todo and pending both empty); without this check
+                # the next get_task would roll into a new pass and this
+                # reader would re-yield chunks it already served
+                if stop_after_pass:
+                    info = client.info()
+                    if info["todo"] == 0 and info["pending"] == 0:
+                        return
+                continue
             client.task_finished(tid)
             if stop_after_pass:
                 info = client.info()

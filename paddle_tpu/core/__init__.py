@@ -17,4 +17,10 @@ from .framework import (  # noqa: F401
     program_guard,
 )
 from .lod import LoDTensor, SelectedRows, TensorArray, create_lod_tensor  # noqa: F401
+from .resilience import (  # noqa: F401
+    FaultInjector,
+    RetryError,
+    RetryPolicy,
+    fault_injector,
+)
 from .scope import Scope  # noqa: F401

@@ -49,32 +49,48 @@ def md5file(fname: str) -> str:
 
 
 def download(url: str, module_name: str, md5sum: str,
-             save_name: str = None) -> str:
+             save_name: str = None, retry_policy=None) -> str:
     """Fetch `url` into DATA_HOME/<module_name>/, verify md5, return the
     local path.  A cached file with the right md5 short-circuits; corrupt
-    or missing files are re-fetched up to 3 times."""
+    or missing files are re-fetched under an exponential-backoff
+    RetryPolicy (3 attempts by default; tune via
+    PADDLE_TPU_DOWNLOAD_RETRY_* env vars) instead of hammering the
+    mirror with immediate re-downloads."""
+    from ..core.resilience import RetryPolicy, fault_injector
+
     dirname = os.path.join(data_home(), module_name)
     os.makedirs(dirname, exist_ok=True)
     filename = os.path.join(
         dirname, save_name if save_name else url.split("/")[-1])
 
-    retry = 0
-    while not (os.path.exists(filename) and md5file(filename) == md5sum):
+    if os.path.exists(filename) and md5file(filename) == md5sum:
+        return filename  # cached and valid: hashed exactly once
+
+    policy = retry_policy or RetryPolicy.from_env(
+        "DOWNLOAD_RETRY", max_attempts=3, base_delay=1.0, max_delay=30.0,
+        deadline=600.0)
+    state = policy.begin()
+    while True:
         if _cache_only():
             raise RuntimeError(f"{filename} is not cached and downloads "
                                "are disabled (offline fallback probe)")
-        if retry >= 3:
-            raise RuntimeError(
-                f"Cannot download {url} within retry limit 3")
-        retry += 1
-        sys.stderr.write(f"Cache file {filename} not found, "
-                         f"downloading {url}\n")
-        tmp = filename + ".part"
-        with urllib.request.urlopen(url, timeout=30) as r, \
-                open(tmp, "wb") as f:
-            shutil.copyfileobj(r, f)
-        os.replace(tmp, filename)
-    return filename
+        try:
+            fault_injector().fire("dataset.download")
+            sys.stderr.write(f"Cache file {filename} not found, "
+                             f"downloading {url}\n")
+            tmp = filename + ".part"
+            with urllib.request.urlopen(url, timeout=30) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, filename)
+            got = md5file(filename)
+            if got != md5sum:
+                raise IOError(f"md5 mismatch for {filename}: got {got}, "
+                              f"want {md5sum}")
+            return filename
+        except Exception as e:
+            state.record(e, what=f"Cannot download {url}")
+            state.sleep()
 
 
 def data_mode() -> str:

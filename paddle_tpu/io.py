@@ -372,8 +372,13 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
     snapshots beyond `max_keep`.  Returns the checkpoint uuid."""
     import uuid as uuid_mod
 
+    from .core.resilience import fault_injector
+
     if max_keep < 0:
         raise ValueError(f"max_keep must be >= 0, got {max_keep}")
+    # chaos hook: a process dying mid-snapshot leaves a meta-less (or
+    # md5-mismatched) dir that restore must skip and GC must reap
+    fault_injector().fire("checkpoint.save")
     cp_uuid = uuid_mod.uuid4().hex
     cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
     os.makedirs(cp_dir, exist_ok=True)
@@ -476,7 +481,11 @@ def latest_checkpoint(dirname, require=None):
     candidates.extend(
         meta["uuid"] for _, _, meta in reversed(_checkpoints_by_time(dirname))
     )
+    seen = set()
     for cp_uuid in candidates:
+        if cp_uuid in seen:
+            continue
+        seen.add(cp_uuid)
         cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
         meta_path = os.path.join(cp_dir, META_FILENAME)
         try:
@@ -488,6 +497,16 @@ def latest_checkpoint(dirname, require=None):
             continue
         if _md5_of_dir(cp_dir) == meta.get("md5"):
             return cp_dir, meta
+        # the pserver restore contract (go/pserver/service.go:346): a
+        # snapshot whose bytes don't match its md5 record is CORRUPT,
+        # never served — fall through to the next-newest valid one, but
+        # loudly, since resuming from it rewinds training state
+        import warnings
+
+        warnings.warn(
+            f"checkpoint {cp_uuid} under {dirname} failed md5 "
+            "verification (corrupt or torn write); falling back to an "
+            "older snapshot", RuntimeWarning, stacklevel=2)
     return None, None
 
 

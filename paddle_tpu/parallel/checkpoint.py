@@ -47,6 +47,11 @@ class ShardedCheckpointMixin:
         setup), because restore may re-shard across a different process
         count."""
         from .. import io as _io
+        from ..core.resilience import fault_injector
+
+        # chaos hook: lets tests model a process dying mid-snapshot (the
+        # torn write the md5-on-restore check exists to catch)
+        fault_injector().fire("checkpoint.save")
 
         nproc = jax.process_count()
         if nproc == 1:

@@ -16,6 +16,7 @@ optimize block ran) mirror listen_and_serv_op.cc:78-175.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import socket
@@ -27,11 +28,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import LoDTensor, SelectedRows
+from ..core.resilience import RetryPolicy, fault_injector
 
-__all__ = ["VariableServer", "VariableClient", "serialize_var",
-           "deserialize_var", "prebind_endpoint"]
+__all__ = ["VariableServer", "VariableClient", "BarrierTimeoutError",
+           "serialize_var", "deserialize_var", "prebind_endpoint",
+           "discard_prebound"]
 
 _HDR = struct.Struct("<I")
+
+# frame-length sanity: a header larger than 1 MiB or a payload larger
+# than 2 GiB is protocol desync / corruption, not a real request —
+# reject instead of allocating huge buffers or blocking on bytes that
+# will never arrive
+_MAX_HEAD = 1 << 20
+_MAX_PAYLOAD = 1 << 31
 
 # endpoint -> bound+listening socket, held from address PUBLICATION to
 # serve(): registry-discovered pservers bind FIRST and register the
@@ -54,6 +64,26 @@ def prebind_endpoint(host: str = "127.0.0.1") -> str:
 
 def _adopt_prebound(port: int):
     return _prebound.pop(port, None) if port else None
+
+
+def discard_prebound(endpoint: Optional[str] = None):
+    """Close parked sockets a VariableServer never adopted (one endpoint,
+    or all of them) — a prebound pserver slot that was abandoned would
+    otherwise hold its port until process exit."""
+    if endpoint is not None:
+        ports = [int(endpoint.rsplit(":", 1)[1])]
+    else:
+        ports = list(_prebound)
+    for port in ports:
+        s = _prebound.pop(port, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+atexit.register(discard_prebound)
 
 
 # ---------------------------------------------------------------------------
@@ -117,16 +147,25 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+def _frame_bytes(verb: str, name: str = "", payload: bytes = b"") -> bytes:
+    head = json.dumps({"verb": verb, "name": name}).encode()
+    return (_HDR.pack(len(head)) + _HDR.pack(len(payload)) + head +
+            payload)
+
+
 def _send_frame(sock: socket.socket, verb: str, name: str = "",
                 payload: bytes = b""):
-    head = json.dumps({"verb": verb, "name": name}).encode()
-    sock.sendall(_HDR.pack(len(head)) + _HDR.pack(len(payload)) + head +
-                 payload)
+    sock.sendall(_frame_bytes(verb, name, payload))
 
 
 def _recv_frame(sock: socket.socket):
     (hlen,) = _HDR.unpack(_read_exact(sock, 4))
     (plen,) = _HDR.unpack(_read_exact(sock, 4))
+    if hlen > _MAX_HEAD or plen > _MAX_PAYLOAD:
+        raise ValueError(
+            f"frame lengths (head {hlen}, payload {plen}) exceed sanity "
+            f"caps ({_MAX_HEAD}, {_MAX_PAYLOAD}): protocol desync or "
+            "corrupt frame")
     head = json.loads(_read_exact(sock, hlen))
     payload = _read_exact(sock, plen) if plen else b""
     return head["verb"], head["name"], payload
@@ -255,34 +294,57 @@ class VariableServer:
         peer = None
         try:
             while True:
-                verb, name, payload = _recv_frame(conn)
-                if verb == "HELLO":
-                    peer = name
-                    _send_frame(conn, "OK")
-                elif verb == "SEND":
-                    tid = self._trainer_id(peer or "anon")
-                    value = deserialize_var(payload)
-                    if self.sync:
-                        with self._lock:
-                            # per-trainer grad rename (listen_and_serv :82)
-                            self.scope.set_var(f"{name}.trainer_{tid}",
-                                               value)
-                    else:
-                        self._apply_async(name, value)
-                    _send_frame(conn, "OK")
-                elif verb == "BARRIER":
-                    if self.sync:
-                        self._barrier()
-                    _send_frame(conn, "OK")
-                elif verb == "GET":
-                    val = self._blocking_get(name)
-                    _send_frame(conn, "VAR", name, serialize_var(val))
-                elif verb == "STOP":
-                    _send_frame(conn, "OK")
-                    self.stop()
+                try:
+                    verb, name, payload = _recv_frame(conn)
+                except (ValueError, KeyError, TypeError) as e:
+                    # malformed frame (bad lengths / non-JSON head): the
+                    # byte stream is desynced, so this CONNECTION is done,
+                    # but the server must keep serving everyone else — the
+                    # sender reconnects and resends (truncated frames from
+                    # a crashed sender land here as ConnectionError via
+                    # _read_exact and are equally non-fatal)
+                    try:
+                        _send_frame(conn, "ERR", f"malformed frame: {e}")
+                    except OSError:
+                        pass
                     return
-                else:
-                    _send_frame(conn, "ERR", f"unknown verb {verb}")
+                try:
+                    if verb == "HELLO":
+                        peer = name
+                        _send_frame(conn, "OK")
+                    elif verb == "SEND":
+                        tid = self._trainer_id(peer or "anon")
+                        value = deserialize_var(payload)
+                        if self.sync:
+                            with self._lock:
+                                # per-trainer grad rename
+                                # (listen_and_serv :82)
+                                self.scope.set_var(f"{name}.trainer_{tid}",
+                                                   value)
+                        else:
+                            self._apply_async(name, value)
+                        _send_frame(conn, "OK")
+                    elif verb == "BARRIER":
+                        if self.sync:
+                            self._barrier()
+                        _send_frame(conn, "OK")
+                    elif verb == "GET":
+                        val = self._blocking_get(name)
+                        _send_frame(conn, "VAR", name, serialize_var(val))
+                    elif verb == "STOP":
+                        _send_frame(conn, "OK")
+                        self.stop()
+                        return
+                    else:
+                        _send_frame(conn, "ERR", f"unknown verb {verb}")
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:
+                    # a bad REQUEST (undecodable payload, unknown var)
+                    # is the client's error to hear about — killing the
+                    # connection silently left it hanging in recv
+                    _send_frame(conn, "ERR",
+                                f"{type(e).__name__}: {e}")
         except (ConnectionError, OSError):
             pass
         finally:
@@ -521,63 +583,190 @@ class VariableServer:
 # ---------------------------------------------------------------------------
 
 
-class VariableClient:
-    def __init__(self, endpoint: str, client_id: str = "",
-                 connect_timeout: float = 180.0):
-        import os
-        import time
-        import uuid
+class BarrierTimeoutError(TimeoutError):
+    """A BARRIER response did not arrive within barrier_timeout — in
+    sync-SGD fan-in that means some trainer never sent its barrier this
+    round, i.e. a lost/wedged trainer (the reference surfaces this as a
+    gRPC deadline on SendBatchBarrier)."""
 
-        host, port = endpoint.rsplit(":", 1)
-        deadline = time.monotonic() + connect_timeout
+
+class VariableClient:
+    """Trainer-side transport with crash recovery: SEND/GET reconnect and
+    resend through a RetryPolicy (both are idempotent — SEND overwrites
+    this trainer's grad slot, GET is a read), while BARRIER resends only
+    when the write provably never completed (the server counts barrier
+    arrivals, so resending after a lost RESPONSE could double-count a
+    round) and supports a timeout that detects a lost trainer.
+
+    Note async (sync=False) servers apply a SEND on arrival, so a resent
+    grad whose first copy DID land applies twice — inherent to
+    at-least-once delivery over ASGD, which is already tolerant of
+    reordered/duplicated updates; pass retry_policy=None-like
+    max_attempts=1 to forbid it."""
+
+    def __init__(self, endpoint: str, client_id: str = "",
+                 connect_timeout: float = 180.0,
+                 request_timeout: Optional[float] = None,
+                 barrier_timeout: Optional[float] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
+        import os as _os
+        import uuid as _uuid
+
+        self.endpoint = endpoint
+        self._host, port = endpoint.rsplit(":", 1)
+        self._port = int(port)
+        # requests block indefinitely by default: a BARRIER response
+        # legitimately waits for straggler trainers + the first
+        # optimize-program compile (sync-SGD semantics, like the
+        # reference's gRPC client Wait())
+        self.request_timeout = request_timeout
+        self.barrier_timeout = barrier_timeout
+        self._policy = retry_policy or RetryPolicy.from_env(
+            "PSERVER_RETRY", max_attempts=5, base_delay=0.2,
+            max_delay=2.0, deadline=30.0)
+        # process-unique id: id(self) can collide ACROSS processes, which
+        # would alias two trainers to one per-trainer grad slot.  A
+        # reconnect re-HELLOs with the SAME id, so the server keeps
+        # routing this trainer to its original grad slot.
+        self._cid = client_id or f"{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
+        self.sock: Optional[socket.socket] = None
+        self._connect(connect_timeout)
+
+    def _connect(self, connect_timeout: Optional[float] = None):
+        import time as _time
+
+        deadline = _time.monotonic() + (connect_timeout
+                                        if connect_timeout is not None
+                                        else 30.0)
         while True:
             try:
+                fault_injector().fire("pserver.connect")
                 self.sock = socket.create_connection(
-                    (host, int(port)), timeout=5)
+                    (self._host, self._port), timeout=5)
                 break
             except OSError:
                 # server process may still be booting (jax import +
                 # program build); retry until the deadline
-                if time.monotonic() >= deadline:
+                self.sock = None
+                if _time.monotonic() >= deadline:
                     raise
-                time.sleep(0.2)
-        # requests block indefinitely after the handshake: a BARRIER
-        # response legitimately waits for straggler trainers + the first
-        # optimize-program compile (sync-SGD semantics, like the
-        # reference's gRPC client Wait())
+                _time.sleep(0.2)
         self.sock.settimeout(None)
-        # process-unique id: id(self) can collide ACROSS processes, which
-        # would alias two trainers to one per-trainer grad slot
-        cid = client_id or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
-        _send_frame(self.sock, "HELLO", cid)
-        self._expect_ok()
-
-    def _expect_ok(self):
-        verb, _, _ = _recv_frame(self.sock)
+        _send_frame(self.sock, "HELLO", self._cid)
+        verb, name, _ = _recv_frame(self.sock)
         if verb != "OK":
-            raise RuntimeError(f"pserver error: {verb}")
+            raise RuntimeError(f"pserver error: {name or verb}")
+
+    def _drop_sock(self):
+        s, self.sock = self.sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _request(self, verb: str, name: str = "", payload: bytes = b"",
+                 idempotent: bool = True,
+                 timeout: Optional[float] = None):
+        """One framed roundtrip.  Connection-level failures (peer died,
+        truncated frame, request timeout) reconnect + resend when
+        `idempotent`; protocol-level ERR replies raise RuntimeError
+        without retry (retrying a rejected request can't succeed).
+
+        Non-idempotent verbs (BARRIER) still retry failures in the SEND
+        phase — an incomplete write provably never reached the server's
+        frame parser, so the request was not counted — and only
+        propagate failures after the frame was fully handed to the
+        kernel, where "applied but response lost" is indistinguishable
+        from "never arrived"."""
+        timeout = self.request_timeout if timeout is None else timeout
+        state = self._policy.begin()
+        while True:
+            sent = False
+            try:
+                if self.sock is None:
+                    self._connect()
+                fault_injector().fire("pserver.request")
+                frame = _frame_bytes(verb, name, payload)
+                data = fault_injector().mangle("pserver.send", frame)
+                self.sock.settimeout(timeout)
+                try:
+                    self.sock.sendall(data)
+                    if data != frame:
+                        # injected mid-write crash / wire corruption: the
+                        # server got a mangled frame; fail our side like
+                        # the sender process died
+                        raise ConnectionError(
+                            "fault injection: mangled frame")
+                    sent = True
+                    rverb, rname, rpayload = _recv_frame(self.sock)
+                finally:
+                    if self.sock is not None:
+                        self.sock.settimeout(None)
+                if rverb == "ERR":
+                    if rname.startswith("malformed frame"):
+                        # the server is closing this desynced connection;
+                        # for idempotent requests a fresh connection +
+                        # resend is the recovery path
+                        raise ConnectionError(
+                            f"pserver rejected frame: {rname}")
+                    raise RuntimeError(f"pserver error: {rname}")
+                return rverb, rname, rpayload
+            except (ConnectionError, OSError,  # incl. timeouts
+                    ValueError, KeyError, TypeError) as e:
+                # the Value/Key/TypeError arm is a malformed RESPONSE
+                # (corrupt lengths / non-JSON head): the stream is
+                # desynced, so the socket must be dropped either way —
+                # reusing it would parse garbage as the next frame header
+                timed_out = isinstance(e, (socket.timeout, TimeoutError))
+                self._drop_sock()
+                if not idempotent and sent:
+                    raise
+                state.record(e, what=(f"pserver {self.endpoint}: "
+                                      f"{verb} {name}".rstrip()))
+                if timed_out and timeout is not None:
+                    # the deadline already consumed the patience budget
+                    state._next_delay = 0.0
+                state.sleep()
 
     def send_var(self, name: str, value):
-        _send_frame(self.sock, "SEND", name, serialize_var(value))
-        self._expect_ok()
+        rverb, _, _ = self._request("SEND", name, serialize_var(value))
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error sending {name!r}: {rverb}")
 
-    def send_batch_barrier(self):
-        _send_frame(self.sock, "BARRIER")
-        self._expect_ok()
+    def send_batch_barrier(self, timeout: Optional[float] = None):
+        """Sync-round barrier.  `timeout` (or the instance-level
+        barrier_timeout) bounds the wait; expiry raises
+        BarrierTimeoutError — the sync-SGD signature of a trainer that
+        died before barriering this round."""
+        timeout = self.barrier_timeout if timeout is None else timeout
+        try:
+            rverb, _, _ = self._request("BARRIER", idempotent=False,
+                                        timeout=timeout)
+        except (socket.timeout, TimeoutError) as e:
+            raise BarrierTimeoutError(
+                f"pserver {self.endpoint}: no barrier release within "
+                f"{timeout}s — a trainer in this round is lost or "
+                "wedged") from e
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error at barrier: {rverb}")
 
     def get_var(self, name: str):
-        _send_frame(self.sock, "GET", name)
-        verb, got_name, payload = _recv_frame(self.sock)
-        if verb != "VAR":
-            raise RuntimeError(f"pserver error fetching {name!r}")
-        return deserialize_var(payload)
+        rverb, _, rpayload = self._request("GET", name)
+        if rverb != "VAR":
+            raise RuntimeError(f"pserver error fetching {name!r}: {rverb}")
+        return deserialize_var(rpayload)
 
     def stop_server(self):
-        _send_frame(self.sock, "STOP")
-        self._expect_ok()
+        rverb, _, _ = self._request("STOP", idempotent=False)
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error on stop: {rverb}")
 
     def close(self):
+        self._drop_sock()
+
+    def __del__(self):
         try:
-            self.sock.close()
-        except OSError:
+            self._drop_sock()
+        except Exception:
             pass

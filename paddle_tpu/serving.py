@@ -35,7 +35,21 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["InferenceServer"]
+from .core.resilience import fault_injector
+
+__all__ = ["InferenceServer", "ServerSaturated", "RequestDeadlineExceeded"]
+
+
+class ServerSaturated(RuntimeError):
+    """The batching queue is full — graceful backpressure: the caller
+    should shed load or retry later, instead of blocking unboundedly
+    behind a stalled worker (subclasses RuntimeError so pre-existing
+    handlers keep working)."""
+
+
+class RequestDeadlineExceeded(TimeoutError):
+    """A request's deadline expired while it sat in the batching queue;
+    the server drops it without spending device time on it."""
 
 
 class InferenceServer:
@@ -107,9 +121,13 @@ class InferenceServer:
         self._worker.start()
 
     # -- client side --------------------------------------------------------
-    def submit(self, x) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request ([C,H,W] or [1,C,H,W]); returns a Future
-        resolving to the [1, ...] fetch for this request."""
+        resolving to the [1, ...] fetch for this request.  With
+        `deadline_ms`, a request still queued when the deadline passes is
+        failed with RequestDeadlineExceeded instead of occupying a batch
+        slot (load-shedding under overload); a saturated queue raises
+        ServerSaturated immediately."""
         x = np.asarray(x, self._dtype)
         if x.shape == self._item_shape:
             x = x[None]
@@ -117,6 +135,8 @@ class InferenceServer:
             raise ValueError(
                 f"request shape {x.shape} != (1,)+{self._item_shape}")
         fut: Future = Future()
+        expires = (time.monotonic() + deadline_ms / 1000.0
+                   if deadline_ms is not None else None)
         with self._submit_lock:
             if self._stop:
                 raise RuntimeError("InferenceServer is closed")
@@ -125,17 +145,18 @@ class InferenceServer:
                 # full queue (worker stalled) would wedge every submitter
                 # on the lock and deadlock close(), whose failure-drain
                 # path needs the same lock
-                self._q.put_nowait((x, fut))
+                self._q.put_nowait((x, fut, expires))
             except queue.Full:
-                raise RuntimeError(
+                raise ServerSaturated(
                     "InferenceServer queue full "
                     f"({self._q.maxsize} pending) — backpressure: retry "
                     "later or raise max_queue") from None
         return fut
 
-    def infer(self, x):
-        """Synchronous single request."""
-        return np.asarray(self.submit(x).result())
+    def infer(self, x, timeout: Optional[float] = None):
+        """Synchronous single request (`timeout` in seconds bounds the
+        wait for the result)."""
+        return np.asarray(self.submit(x).result(timeout))
 
     def stats(self) -> Dict[str, int]:
         """{'requests': N, 'dispatches': M} — M < N shows aggregation."""
@@ -150,18 +171,31 @@ class InferenceServer:
         # callers blocked in fut.result() forever
         while True:
             try:
-                _, fut = self._q.get_nowait()
+                _, fut, _ = self._q.get_nowait()
             except queue.Empty:
                 break
             fut.set_exception(RuntimeError("InferenceServer closed"))
 
     # -- worker -------------------------------------------------------------
+    def _expired(self, item) -> bool:
+        """Shed a dead request at dequeue time: resolving its future with
+        the deadline error costs nothing; batching it would spend a batch
+        slot (and possibly a bigger bucket) on an answer nobody awaits."""
+        _, fut, expires = item
+        if expires is None or time.monotonic() < expires:
+            return False
+        _deliver(fut, exception=RequestDeadlineExceeded(
+            "request deadline expired while queued"))
+        return True
+
     def _take_batch(self):
         """Block for the first request, then coalesce whatever arrives
         within the window, capped at the largest bucket."""
         try:
             first = self._q.get(timeout=0.05)
         except queue.Empty:
+            return []
+        if self._expired(first):
             return []
         batch = [first]
         cap = self._buckets[-1]
@@ -171,9 +205,11 @@ class InferenceServer:
             if remain <= 0 and self._q.empty():
                 break
             try:
-                batch.append(self._q.get(timeout=max(remain, 0)))
+                item = self._q.get(timeout=max(remain, 0))
             except queue.Empty:
                 break
+            if not self._expired(item):
+                batch.append(item)
         return batch
 
     def _loop(self):
@@ -182,6 +218,15 @@ class InferenceServer:
         while not self._stop:
             batch = self._take_batch()
             if not batch:
+                continue
+            # chaos hook: delay rules here back the queue up, which is
+            # how the saturation/deadline tests create overload; an
+            # error rule fails this batch but must not kill the worker
+            try:
+                fault_injector().fire("serving.dispatch")
+            except Exception as e:
+                for _, fut, _ in batch:
+                    _deliver(fut, exception=e)
                 continue
             n = len(batch)
             bucket = next(b for b in self._buckets if b >= n)
@@ -196,12 +241,12 @@ class InferenceServer:
                 out = self._compiled[bucket](
                     {self._feed_name: staged}, self._states)
             except Exception as e:  # deliver, don't kill the loop
-                for _, fut in batch:
+                for _, fut, _ in batch:
                     _deliver(fut, exception=e)
                 continue
             self._dispatches += 1
             self._requests += n
-            for i, (_, fut) in enumerate(batch):
+            for i, (_, fut, _) in enumerate(batch):
                 _deliver(fut, result=out[i:i + 1])
 
 

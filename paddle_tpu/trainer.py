@@ -95,51 +95,106 @@ class Trainer:
               feeder: Optional[DataFeeder] = None,
               checkpoint_dir: Optional[str] = None,
               checkpoint_every_n_passes: int = 1,
-              checkpoint_max_keep: int = 3):
+              checkpoint_max_keep: int = 3,
+              checkpoint_every_n_iters: int = 0,
+              resume_from: Optional[str] = None):
         """reader: batch reader (yields lists of samples per batch).
 
         With `checkpoint_dir`, resumes from the newest valid snapshot there
-        (params + optimizer state + the pass counter travel in the snapshot
-        meta) and saves a snapshot every `checkpoint_every_n_passes`
-        (<= 0 disables saving) —
+        (params + optimizer state + the pass/batch/step cursor travel in
+        the snapshot meta) and saves a snapshot every
+        `checkpoint_every_n_passes` (<= 0 disables saving) —
         the trainer-side analogue of the Go pserver's periodic checkpoint
         (go/pserver/service.go:120-203) and the book_distribute scripts'
-        per-pass save."""
+        per-pass save.
+
+        Auto-resume mode: `checkpoint_every_n_iters > 0` additionally
+        snapshots every N iterations, and `resume_from=dir` restores
+        params + the global step from the newest valid snapshot there and
+        CONTINUES THE PASS it died in (already-trained batches of that
+        pass are fast-forwarded, relying on the deterministic reader) —
+        so a trainer killed at iteration k and restarted under a
+        supervisor finishes with the same step count and params as an
+        uninterrupted run.  `resume_from` doubles as the save target when
+        `checkpoint_dir` is not given.  The running step count is exposed
+        as `self.step`."""
         from . import io
+        from .core.resilience import fault_injector
 
         self.start()
         event_handler = event_handler or (lambda e: None)
         feeder = feeder or self._feeder()
         fetches = [self.loss] + self.fetch_list
-        first_pass = 0
-        if checkpoint_dir is not None:
-            meta = io.load_checkpoint(self.exe, checkpoint_dir,
+        if resume_from is not None and checkpoint_dir is None:
+            checkpoint_dir = resume_from
+        first_pass, skip_batches = 0, 0
+        self.step = int(getattr(self, "step", 0))
+        load_dir = resume_from if resume_from is not None else checkpoint_dir
+        if load_dir is not None:
+            meta = io.load_checkpoint(self.exe, load_dir,
                                       main_program=self.main_program)
             if meta is not None:
-                first_pass = int(
-                    meta["trainer_args"].get("next_pass_id", 0))
+                args = meta["trainer_args"]
+                first_pass = int(args.get("next_pass_id", 0))
+                skip_batches = int(args.get("next_batch_id", 0))
+                self.step = int(args.get("step", self.step))
+
+        def _save(next_pass_id, next_batch_id):
+            io.save_checkpoint(
+                self.exe, checkpoint_dir,
+                main_program=self.main_program,
+                trainer_args={"next_pass_id": next_pass_id,
+                              "next_batch_id": next_batch_id,
+                              "step": self.step},
+                max_keep=checkpoint_max_keep)
+
         for pass_id in range(first_pass, num_passes):
-            event_handler(BeginPass(pass_id))
+            # in a resumed pass, BeginPass fires only once a batch
+            # actually trains: a snapshot taken at the pass's final batch
+            # would otherwise replay the whole pass as skips and emit a
+            # duplicate BeginPass/EndPass pair (the latter with NaN cost)
+            resuming = skip_batches > 0
+            trained = False
+            if not resuming:
+                event_handler(BeginPass(pass_id))
             pass_costs = []
             for batch_id, batch in enumerate(reader()):
+                if skip_batches > 0:
+                    # resumed mid-pass: the snapshot already carries the
+                    # effect of these batches; replay the reader past
+                    # them without training
+                    skip_batches -= 1
+                    continue
+                if resuming and not trained:
+                    event_handler(BeginPass(pass_id))
+                trained = True
+                # chaos hook: auto-resume tests kill the trainer here
+                fault_injector().fire("trainer.iteration")
                 event_handler(BeginIteration(pass_id, batch_id))
                 outs = self.exe.run(self.main_program,
                                     feed=feeder.feed(batch),
                                     fetch_list=fetches)
                 cost = float(np.asarray(outs[0]).reshape(-1)[0])
                 pass_costs.append(cost)
+                self.step += 1
                 event_handler(EndIteration(pass_id, batch_id, cost,
                                            metrics=outs[1:]))
+                if checkpoint_dir is not None \
+                        and checkpoint_every_n_iters > 0 \
+                        and self.step % checkpoint_every_n_iters == 0:
+                    _save(pass_id, batch_id + 1)
+            skip_batches = 0
+            if resuming and not trained:
+                # the snapshot was taken AT the pass boundary: this pass
+                # is already complete, so no events and no redundant
+                # checkpoint for it — move straight to the next pass
+                continue
             event_handler(EndPass(pass_id, metrics={
                 "avg_cost": float(np.mean(pass_costs)) if pass_costs
                 else float("nan")}))
             if checkpoint_dir is not None and checkpoint_every_n_passes > 0 \
                     and (pass_id + 1) % checkpoint_every_n_passes == 0:
-                io.save_checkpoint(
-                    self.exe, checkpoint_dir,
-                    main_program=self.main_program,
-                    trainer_args={"next_pass_id": pass_id + 1},
-                    max_keep=checkpoint_max_keep)
+                _save(pass_id + 1, 0)
 
     def test(self, reader: Callable, feeder: Optional[DataFeeder] = None,
              fetch_list: Optional[Sequence] = None):

@@ -32,6 +32,7 @@ def _fresh_state():
     import paddle_tpu as fluid
     from paddle_tpu.core import executor as executor_mod
     from paddle_tpu.core import framework as fw
+    from paddle_tpu.core.resilience import fault_injector
     from paddle_tpu.core.scope import Scope
 
     old_main = fw.switch_main_program(fluid.Program())
@@ -40,6 +41,9 @@ def _fresh_state():
     old_scope = executor_mod._global_scope
     executor_mod._global_scope = Scope()
     yield
+    # a chaos test that failed mid-run must not leak armed faults into
+    # unrelated tests
+    fault_injector().clear()
     fw.switch_main_program(old_main)
     fw.switch_startup_program(old_startup)
     executor_mod._global_scope = old_scope
@@ -51,3 +55,11 @@ def pytest_configure(config):
         "slow: long-running test (book training flows, subprocess "
         "clusters). Fast subset: pytest -m 'not slow' runs in ~1/3 the "
         "wall time (6:22 vs 18:41 measured); CI runs the full suite.")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection test (core/resilience FaultInjector "
+        "driving socket drops, truncated frames, corrupt snapshots, "
+        "killed trainers). Socket-level single-process cases are fast "
+        "and run in tier-1; process-kill scenarios are also marked slow. "
+        "Run just the chaos suite: pytest tests/test_resilience.py "
+        "-m chaos")

@@ -1,0 +1,870 @@
+"""Resilience-layer chaos suite: RetryPolicy/FaultInjector units, then
+fault-injected runs of every networked/durable subsystem.
+
+Failure model under test (docs/resilience.md): connection-level failures
+are retried under exponential backoff; truncated/corrupt frames fail the
+sender's connection and never kill a server loop; corrupt snapshots are
+skipped in favor of the newest md5-valid one; a killed trainer resumes
+from its last periodic checkpoint and converges to the same final state.
+"""
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.resilience import (
+    FaultError,
+    FaultInjector,
+    RetryError,
+    RetryPolicy,
+    fault_injector,
+)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_backoff_sequence_and_cap(self):
+        p = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=0.8,
+                        multiplier=2.0, jitter=0.0)
+        assert [round(p.delay(n), 3) for n in range(1, 6)] == [
+            0.1, 0.2, 0.4, 0.8, 0.8]
+
+    def test_jitter_bounds(self):
+        p = RetryPolicy(base_delay=1.0, max_delay=10.0, multiplier=1.0,
+                        jitter=0.25)
+        for _ in range(50):
+            assert 0.75 <= p.delay(1) <= 1.25
+
+    def test_call_retries_then_succeeds(self):
+        sleeps = []
+        p = RetryPolicy(max_attempts=5, base_delay=0.1, multiplier=2.0,
+                        jitter=0.0, deadline=None, sleep=sleeps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert p.call(flaky, what="flaky op") == "ok"
+        assert calls["n"] == 3
+        assert [round(s, 3) for s in sleeps] == [0.1, 0.2]
+
+    def test_exhaustion_reports_attempts_and_elapsed(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0,
+                        deadline=None, sleep=lambda s: None)
+        with pytest.raises(RetryError) as ei:
+            p.call(lambda: (_ for _ in ()).throw(OSError("down")),
+                   what="peer unreachable")
+        err = ei.value
+        assert isinstance(err, OSError)  # existing handlers keep working
+        assert err.attempts == 3
+        assert "3 attempts" in str(err) and "over" in str(err)
+        assert "down" in str(err)
+        assert isinstance(err.last_error, OSError)
+
+    def test_deadline_bounds_the_sequence(self):
+        t = [0.0]
+        p = RetryPolicy(max_attempts=None, base_delay=0.4, multiplier=1.0,
+                        jitter=0.0, deadline=1.0,
+                        sleep=lambda s: t.__setitem__(0, t[0] + s),
+                        clock=lambda: t[0])
+        with pytest.raises(RetryError) as ei:
+            p.call(lambda: (_ for _ in ()).throw(OSError("x")), what="op")
+        # attempts at t=0, 0.4, 0.8; a fourth would start past the deadline
+        assert ei.value.attempts == 3
+
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_MASTER_RETRY_MAX_ATTEMPTS", "2")
+        monkeypatch.setenv("PADDLE_TPU_RETRY_BASE_DELAY", "0.5")
+        p = RetryPolicy.from_env("MASTER_RETRY", max_attempts=50,
+                                 base_delay=0.2, deadline=30.0)
+        assert p.max_attempts == 2  # specific prefix wins
+        assert p.base_delay == 0.5  # generic RETRY fallback applies
+        assert p.deadline == 30.0   # untouched default survives
+
+    def test_from_env_none_and_empty_are_safe(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_RETRY_MULTIPLIER", "none")
+        monkeypatch.setenv("PADDLE_TPU_RETRY_DEADLINE", "none")
+        monkeypatch.setenv("PADDLE_TPU_RETRY_MAX_ATTEMPTS", "")
+        p = RetryPolicy.from_env("MASTER_RETRY", max_attempts=7,
+                                 deadline=30.0)
+        assert p.multiplier == 2.0   # "none" meaningless here: default
+        assert p.deadline is None    # cap-style knob: disableable
+        assert p.max_attempts == 7   # empty string counts as unset
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_fail_nth_call(self):
+        inj = FaultInjector()
+        inj.inject("x.y", "error", nth=2)
+        inj.fire("x.y")  # call 1: clean
+        with pytest.raises(FaultError):
+            inj.fire("x.y")  # call 2: boom
+        inj.fire("x.y")  # call 3: clean again
+
+    def test_count_window_and_custom_exc(self):
+        inj = FaultInjector()
+        inj.inject("s", "error", nth=1, count=2, exc=RuntimeError("boom"))
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="boom"):
+                inj.fire("s")
+        inj.fire("s")
+
+    def test_delay(self):
+        inj = FaultInjector()
+        inj.inject("s", "delay", delay_s=0.05)
+        t0 = time.monotonic()
+        inj.fire("s")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_truncate_and_corrupt(self):
+        inj = FaultInjector()
+        inj.inject("t", "truncate")
+        assert inj.mangle("t", b"abcdef") == b"abc"
+        inj.inject("t2", "truncate", arg=2)
+        assert inj.mangle("t2", b"abcdef") == b"ab"
+        inj.inject("c", "corrupt")
+        data = b"abcdef"
+        out = inj.mangle("c", data)
+        assert len(out) == len(data) and out != data
+
+    def test_site_patterns(self):
+        inj = FaultInjector()
+        inj.inject("pserver.*", "error")
+        with pytest.raises(FaultError):
+            inj.fire("pserver.send")
+
+    def test_env_spec(self):
+        inj = FaultInjector()
+        inj.load_env("a.b:error:2:3, c:truncate")
+        rules = inj.rules()
+        assert [(r.site, r.kind, r.nth, r.count) for r in rules] == [
+            ("a.b", "error", 2, 3), ("c", "truncate", 1, 1)]
+        with pytest.raises(ValueError):
+            inj.load_env("nokind")
+        with pytest.raises(ValueError):
+            FaultInjector().inject("s", "explode")
+
+    def test_env_spec_args(self):
+        inj = FaultInjector()
+        inj.load_env("s:delay:1:2:0.25,t:truncate:1:1:3,c:corrupt")
+        delay, trunc, corrupt = inj.rules()
+        assert delay.kind == "delay" and delay.delay_s == 0.25
+        assert delay.count == 2
+        assert trunc.arg == 3
+        assert corrupt.arg is None
+        # a delay with no seconds would be a silent no-op: rejected
+        with pytest.raises(ValueError, match="delay needs"):
+            FaultInjector().load_env("s:delay:1")
+
+    def test_singleton_clear(self):
+        inj = fault_injector()
+        inj.inject("q", "error")
+        assert inj.rules()
+        inj.clear()
+        assert not inj.rules()
+        inj.fire("q")  # disarmed
+
+
+# ---------------------------------------------------------------------------
+# MasterClient under chaos
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.chaos
+class TestMasterChaos:
+    def test_roundtrips_survive_drop_and_truncation(self):
+        from paddle_tpu.cloud import Master, MasterClient
+
+        m = Master(failure_max=3, timeout_s=60)
+        port = m.serve(0)
+        inj = fault_injector()
+        inj.clear()
+        # first connection attempt dies; later, one request frame is cut
+        # mid-write (sender-crash model) — both must be absorbed
+        inj.inject("master.connect", "error", nth=1)
+        inj.inject("master.send", "truncate", nth=2)
+        cl = MasterClient(f"127.0.0.1:{port}", retry_interval=0.01)
+        try:
+            assert cl.set_dataset(["c0", "c1", "c2"], 1)
+            tid, chunks = cl.get_task()  # this frame was the truncated one
+            assert chunks and chunks[0] in ("c0", "c1", "c2")
+            assert cl.task_finished(tid)
+            info = cl.info()
+            assert info["done"] == 1
+            assert inj.rules()[0].fired == 1
+            assert inj.rules()[1].fired == 1
+        finally:
+            inj.clear()
+            cl.close()
+            m.stop()
+
+    def test_corrupted_frame_is_retried_clean(self):
+        from paddle_tpu.cloud import Master, MasterClient
+
+        m = Master(failure_max=3, timeout_s=60)
+        port = m.serve(0)
+        inj = fault_injector()
+        inj.clear()
+        inj.inject("master.send", "corrupt", nth=1)
+        cl = MasterClient(f"127.0.0.1:{port}", retry_interval=0.01)
+        try:
+            info = cl.info()  # 1st frame corrupted on the wire -> resent
+            assert set(info) == {"todo", "pending", "done", "discarded",
+                                 "pass"}
+            assert inj.rules()[0].fired == 1
+        finally:
+            inj.clear()
+            cl.close()
+            m.stop()
+
+    def test_unreachable_error_carries_attempts_and_elapsed(self):
+        from paddle_tpu.cloud import MasterClient
+
+        port = _free_port()  # nothing listens here
+        cl = MasterClient(
+            f"127.0.0.1:{port}",
+            retry_policy=RetryPolicy(max_attempts=3, base_delay=0.01,
+                                     jitter=0.0, deadline=None))
+        with pytest.raises(OSError, match="3 attempts") as ei:
+            cl.info()
+        assert "unreachable" in str(ei.value)
+        assert ei.value.attempts == 3
+        cl.close()
+
+    def test_legacy_kwargs_map_onto_policy(self):
+        from paddle_tpu.cloud import MasterClient
+
+        cl = MasterClient("127.0.0.1:1", retry_interval=0.05, timeout=7.0)
+        assert cl.policy.base_delay == 0.05
+        assert cl.policy.deadline == 7.0
+        cl.close()
+
+    def test_teardown_after_server_death(self):
+        from paddle_tpu.cloud import Master, MasterClient
+
+        m = Master(failure_max=3, timeout_s=60)
+        port = m.serve(0)
+        cl = MasterClient(
+            f"127.0.0.1:{port}",
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.01,
+                                     jitter=0.0, deadline=None))
+        cl.set_dataset(["a"])
+        cl.close()  # drop our conn so the server's join can't wedge
+        m.stop()
+        with pytest.raises(OSError):
+            cl.info()  # server is gone; fails fast, no hang
+        cl.close()  # idempotent, never raises
+        cl.close()
+        del m  # double-teardown (stop + destructor) must be clean
+
+
+# ---------------------------------------------------------------------------
+# task_record_reader failure path (nack -> re-dispatch -> discard)
+# ---------------------------------------------------------------------------
+
+
+class TestTaskRecordReaderFailure:
+    def test_midchunk_error_nacks_and_second_reader_completes(self):
+        from paddle_tpu.cloud import Master, task_record_reader
+
+        m = Master(failure_max=2, timeout_s=60)
+        m.set_dataset(["a", "b"])
+
+        def bad_chunk_reader(chunk):
+            yield chunk + "0"
+            if chunk == "a":
+                raise RuntimeError("disk error mid-chunk")
+            yield chunk + "1"
+
+        with pytest.raises(RuntimeError, match="mid-chunk"):
+            list(task_record_reader(m, bad_chunk_reader)())
+        c = m.counts()
+        assert c["pending"] == 0  # the failed task was nacked, not leaked
+        assert c["todo"] >= 1     # and went back for re-dispatch
+
+        # a second (healthy) reader picks up the re-dispatched task
+        records = list(task_record_reader(
+            m, lambda ch: [ch + "0", ch + "1"])())
+        assert "a0" in records and "a1" in records
+        c = m.counts()
+        assert c["done"] == 2 and c["discarded"] == 0
+
+    def test_failure_max_discards_and_counts(self):
+        from paddle_tpu.cloud import Master, task_record_reader
+
+        m = Master(failure_max=1, timeout_s=60)
+        m.set_dataset(["a", "b"])
+
+        def poisoned(chunk):
+            if chunk == "a":
+                raise RuntimeError("poisoned chunk")
+            return [chunk + "0"]
+
+        # skip mode: one surviving reader nacks the poisoned task until
+        # the master discards it (failure_max exceeded) and still
+        # finishes the pass on the healthy chunks
+        records = list(task_record_reader(
+            m, poisoned, on_chunk_error="skip")())
+        assert records == ["b0"]
+        c = m.counts()
+        assert c["discarded"] == 1
+        assert c["done"] == 1
+        assert c["todo"] == 0 and c["pending"] == 0
+
+    def test_on_chunk_error_validated(self):
+        from paddle_tpu.cloud import task_record_reader
+
+        with pytest.raises(ValueError):
+            task_record_reader(None, lambda c: [], on_chunk_error="nope")
+
+
+# ---------------------------------------------------------------------------
+# VariableClient / VariableServer under chaos
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestPserverChaos:
+    def _server(self, **kw):
+        from paddle_tpu.parallel.pserver import VariableServer
+
+        scope = fluid.Scope()
+        srv = VariableServer(None, scope, None, **kw)
+        port = srv.serve(0)
+        return srv, scope, port
+
+    def test_send_survives_drop_and_truncated_frame(self):
+        from paddle_tpu.parallel.pserver import VariableClient
+
+        srv, scope, port = self._server()
+        inj = fault_injector()
+        inj.clear()
+        inj.inject("pserver.connect", "error", nth=1)
+        cl = VariableClient(f"127.0.0.1:{port}", connect_timeout=10,
+                            retry_policy=RetryPolicy(
+                                max_attempts=4, base_delay=0.01,
+                                jitter=0.0, deadline=None))
+        try:
+            # next outgoing request frame is cut mid-write; the server
+            # must shrug it off and the client reconnect + resend
+            inj.inject("pserver.send", "truncate", nth=1)
+            w = np.arange(4, dtype=np.float32)
+            cl.send_var("w", w)
+            cl.send_batch_barrier()  # fan_in=1: sums w.trainer_0 -> w
+            got = cl.get_var("w")
+            np.testing.assert_array_equal(np.asarray(got), w)
+            assert [r.fired for r in inj.rules()] == [1, 1]
+        finally:
+            inj.clear()
+            cl.close()
+            srv.stop()
+
+    def test_corrupted_send_is_resent(self):
+        from paddle_tpu.parallel.pserver import VariableClient
+
+        srv, scope, port = self._server()
+        cl = VariableClient(f"127.0.0.1:{port}", connect_timeout=10,
+                            retry_policy=RetryPolicy(
+                                max_attempts=4, base_delay=0.01,
+                                jitter=0.0, deadline=None))
+        inj = fault_injector()
+        inj.clear()
+        inj.inject("pserver.send", "corrupt", nth=1)
+        try:
+            w = np.arange(5, dtype=np.float32)
+            cl.send_var("w", w)  # corrupted on the wire -> reconnect+resend
+            cl.send_batch_barrier()
+            np.testing.assert_array_equal(np.asarray(cl.get_var("w")), w)
+            assert inj.rules()[0].fired == 1
+        finally:
+            inj.clear()
+            cl.close()
+            srv.stop()
+
+    def test_malformed_frames_do_not_kill_the_server(self):
+        from paddle_tpu.parallel.pserver import VariableClient
+
+        srv, scope, port = self._server()
+        scope.set_var("w", np.ones(3, np.float32))
+        try:
+            # garbage header length (would block forever reading bytes
+            # that never come if unchecked)
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.sendall(struct.pack("<I", 0xFFFFFF00) + struct.pack("<I", 0))
+            s.settimeout(2)
+            s.recv(1 << 16)  # ERR frame and/or EOF — must not hang
+            s.close()
+            # garbage payload length with a sane header
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.sendall(struct.pack("<I", 2) + struct.pack("<I", 0xFFFFFFF0))
+            s.settimeout(2)
+            s.recv(1 << 16)
+            s.close()
+            # non-JSON head
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.sendall(struct.pack("<I", 5) + struct.pack("<I", 0) +
+                      b"notjs")
+            s.settimeout(2)
+            s.recv(1 << 16)
+            s.close()
+            # the accept loop is still alive: a real client works
+            cl = VariableClient(f"127.0.0.1:{port}", connect_timeout=10)
+            np.testing.assert_array_equal(
+                np.asarray(cl.get_var("w")), np.ones(3, np.float32))
+            cl.close()
+        finally:
+            srv.stop()
+
+    def test_bad_request_gets_err_reply_and_conn_survives(self):
+        from paddle_tpu.parallel.pserver import VariableClient
+
+        srv, scope, port = self._server()
+        scope.set_var("w", np.ones(2, np.float32))
+        cl = VariableClient(f"127.0.0.1:{port}", connect_timeout=10)
+        try:
+            with pytest.raises(RuntimeError, match="pserver error"):
+                cl.get_var("no_such_var")  # used to kill the connection
+            # same connection still serves good requests
+            np.testing.assert_array_equal(
+                np.asarray(cl.get_var("w")), np.ones(2, np.float32))
+        finally:
+            cl.close()
+            srv.stop()
+
+    def test_malformed_response_triggers_reconnect_resend(self):
+        """A desynced RESPONSE stream (corrupt frame lengths from the
+        server side) must drop the socket and retry, mirroring the
+        server-side malformed-frame hardening."""
+        from paddle_tpu.parallel.pserver import (
+            VariableClient,
+            _recv_frame,
+            _send_frame,
+            serialize_var,
+        )
+
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(4)
+        port = lst.getsockname()[1]
+        conns = []
+
+        def fake_server():
+            while True:
+                try:
+                    c, _ = lst.accept()
+                except OSError:
+                    return
+                conns.append(c)
+                try:
+                    _recv_frame(c)  # HELLO
+                    _send_frame(c, "OK")
+                    name = _recv_frame(c)[1]  # the GET
+                    if len(conns) == 1:
+                        # garbage response: absurd frame lengths
+                        c.sendall(struct.pack("<I", 0xFFFFFFF0) * 2)
+                        c.close()
+                    else:
+                        _send_frame(c, "VAR", name,
+                                    serialize_var(np.ones(2, np.float32)))
+                except Exception:
+                    c.close()
+
+        t = threading.Thread(target=fake_server, daemon=True)
+        t.start()
+        cl = VariableClient(f"127.0.0.1:{port}", connect_timeout=5,
+                            retry_policy=RetryPolicy(
+                                max_attempts=3, base_delay=0.01,
+                                jitter=0.0, deadline=None))
+        try:
+            np.testing.assert_array_equal(
+                np.asarray(cl.get_var("w")), np.ones(2, np.float32))
+            assert len(conns) == 2  # reconnected after the garbage reply
+        finally:
+            cl.close()
+            lst.close()
+
+    def test_barrier_timeout_detects_lost_trainer(self):
+        from paddle_tpu.parallel.pserver import (
+            BarrierTimeoutError,
+            VariableClient,
+        )
+
+        srv, scope, port = self._server(fan_in=2)  # peer never shows up
+        cl = VariableClient(f"127.0.0.1:{port}", connect_timeout=10)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(BarrierTimeoutError, match="lost"):
+                cl.send_batch_barrier(timeout=0.3)
+            assert time.monotonic() - t0 < 5
+        finally:
+            cl.close()
+            srv.stop()
+
+    def test_prebound_sockets_are_released(self):
+        from paddle_tpu.parallel import pserver as ps
+
+        ep = ps.prebind_endpoint()
+        port = int(ep.rsplit(":", 1)[1])
+        assert port in ps._prebound
+        ps.discard_prebound(ep)
+        assert port not in ps._prebound
+        ps.discard_prebound(ep)  # idempotent
+        # bulk form (the atexit hook) drains everything left behind
+        ps.prebind_endpoint()
+        ps.prebind_endpoint()
+        ps.discard_prebound()
+        assert not ps._prebound
+
+
+# ---------------------------------------------------------------------------
+# checkpoint corruption fallback + trainer auto-resume
+# ---------------------------------------------------------------------------
+
+
+def _linear_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+    return main, startup, x, y, loss
+
+
+def _batches(n_batches=4, batch_size=2):
+    r = np.random.RandomState(0)
+    data = [(r.rand(4).astype(np.float32), r.rand(1).astype(np.float32))
+            for _ in range(n_batches * batch_size)]
+
+    def reader():
+        for i in range(0, len(data), batch_size):
+            yield data[i:i + batch_size]
+
+    return reader
+
+
+def _persistable_values(program):
+    scope = fluid.global_scope()
+    return {v.name: np.asarray(scope.find_var(v.name)).copy()
+            for v in program.list_vars()
+            if v.persistable and scope.find_var(v.name) is not None}
+
+
+class TestCheckpointCorruptionFallback:
+    def test_corrupt_latest_falls_back_to_previous_valid_uuid(
+            self, tmp_path):
+        from paddle_tpu import io as pio
+        from paddle_tpu import trainer as trainer_mod
+
+        main, startup, x, y, loss = _linear_model()
+        t = trainer_mod.Trainer(loss, optimizer=fluid.SGD(0.1),
+                                feed_list=[x, y], main_program=main,
+                                startup_program=startup)
+        t.train(2, _batches(), checkpoint_dir=str(tmp_path),
+                checkpoint_every_n_passes=0, checkpoint_every_n_iters=2)
+        assert t.step == 8  # snapshots at steps 2,4,6,8
+        with open(os.path.join(str(tmp_path), pio.LATEST_FILENAME)) as f:
+            latest_uuid = f.read().strip()
+        cp_dir = os.path.join(str(tmp_path),
+                              f"{pio.CHECKPOINT_PREFIX}_{latest_uuid}")
+        victim = [n for n in os.listdir(cp_dir) if not n.startswith("__")][0]
+        with open(os.path.join(cp_dir, victim), "ab") as f:
+            f.write(b"bitrot")
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.warns(RuntimeWarning, match="md5"):
+            meta = pio.load_checkpoint(exe, str(tmp_path),
+                                       main_program=main)
+        assert meta is not None
+        assert meta["uuid"] != latest_uuid  # previous valid uuid won
+        assert int(meta["trainer_args"]["step"]) == 6
+
+
+@pytest.mark.chaos
+class TestTrainerAutoResume:
+    def test_killed_trainer_resumes_to_identical_final_state(
+            self, tmp_path):
+        from paddle_tpu import trainer as trainer_mod
+
+        main, startup, x, y, loss = _linear_model()
+        reader = _batches(n_batches=4)
+
+        # reference: uninterrupted 3-pass run (12 steps)
+        t_ref = trainer_mod.Trainer(loss, optimizer=fluid.SGD(0.1),
+                                    feed_list=[x, y], main_program=main,
+                                    startup_program=startup)
+        t_ref.train(3, reader)
+        assert t_ref.step == 12
+        ref_params = _persistable_values(main)
+
+        # chaos run: killed at its 6th iteration (5 steps done,
+        # snapshot on disk at step 4)
+        inj = fault_injector()
+        inj.clear()
+        inj.inject("trainer.iteration", "error", nth=6,
+                   exc=RuntimeError("SIGKILL stand-in"))
+        t_crash = trainer_mod.Trainer(loss, feed_list=[x, y],
+                                      main_program=main,
+                                      startup_program=startup)
+        with pytest.raises(RuntimeError, match="SIGKILL"):
+            t_crash.train(3, reader, resume_from=str(tmp_path),
+                          checkpoint_every_n_passes=0,
+                          checkpoint_every_n_iters=2)
+        inj.clear()
+        assert t_crash.step == 5
+
+        # supervised restart: resumes params+step from the snapshot,
+        # fast-forwards the finished batches of the interrupted pass,
+        # finishes with the reference's step count and params
+        ends = []
+        t_resume = trainer_mod.Trainer(loss, feed_list=[x, y],
+                                       main_program=main,
+                                       startup_program=startup)
+        t_resume.train(3, reader, resume_from=str(tmp_path),
+                       checkpoint_every_n_passes=0,
+                       checkpoint_every_n_iters=2,
+                       event_handler=lambda e: ends.append(e) if isinstance(
+                           e, trainer_mod.EndIteration) else None)
+        assert t_resume.step == 12
+        assert len(ends) == 8  # steps 5..12 retrained, 1..4 fast-forwarded
+        got = _persistable_values(main)
+        assert set(got) == set(ref_params)
+        for name in ref_params:
+            np.testing.assert_allclose(got[name], ref_params[name],
+                                       rtol=1e-6, atol=1e-7,
+                                       err_msg=name)
+
+
+    def test_resume_at_pass_boundary_emits_no_duplicate_pass_events(
+            self, tmp_path):
+        from paddle_tpu import trainer as trainer_mod
+
+        main, startup, x, y, loss = _linear_model()
+        reader = _batches(n_batches=4)
+        # iter-checkpoint cadence aligned with the pass length: the last
+        # snapshot before the kill lands exactly on a pass boundary
+        inj = fault_injector()
+        inj.clear()
+        inj.inject("trainer.iteration", "error", nth=5,
+                   exc=RuntimeError("killed"))
+        t = trainer_mod.Trainer(loss, optimizer=fluid.SGD(0.1),
+                                feed_list=[x, y], main_program=main,
+                                startup_program=startup)
+        with pytest.raises(RuntimeError, match="killed"):
+            t.train(2, reader, resume_from=str(tmp_path),
+                    checkpoint_every_n_passes=0, checkpoint_every_n_iters=4)
+        inj.clear()
+        assert t.step == 4  # snapshot cursor sits at (pass 0, batch 4)
+
+        events = []
+        t2 = trainer_mod.Trainer(loss, feed_list=[x, y],
+                                 main_program=main,
+                                 startup_program=startup)
+        t2.train(2, reader, resume_from=str(tmp_path),
+                 checkpoint_every_n_passes=0, checkpoint_every_n_iters=4,
+                 event_handler=events.append)
+        assert t2.step == 8
+        begins = [e.pass_id for e in events
+                  if isinstance(e, trainer_mod.BeginPass)]
+        ends = [e for e in events if isinstance(e, trainer_mod.EndPass)]
+        assert begins == [1]  # pass 0 was already complete: no replay
+        assert [e.pass_id for e in ends] == [1]
+        assert all(np.isfinite(e.metrics["avg_cost"]) for e in ends)
+
+
+_CHILD = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import trainer as trainer_mod
+
+ckpt, kill_at = sys.argv[1], int(sys.argv[2])
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+t = trainer_mod.Trainer(loss, optimizer=fluid.SGD(0.1), feed_list=[x, y],
+                        main_program=main, startup_program=startup)
+r = np.random.RandomState(0)
+data = [(r.rand(4).astype(np.float32), r.rand(1).astype(np.float32))
+        for _ in range(8)]
+
+def reader():
+    for i in range(0, 8, 2):
+        yield data[i:i + 2]
+
+def handler(e):
+    if (kill_at and isinstance(e, trainer_mod.EndIteration)
+            and t.step >= kill_at):
+        os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, a real crash
+
+t.train(3, reader, event_handler=handler, resume_from=ckpt,
+        checkpoint_every_n_iters=2)
+scope = fluid.global_scope()
+total = sum(float(np.abs(np.asarray(scope.find_var(v.name))).sum())
+            for v in main.list_vars()
+            if v.persistable and scope.find_var(v.name) is not None)
+print("FINAL", t.step, round(total, 6))
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestTrainerKillDashNine:
+    def test_sigkill_and_supervised_restart(self, tmp_path):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "child.py"
+        script.write_text(_CHILD.format(repo=repo))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_DATASET="synthetic")
+
+        def run(ckpt, kill_at):
+            return subprocess.run(
+                [sys.executable, str(script), str(ckpt), str(kill_at)],
+                capture_output=True, text=True, timeout=300, env=env)
+
+        ref = run(tmp_path / "ref_ckpt", 0)
+        assert ref.returncode == 0, ref.stderr
+        ref_final = ref.stdout.strip().splitlines()[-1].split()
+
+        crash_dir = tmp_path / "crash_ckpt"
+        crashed = run(crash_dir, 5)
+        assert crashed.returncode == -9  # genuinely SIGKILLed mid-pass
+
+        resumed = run(crash_dir, 0)
+        assert resumed.returncode == 0, resumed.stderr
+        res_final = resumed.stdout.strip().splitlines()[-1].split()
+        assert res_final[1] == ref_final[1] == "12"  # same step count
+        assert abs(float(res_final[2]) - float(ref_final[2])) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# dataset download backoff
+# ---------------------------------------------------------------------------
+
+
+class TestDownloadBackoff:
+    def test_backoff_between_failed_fetches(self, tmp_path, monkeypatch):
+        import urllib.request
+
+        from paddle_tpu.dataset import common
+
+        monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path / "home"))
+        src = tmp_path / "corpus.bin"
+        src.write_bytes(b"payload")
+        md5 = common.md5file(str(src))
+        calls = {"n": 0}
+        real_urlopen = urllib.request.urlopen
+
+        def flaky_urlopen(url, timeout=None):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("mirror down")
+            return real_urlopen(url, timeout=timeout)
+
+        monkeypatch.setattr(urllib.request, "urlopen", flaky_urlopen)
+        sleeps = []
+        policy = RetryPolicy(max_attempts=4, base_delay=0.1,
+                             multiplier=2.0, jitter=0.0, deadline=None,
+                             sleep=sleeps.append)
+        path = common.download("file://" + str(src), "toy", md5,
+                               retry_policy=policy)
+        assert open(path, "rb").read() == b"payload"
+        assert calls["n"] == 3
+        # exponential gaps, not an immediate hammer-loop
+        assert [round(s, 3) for s in sleeps] == [0.1, 0.2]
+
+    def test_md5_mismatch_counts_as_failure(self, tmp_path, monkeypatch):
+        from paddle_tpu.dataset import common
+
+        monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path / "home"))
+        src = tmp_path / "corpus.bin"
+        src.write_bytes(b"wrong content")
+        policy = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0,
+                             deadline=None, sleep=lambda s: None)
+        with pytest.raises(RetryError, match="2 attempts"):
+            common.download("file://" + str(src), "toy", "0" * 32,
+                            retry_policy=policy)
+
+
+# ---------------------------------------------------------------------------
+# serving: saturation + request deadline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestServingOverload:
+    def test_saturation_rejects_and_deadline_sheds(self):
+        from paddle_tpu.io import prune
+        from paddle_tpu.serving import (
+            InferenceServer,
+            RequestDeadlineExceeded,
+            ServerSaturated,
+        )
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+            predict = fluid.layers.fc(input=img, size=2, act="softmax")
+        scope = fluid.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+        infer_prog = prune(main, [predict], for_test=True)
+
+        inj = fault_injector()
+        inj.clear()
+        # every dispatch stalls 0.5s -> the queue backs up on demand
+        inj.inject("serving.dispatch", "delay", delay_s=0.5, nth=1,
+                   count=10)
+        server = InferenceServer(infer_prog, "img", predict, scope,
+                                 place=fluid.CPUPlace(), buckets=(1,),
+                                 window_ms=0.1, max_queue=2)
+        x = np.zeros((4,), np.float32)
+        try:
+            f1 = server.submit(x)
+            time.sleep(0.2)  # worker now holds f1 inside the stall
+            f2 = server.submit(x, deadline_ms=1.0)  # will rot in queue
+            f3 = server.submit(x)
+            with pytest.raises(ServerSaturated, match="queue full"):
+                server.submit(x)  # graceful rejection, not a blocked put
+            with pytest.raises(RequestDeadlineExceeded):
+                f2.result(timeout=10)
+            assert np.asarray(f1.result(timeout=10)).shape == (1, 2)
+            assert np.asarray(f3.result(timeout=10)).shape == (1, 2)
+        finally:
+            inj.clear()
+            server.close()
