@@ -22,7 +22,10 @@ The JSON also carries the honesty block (VERDICT r1 #1/#2):
 
 Knobs: BENCH_BATCH, BENCH_ITERS, BENCH_DTYPE, BENCH_LAYOUT,
 BENCH_AMP=0 (pure-bf16 mode, reported as the secondary number in
-benchmark/README.md), BENCH_CONVERGENCE=0.
+benchmark/README.md), BENCH_CONVERGENCE=0, BENCH_PREFETCH=N (input
+pipeline microbench: serial vs prefetch-depth-N + lazy-fetch steps/s
+with the host-blocked fraction of each loop; BENCH_PREFETCH_ITERS
+steps).
 """
 import json
 import os
@@ -156,6 +159,111 @@ def run_convergence(target_acc=0.85, max_seconds=None, batch=128):
             "compile_seconds": round(compile_seconds, 1)}
 
 
+def run_prefetch_bench(depth, steps=None):
+    """Input-pipeline microbench (BENCH_PREFETCH=N): one pass of a
+    host-bound training loop measured serial, then with the prefetch
+    pipeline (reader/pipeline.py) + lazy fetches.  Reports steps/s and
+    samples/s for both modes and each loop's host-blocked fraction —
+    serial blocks in feed packing (timed inline), the prefetched loop
+    only in queue waits (PrefetchIterator.wait_s) — so the JSON shows
+    both the speedup AND where the remaining stall is."""
+    import paddle_tpu as fluid
+    from paddle_tpu import reader as rdr
+    from paddle_tpu.data_feeder import DataFeeder
+    from paddle_tpu.reader.pipeline import prefetch_feeder
+
+    steps = steps or int(os.environ.get("BENCH_PREFETCH_ITERS", "40"))
+    bs, dim = 128, 256
+    place = fluid.TPUPlace()
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=512, act="relu")
+            h = fluid.layers.fc(input=h, size=512, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            fluid.SGD(learning_rate=0.01).minimize(loss)
+        return main_p, startup, loss, [x, y]
+
+    def sample_reader():
+        # chunked numpy generate + normalize: real host work standing in
+        # for decode/augment, sized so the serial loop is host-BOUND —
+        # the regime the pipeline exists for (on a compute-bound loop
+        # BENCH_PREFETCH correctly reports speedup ~1.0).  Work is done
+        # in batch-size chunks like a real decoder: large numpy ops
+        # release the GIL, so the worker thread genuinely overlaps the
+        # consumer's dispatch (per-sample tiny-op python loops would
+        # serialize on the GIL and measure contention, not the pipeline)
+        r = np.random.RandomState(0)
+        for _ in range(steps):
+            v = r.standard_normal((bs, 12, dim)).astype(np.float32)
+            v = (v - v.mean(axis=1, keepdims=True)) \
+                / (v.std(axis=1, keepdims=True) + 1e-6)
+            x = v.mean(axis=1)
+            y = r.rand(bs, 1).astype(np.float32)
+            for i in range(bs):
+                yield (x[i], y[i])
+
+    batches = rdr.batch(sample_reader, bs, drop_last=True)
+
+    def measure(prefetch_depth):
+        main_p, startup, loss, feed_vars = build()
+        exe = fluid.Executor(place)
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        feeder = DataFeeder(feed_vars, place)
+        warm = feeder.feed(next(iter(batches())))
+        exe.run(main_p, feed=warm, fetch_list=[loss], scope=scope)
+        misses_warm = exe.cache_stats()["misses"]
+        host_blocked = 0.0
+        t0 = time.perf_counter()
+        if prefetch_depth == 0:
+            it = iter(batches())
+            while True:
+                f0 = time.perf_counter()  # reader + pack both block here
+                b = next(it, None)
+                if b is None:
+                    break
+                feed = feeder.feed(b)
+                host_blocked += time.perf_counter() - f0
+                exe.run(main_p, feed=feed, fetch_list=[loss], scope=scope)
+        else:
+            it = prefetch_feeder(batches, feeder, place,
+                                 depth=prefetch_depth)()
+            fence_s = 0.0
+            last = None
+            for i, feed in enumerate(it):
+                last, = exe.run(main_p, feed=feed, fetch_list=[loss],
+                                scope=scope, return_numpy=False)
+                if (i + 1) % 8 == 0:  # periodic fence (sync_every_n=8)
+                    f0 = time.perf_counter()
+                    np.asarray(last)
+                    fence_s += time.perf_counter() - f0
+            f0 = time.perf_counter()
+            np.asarray(last)  # final fence: count finished work only
+            fence_s += time.perf_counter() - f0
+            # blocked = input starvation (queue waits) + fetch fences —
+            # the two stalls the prefetched loop can still suffer
+            host_blocked = it.wait_s + fence_s
+        wall = time.perf_counter() - t0
+        recompiles = exe.cache_stats()["misses"] - misses_warm
+        return {"steps_per_sec": round(steps / wall, 2),
+                "samples_per_sec": round(steps * bs / wall, 1),
+                "host_blocked_fraction": round(host_blocked / wall, 4),
+                "recompiles_after_warmup": recompiles}
+
+    serial = measure(0)
+    prefetched = measure(depth)
+    return {"depth": depth, "steps": steps, "batch": bs,
+            "serial": serial, "prefetch": prefetched,
+            "speedup": round(prefetched["steps_per_sec"]
+                             / serial["steps_per_sec"], 3)}
+
+
 def main():
     import paddle_tpu as fluid
     from harness import gated_time_program
@@ -193,6 +301,9 @@ def main():
         "ms_per_step": round(ms, 2),
     }
     out.update(fields)
+    prefetch_depth = int(os.environ.get("BENCH_PREFETCH", "0"))
+    if prefetch_depth > 0:
+        out["prefetch_pipeline"] = run_prefetch_bench(prefetch_depth)
     if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
             "0", "false", "no", "off"):
         conv = run_convergence()

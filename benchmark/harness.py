@@ -25,6 +25,16 @@ _CHIPS = {
 }
 
 
+def _cost_dict(compiled):
+    """compiled.cost_analysis() normalized to one flat dict — newer jax
+    returns a per-device LIST of dicts (one per participating device)
+    where older versions returned the dict itself."""
+    ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca)
+
+
 def chip_specs():
     """(device_kind, peak_flops, hbm_bytes_per_s) of the default device;
     (kind, None, None) off-TPU (no meaningful peak for CPU hosts)."""
@@ -214,7 +224,7 @@ def time_program(main, startup, feeds, fetch_name, iters,
     # AOT-compile once and call the executable directly (a separate
     # lower().compile() would not share jit's cache -> double compile)
     compiled = step.lower(dev_feeds[0], states).compile()
-    cost = compiled.cost_analysis() or {} if with_cost else None
+    cost = _cost_dict(compiled) if with_cost else None
     loss, states = compiled(dev_feeds[0], states)  # warmup
     jax.block_until_ready(loss)
     n = len(dev_feeds)  # n = iters+1: warmup takes [0], the loop takes
@@ -287,7 +297,7 @@ def time_program_scan(main, startup, feeds, fetch_name,
         # XLA's cost analysis counts a while/scan BODY once, not times
         # the trip count, so this is already the per-step cost (verified:
         # the k=6 scan reports the same bytes as the single-step program)
-        cost = dict(compiled.cost_analysis() or {})
+        cost = _cost_dict(compiled)
     losses, states = compiled(stacks[0], states)  # warmup
     jax.block_until_ready(losses)
     t0 = time.perf_counter()

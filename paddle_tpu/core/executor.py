@@ -22,12 +22,16 @@ via Param/ParamOut aliasing in optimizer ops, e.g. sgd_op.cc).
 from __future__ import annotations
 
 import os
+import time
+import warnings
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import flags as flags_mod
 from . import registry
 from .execution import DictEnv, ExecContext, ScopeEnv, run_op
 from .flags import get_flag
@@ -212,6 +216,54 @@ class _MissingState(KeyError):
     pass
 
 
+_persistent_cache_dir: Optional[str] = None
+
+
+def _maybe_enable_persistent_cache():
+    """Wire JAX's persistent compilation cache when the
+    `compilation_cache_dir` flag (env PADDLE_TPU_COMPILATION_CACHE_DIR) is
+    set: compiled executables survive process restarts, so a re-launched
+    trainer pays deserialization instead of XLA compilation for every
+    warm (program, shape) config.  Idempotent; runs on Executor init AND
+    on every `set_flags` touching the flag (flags.on_flag_change), so
+    enabling/disabling takes effect immediately."""
+    global _persistent_cache_dir
+    d = get_flag("compilation_cache_dir")
+    if d == _persistent_cache_dir or (not d and _persistent_cache_dir
+                                      is None):
+        return
+    if not d:  # flag cleared: actually disable, don't keep the old dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache,
+            )
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        _persistent_cache_dir = None
+        return
+    jax.config.update("jax_compilation_cache_dir", d)
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass  # option renamed/absent in this jax — dir alone suffices
+    try:
+        # an earlier compile (e.g. during program build) may have
+        # initialized the cache module as disabled; re-point it
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    _persistent_cache_dir = d
+
+
+flags_mod.on_flag_change("compilation_cache_dir",
+                         _maybe_enable_persistent_cache)
+
+
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
@@ -223,7 +275,46 @@ class Executor:
         self._seed = seed
         self._step = 0
         self._cache: Dict = {}
-        self._fp_cache: Dict[int, tuple] = {}  # id(program) -> (version, fp)
+        # weakref-keyed: an id()-keyed map held stale fingerprints past
+        # program GC, and a recycled id could serve the WRONG fingerprint
+        self._fp_cache: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()  # program -> (version, fp)
+        self._stats = {"hits": 0, "misses": 0, "compile_s": 0.0,
+                       "recompiles_after_warmup": 0}
+        self._warm_fps: set = set()
+        _maybe_enable_persistent_cache()
+
+    def cache_stats(self) -> Dict:
+        """Dispatch/compile telemetry for this Executor's executable cache:
+        `hits`/`misses` (cache lookups across compiled + segmented modes),
+        `compile_s` (wall time of first invocations, i.e. trace + XLA
+        compile + first dispatch), `entries` (live executables), and
+        `recompiles_after_warmup` — misses for a program that already had
+        a steady-state hit, the signature of a shape/flag leak re-tracing
+        the hot path (PADDLE_TPU_LOG_RECOMPILES=1 also warns per event)."""
+        return {**self._stats, "entries": len(self._cache)}
+
+    def _note_lookup(self, hit: bool, fp, cache_key, once=None) -> None:
+        """`once`: per-run set deduping the recompile counter/warning —
+        a segmented run looks up one executable per device segment, but
+        one odd-shaped batch is ONE hot-path re-trace, not k."""
+        if hit:
+            self._stats["hits"] += 1
+            self._warm_fps.add(fp)
+            return
+        self._stats["misses"] += 1
+        if fp in self._warm_fps and (once is None or fp not in once):
+            if once is not None:
+                once.add(fp)
+            self._stats["recompiles_after_warmup"] += 1
+            if get_flag("log_recompiles"):
+                warnings.warn(
+                    "Executor recompile after warmup: program fingerprint "
+                    f"{fp[:12]}… missed the executable cache with key "
+                    f"{cache_key!r} — a feed shape/dtype/LoD or trace-time "
+                    "flag changed on the hot path (consider length "
+                    "bucketing; see docs/performance.md)",
+                    RuntimeWarning, stacklevel=4)
 
     # -- public API ----------------------------------------------------------
     def run(
@@ -332,15 +423,17 @@ class Executor:
     def _run_interpreted(self, program, block, scope, feed, fetch_names, key):
         device = self.place.jax_device()
         local = scope.new_scope()
-        env = self._scope_env(program, scope, local)
-        with jax.default_device(device):
-            for name, v in feed.items():
-                env.set(name, _to_device_value(v, device))
-            ctx = ExecContext(key, scope=local, executor=self)
-            for op in block.ops:
-                _run_op_instrumented(ctx, op, env)
-            outs = self._fetch(env, fetch_names)
-        scope.kids.remove(local)
+        try:  # finally: a raising op must not leak the local scope
+            env = self._scope_env(program, scope, local)
+            with jax.default_device(device):
+                for name, v in feed.items():
+                    env.set(name, _to_device_value(v, device))
+                ctx = ExecContext(key, scope=local, executor=self)
+                for op in block.ops:
+                    _run_op_instrumented(ctx, op, env)
+                outs = self._fetch(env, fetch_names)
+        finally:
+            scope.kids.remove(local)
         return outs
 
     # -- segmented: compiled device segments between eager host ops ---------
@@ -377,24 +470,29 @@ class Executor:
         is identical across interpreted/compiled/segmented modes."""
         device = self.place.jax_device()
         local = scope.new_scope()
-        env = self._scope_env(program, scope, local)
-        fp = self._fingerprint(program)
-        with jax.default_device(device):
-            for name, v in feed.items():
-                env.set(name, _to_device_value(v, device))
-            ctx = ExecContext(key, scope=local, executor=self)
-            for seg_idx, (is_host, ops) in enumerate(self._segments(block)):
-                if is_host:
-                    for op in ops:
-                        _run_op_instrumented(ctx, op, env)
-                    continue
-                self._run_segment_compiled(fp, seg_idx, ops, env, key,
-                                           device)
-            outs = self._fetch(env, fetch_names)
-        scope.kids.remove(local)
+        try:  # finally: a raising op must not leak the local scope
+            env = self._scope_env(program, scope, local)
+            fp = self._fingerprint(program)
+            with jax.default_device(device):
+                for name, v in feed.items():
+                    env.set(name, _to_device_value(v, device))
+                ctx = ExecContext(key, scope=local, executor=self)
+                once = set()  # one recompile count per run, not per seg
+                for seg_idx, (is_host, ops) in enumerate(
+                        self._segments(block)):
+                    if is_host:
+                        for op in ops:
+                            _run_op_instrumented(ctx, op, env)
+                        continue
+                    self._run_segment_compiled(fp, seg_idx, ops, env, key,
+                                               device, once)
+                outs = self._fetch(env, fetch_names)
+        finally:
+            scope.kids.remove(local)
         return outs
 
-    def _run_segment_compiled(self, fp, seg_idx, ops, env, key, device):
+    def _run_segment_compiled(self, fp, seg_idx, ops, env, key, device,
+                              once=None):
         # names this segment reads from the surrounding env
         read, written = [], set()
         for op in ops:
@@ -414,7 +512,9 @@ class Executor:
             get_flag("flash_block_q"), get_flag("flash_block_k"),
         )
         fn = self._cache.get(cache_key)
-        if fn is None:
+        miss = fn is None
+        self._note_lookup(not miss, fp, cache_key, once)
+        if miss:
             def fn(vals, rng_key, _ops=tuple(ops)):
                 seg_env = DictEnv(vals)
                 seg_ctx = ExecContext(rng_key, executor=self, compiled=True)
@@ -429,22 +529,25 @@ class Executor:
             self._cache[cache_key] = fn
         from paddle_tpu import profiler
 
+        t0 = time.perf_counter() if miss else None
         if profiler.is_enabled():
             with profiler.record_event(f"xla_segment_{seg_idx}"):
                 out = fn(in_vals, key)
                 jax.block_until_ready(out)
         else:
             out = fn(in_vals, key)
+        if miss:
+            self._stats["compile_s"] += time.perf_counter() - t0
         for n, v in out.items():
             env.set(n, v)
 
     # -- compiled ------------------------------------------------------------
     def _fingerprint(self, program) -> str:
-        ent = self._fp_cache.get(id(program))
+        ent = self._fp_cache.get(program)
         if ent is not None and ent[0] == program._version:
             return ent[1]
         fp = program.fingerprint()
-        self._fp_cache[id(program)] = (program._version, fp)
+        self._fp_cache[program] = (program._version, fp)
         return fp
 
     @staticmethod
@@ -504,19 +607,24 @@ class Executor:
             get_flag("flash_block_q"), get_flag("flash_block_k"),
         )
         fn = self._cache.get(cache_key)
-        if fn is None:
+        miss = fn is None
+        self._note_lookup(not miss, cache_key[0], cache_key)
+        if miss:
             fn = self._build_compiled_fn(
                 block, fetch_names, state_out_names, repl
             )
             self._cache[cache_key] = fn
         from paddle_tpu import profiler
 
+        t0 = time.perf_counter() if miss else None
         if profiler.is_enabled():
             with profiler.record_event("xla_block"):
                 fetches, state_out = fn(feed_vals, ro, rw, key)
                 jax.block_until_ready((fetches, state_out))
         else:
             fetches, state_out = fn(feed_vals, ro, rw, key)
+        if miss:
+            self._stats["compile_s"] += time.perf_counter() - t0
         for n, v in state_out.items():
             scope.set_var(n, v)
         return [fetches[n] for n in fetch_names]

@@ -14,6 +14,15 @@ from typing import Any, Dict
 
 _DEFS: Dict[str, Any] = {}
 _VALUES: Dict[str, Any] = {}
+_ON_CHANGE: Dict[str, list] = {}
+
+
+def on_flag_change(name: str, callback):
+    """Register `callback()` to run whenever `set_flags` touches `name` —
+    for flags that must take effect immediately rather than at the next
+    consumer read (e.g. compilation_cache_dir re-pointing JAX's
+    persistent cache)."""
+    _ON_CHANGE.setdefault(name, []).append(callback)
 
 
 def define_flag(name: str, default, help_str: str = ""):
@@ -41,6 +50,9 @@ def set_flags(flags: Dict[str, Any]):
         if k not in _DEFS:
             raise KeyError(f"unknown flag {k!r}; defined: {sorted(_DEFS)}")
         _VALUES[k] = v
+    for k in flags:
+        for cb in _ON_CHANGE.get(k, ()):
+            cb()
 
 
 def flag_defaults():
@@ -75,6 +87,30 @@ define_flag("flash_block_k", -1,
             "override the flash kernel's shape-keyed K block size "
             "(-1 = the measured table); tuning/benchmark hook, read at "
             "TRACE time")
+define_flag("log_recompiles", False,
+            "warn (RuntimeWarning) whenever the Executor misses its "
+            "executable cache for a program that already reached "
+            "steady-state (had a cache hit) — the signature of a feed "
+            "shape/dtype/LoD or trace-time-flag leak re-tracing the hot "
+            "path.  Counted unconditionally in Executor.cache_stats()"
+            "['recompiles_after_warmup']")
+define_flag("compilation_cache_dir", "",
+            "directory for JAX's persistent compilation cache: compiled "
+            "executables survive process restarts, so a relaunched "
+            "trainer pays deserialization instead of XLA compile time "
+            "for warm configs.  Wired on Executor init "
+            "(core/executor.py:_maybe_enable_persistent_cache)")
+define_flag("prefetch_depth", 0,
+            "default Trainer.train prefetch depth: N > 0 runs reader + "
+            "DataFeeder.feed + device_put N batches ahead on a "
+            "background thread (reader/pipeline.py); 0 keeps the serial "
+            "loop.  Per-call override: Trainer.train(prefetch=N)")
+define_flag("sync_every_n", 1,
+            "default Trainer.train fetch-sync cadence: K > 1 hands "
+            "EndIteration a LazyFetch cost (device->host copy deferred "
+            "until read) and fences the dispatch queue every K steps; "
+            "1 materializes every step (the serial loop).  Per-call "
+            "override: Trainer.train(sync_every_n=K)")
 define_flag("flash_pack_heads", True,
             "fold head PAIRS into the 128-lane dim inside the flash "
             "kernel when head_dim == 64 (and the head count is even): "

@@ -22,7 +22,17 @@ class DataFeeder:
     def feed(self, iterable):
         """iterable of rows; each row has one slot value per feed var.
         lod_level==0 slots are stacked dense; lod_level==1 slots are lists of
-        variable-length sequences, packed flat + offset table (LoD)."""
+        variable-length sequences, packed flat + offset table (LoD).
+
+        Emits a `feed.pack` profiler event: in the serial loop this is
+        host time the device sits idle; the prefetch pipeline
+        (reader/pipeline.py) moves it onto the worker thread."""
+        from . import profiler
+
+        with profiler.record_event("feed.pack"):
+            return self._feed(iterable)
+
+    def _feed(self, iterable):
         rows = list(iterable)
         out = {}
         for i, var in enumerate(self.feed_list):

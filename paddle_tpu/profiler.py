@@ -17,6 +17,7 @@ instead — the TPU answer to `cuda_profiler`'s nvprof output.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -30,10 +31,16 @@ __all__ = [
     "record_event",
     "profiler_summary",
     "profile_compiled_ops",
+    "event_totals",
+    "host_blocked_fraction",
 ]
 
 _enabled = False
 _events: Dict[str, List[float]] = {}
+# events are recorded from the prefetch worker thread too
+# (reader/pipeline.py): the store must tolerate concurrent
+# record_event vs event_totals/profiler_summary readers
+_events_lock = threading.Lock()
 
 
 def is_enabled() -> bool:
@@ -53,7 +60,9 @@ def record_event(name: str, sync=None):
     finally:
         if sync is not None:
             sync()
-        _events.setdefault(name, []).append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        with _events_lock:
+            _events.setdefault(name, []).append(dt)
 
 
 def enable_profiler(state: str = "All"):
@@ -63,7 +72,8 @@ def enable_profiler(state: str = "All"):
 
 
 def reset_profiler():
-    _events.clear()
+    with _events_lock:
+        _events.clear()
 
 
 def disable_profiler(sorted_key: Optional[str] = None, print_table=True):
@@ -79,7 +89,9 @@ def disable_profiler(sorted_key: Optional[str] = None, print_table=True):
 
 def profiler_summary(sorted_key: Optional[str] = None):
     rows = []
-    for name, ts in _events.items():
+    with _events_lock:
+        snapshot = {name: list(ts) for name, ts in _events.items()}
+    for name, ts in snapshot.items():
         rows.append({
             "name": name, "calls": len(ts), "total": sum(ts),
             "min": min(ts), "max": max(ts), "ave": sum(ts) / len(ts),
@@ -88,6 +100,30 @@ def profiler_summary(sorted_key: Optional[str] = None):
     if key in ("calls", "total", "min", "max", "ave"):
         rows.sort(key=lambda r: -r[key])
     return rows
+
+
+def event_totals() -> Dict[str, float]:
+    """{event name: total seconds} recorded so far — the programmatic
+    view of the summary table, for user telemetry over the pipeline
+    stage events (feed.pack / pipeline.*; see docs/performance.md).
+    bench.py measures its loops directly instead: enabling the profiler
+    fences compiled-mode dispatches and would serialize what it times."""
+    with _events_lock:
+        return {name: sum(ts) for name, ts in _events.items()}
+
+
+def host_blocked_fraction(wall_seconds: float, events) -> float:
+    """Fraction of `wall_seconds` spent inside the named host-side
+    events.  Which events block the loop depends on the pipeline mode:
+    the serial loop blocks in `feed.pack` (DataFeeder) + `pipeline.h2d`;
+    the prefetched loop's worker absorbs those, and the loop itself only
+    blocks in `pipeline.wait` (queue empty) and `pipeline.fetch_sync`
+    (LazyFetch reads) — pass the event set matching the mode measured."""
+    if wall_seconds <= 0:
+        return 0.0
+    with _events_lock:
+        total = sum(sum(_events.get(e, ())) for e in events)
+    return min(total / wall_seconds, 1.0)
 
 
 def format_summary(rows) -> str:

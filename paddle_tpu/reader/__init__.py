@@ -24,6 +24,10 @@ __all__ = [
     "batch",
     "bucket_by_length",
     "native_pipeline",
+    "prefetch_feeder",
+    "PrefetchIterator",
+    "PrefetchReader",
+    "stage_to_device",
     "PipeReader",
     "ComposeNotAligned",
 ]
@@ -111,31 +115,23 @@ def compose(*readers, **kwargs):
 
 def buffered(reader, size):
     """Prefetch into a bounded buffer on a worker thread
-    (decorator.py buffered)."""
-
-    class _End:
-        pass
+    (decorator.py buffered) — a host-side PrefetchIterator (no feed
+    packing, no device transfer), which also gives abandoned streams a
+    clean worker shutdown instead of a thread blocked on a full queue.
+    `size <= 0` means unbounded, as before.  The generator wrapper keeps
+    the original laziness: nothing is consumed from the source until the
+    first next() (side-effecting sources like cloud_reader must not
+    drain tasks at construction time)."""
 
     def data_reader():
-        q = queue.Queue(maxsize=size)
+        from .pipeline import PrefetchIterator
 
-        def feed():
-            try:
-                for d in reader():
-                    q.put(d)
-                q.put(_End)
-            except BaseException as e:  # propagate, don't truncate the stream
-                q.put(_Error(e))
-
-        t = threading.Thread(target=feed, daemon=True)
-        t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                break
-            if isinstance(e, _Error):
-                raise e.exc
-            yield e
+        it = PrefetchIterator(reader, feeder=None, device_put=False,
+                              depth=size if size > 0 else 2 ** 30)
+        try:
+            yield from it
+        finally:
+            it.close()
 
     return data_reader
 
@@ -409,3 +405,12 @@ class PipeReader:
         rc = self.process.wait()
         if rc != 0:
             raise RuntimeError(f"PipeReader command failed with exit {rc}")
+
+
+# imported last: pipeline reuses this module's _Error carrier
+from .pipeline import (  # noqa: E402,F401
+    PrefetchIterator,
+    PrefetchReader,
+    prefetch_feeder,
+    stage_to_device,
+)

@@ -1,0 +1,237 @@
+"""Async input pipeline: overlap reader -> feed-pack -> H2D with compute.
+
+The reference overlaps input preparation with compute through the async
+PyDataProvider2 pool and the gserver double-buffered data providers
+(framework/reader.h double_buffer); our Trainer loop was fully serial —
+`DataFeeder.feed` packed numpy on the host while the device idled.  On
+TPU, dispatch is async by design, so the whole host-side portion of a
+step is hideable: this module runs the batch reader, the feed packing
+and an eager `jax.device_put` on a background thread ahead of the
+training loop, handing the consumer feed dicts whose values are already
+device-resident.
+
+Layering: this sits ON TOP of the reader decorators (shuffle/batch/
+bucket_by_length/...), not instead of them — `prefetch_feeder(reader,
+feeder)` takes any batch reader and returns another zero-arg reader
+(the package idiom), whose iterator is a `PrefetchIterator` with clean
+shutdown (`close()`), bounded-queue backpressure, and exception
+propagation (a reader/feeder failure re-raises in the consumer instead
+of truncating the stream, same contract as `buffered`).
+
+The H2D staging stage (`stage_to_device`) is shared with the serving
+worker's batch assembly (serving.py), so both hot paths emit the same
+`pipeline.h2d` profiler events.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+__all__ = ["prefetch_feeder", "PrefetchIterator", "PrefetchReader",
+           "stage_to_device"]
+
+from . import _Error
+
+
+class _End:
+    pass
+
+
+def stage_to_device(value, device):
+    """H2D-stage one feed value (LoDTensor wrappers preserved), emitting a
+    `pipeline.h2d` profiler event — the single staging stage shared by the
+    training prefetch pipeline and the serving worker's batch assembly."""
+    from paddle_tpu import profiler
+    from paddle_tpu.core.executor import _to_device_value
+
+    with profiler.record_event("pipeline.h2d"):
+        return _to_device_value(value, device)
+
+
+class PrefetchIterator:
+    """One epoch of prefetched feeds: a daemon thread runs
+    `reader() -> feeder.feed -> device_put` into a bounded queue.
+
+    * backpressure: the queue holds at most `depth` packed batches, so a
+      slow consumer bounds host memory and the worker's readahead;
+    * errors: any exception in the reader/feeder/transfer re-raises at the
+      consumer's next `__next__` (after already-queued good batches);
+    * shutdown: `close()` (idempotent; also called on exhaustion) stops
+      the worker and joins it, so breaking out of a pass early never
+      leaks a thread blocked on a full queue.  NOTE: a live worker holds
+      a reference to this iterator (the thread's bound-method target),
+      so an ABANDONED iterator is not garbage-collected — consumers that
+      may abandon mid-stream should hold the `PrefetchReader` wrapper
+      (what `prefetch_feeder` returns), whose `__del__` IS reachable and
+      closes the inner iterator.
+    """
+
+    def __init__(self, reader, feeder=None, place=None, depth=2,
+                 device_put=True):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        # cumulative consumer-side blocked time (queue empty): the
+        # host-blocked numerator a bench can read without enabling the
+        # profiler (whose compiled-mode events fence the device)
+        self.wait_s = 0.0
+        self._feeder = feeder
+        self._device_put = device_put
+        place = place or getattr(feeder, "place", None)
+        self._device = place.jax_device() if place is not None else None
+        if device_put and self._device is None:
+            import jax
+
+            self._device = jax.devices()[0]
+        self.thread = threading.Thread(
+            target=self._work, args=(reader,), daemon=True,
+            name="paddle-tpu-prefetch")
+        self.thread.start()
+
+    # -- worker -------------------------------------------------------------
+    def _put(self, item) -> bool:
+        """Blocking put that wakes up when the consumer closes early."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _prepare(self, batch):
+        if self._feeder is not None:
+            feed = self._feeder.feed(batch)
+        else:
+            feed = batch  # reader already yields feed dicts
+        if self._device_put and isinstance(feed, dict):
+            feed = {k: stage_to_device(v, self._device)
+                    for k, v in feed.items()}
+        elif self._device_put:
+            feed = stage_to_device(feed, self._device)
+        return feed
+
+    def _work(self, reader):
+        try:
+            for batch in reader():
+                if self._stop.is_set():
+                    return
+                if not self._put(self._prepare(batch)):
+                    return
+            self._put(_End)
+        except BaseException as e:  # propagate, don't truncate the stream
+            self._put(_Error(e))
+
+    # -- consumer -----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from paddle_tpu import profiler
+
+        if self._done:
+            raise StopIteration
+        with profiler.record_event("pipeline.wait"):
+            t0 = time.perf_counter()
+            item = self._q.get()
+            self.wait_s += time.perf_counter() - t0
+        if item is _End:
+            self._done = True
+            self.thread.join(timeout=5)
+            raise StopIteration
+        if isinstance(item, _Error):
+            self._done = True
+            self._stop.set()
+            raise item.exc
+        return item
+
+    def close(self):
+        """Stop the worker and join it (safe to call more than once)."""
+        self._done = True
+        self._stop.set()
+        while True:  # drain so a blocked put wakes immediately
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self.thread.is_alive():
+            self.thread.join(timeout=5)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PrefetchReader:
+    """Lazy one-epoch handle: the PrefetchIterator (and its worker
+    thread) starts at the FIRST `next()`, not at construction — the
+    package reader contract (`compose`/`zip` call every reader before
+    consuming any; side-effecting sources like `cloud_reader` must not
+    drain tasks for a stream nobody iterates).  Because the worker only
+    references the INNER iterator, dropping this handle is collectable:
+    `__del__` closes the iterator, so an abandoned stream (early `break`
+    without `close()`) leaks neither the thread nor the queued
+    device-resident batches."""
+
+    def __init__(self, reader, feeder=None, place=None, depth=2,
+                 device_put=True):
+        self._args = (reader, feeder, place, depth, device_put)
+        self._it: "PrefetchIterator | None" = None
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._it is None:
+            reader, feeder, place, depth, device_put = self._args
+            self._it = PrefetchIterator(reader, feeder=feeder,
+                                        place=place, depth=depth,
+                                        device_put=device_put)
+        return next(self._it)
+
+    @property
+    def wait_s(self) -> float:
+        """Consumer-side blocked seconds (see PrefetchIterator.wait_s)."""
+        return self._it.wait_s if self._it is not None else 0.0
+
+    def close(self):
+        self._closed = True
+        if self._it is not None:
+            self._it.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetch_feeder(reader, feeder=None, place=None, depth=2,
+                    device_put=True):
+    """Reader decorator: batch reader -> reader of DEVICE-RESIDENT feed
+    dicts, prepared `depth` batches ahead on a background thread.
+
+        feeds = prefetch_feeder(train_reader, feeder, place, depth=2)
+        for feed in feeds():
+            exe.run(main, feed=feed, fetch_list=[loss])
+
+    `feeder=None` means the reader already yields feed dicts and only the
+    device transfer is staged; `device_put=False` keeps values on host
+    (pure pack-ahead).  Each call of the returned reader yields a fresh
+    `PrefetchReader` (own thread + queue once iterated), so it composes
+    with the multi-pass Trainer loop exactly like any other reader.
+    """
+
+    def feed_reader():
+        return PrefetchReader(reader, feeder=feeder, place=place,
+                              depth=depth, device_put=device_put)
+
+    return feed_reader

@@ -36,6 +36,7 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from .core.resilience import fault_injector
+from .reader.pipeline import stage_to_device
 
 __all__ = ["InferenceServer", "ServerSaturated", "RequestDeadlineExceeded"]
 
@@ -233,10 +234,12 @@ class InferenceServer:
             xs = [item[0] for item in batch]
             if bucket > n:  # pad with the last request, sliced away below
                 xs += [xs[-1]] * (bucket - n)
-            # H2D here (worker thread) overlaps the PREVIOUS dispatch's
-            # device compute; the dispatch below is async
-            staged = jax.device_put(np.concatenate(xs, axis=0),
-                                    self._device)
+            # batch assembly reuses the training pipeline's H2D staging
+            # stage (same `pipeline.h2d` profiler event): the transfer on
+            # this worker thread overlaps the PREVIOUS dispatch's device
+            # compute; the dispatch below is async
+            staged = stage_to_device(np.concatenate(xs, axis=0),
+                                     self._device)
             try:
                 out = self._compiled[bucket](
                     {self._feed_name: staged}, self._states)

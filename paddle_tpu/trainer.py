@@ -12,7 +12,8 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from .core.executor import CPUPlace, Executor
+from .core.executor import CPUPlace, Executor, _to_numpy
+from .core.flags import get_flag
 from .core.framework import (
     Program,
     default_main_program,
@@ -26,8 +27,123 @@ __all__ = [
     "EndPass",
     "BeginIteration",
     "EndIteration",
+    "LazyFetch",
     "Trainer",
 ]
+
+
+class LazyFetch:
+    """Handle for a fetched value that may still be in flight on device.
+
+    `Executor.run(..., return_numpy=True)` forces a blocking device->host
+    copy of every fetch — with async dispatch that serializes the loop on
+    the device.  A LazyFetch wraps the raw device value instead; the copy
+    happens only when someone actually reads it (`float()`,
+    `np.asarray(...)`, `.numpy()`), so step N+1 can dispatch while step N
+    is still computing.  Reading is idempotent (the materialized host
+    value is cached)."""
+
+    __slots__ = ("_device_value", "_host_value")
+
+    def __init__(self, device_value):
+        self._device_value = device_value
+        self._host_value = None
+
+    def value(self):
+        """The raw value, no sync: device-resident until materialized,
+        the cached host copy afterwards."""
+        if self._host_value is not None:
+            return self._host_value
+        return self._device_value
+
+    def numpy(self):
+        """Materialize on host (blocks until the computation delivers).
+        Releases the device buffer: a pass worth of retained cost
+        handles must not pin one live device array per step."""
+        if self._host_value is None:
+            from . import profiler
+
+            with profiler.record_event("pipeline.fetch_sync"):
+                self._host_value = _to_numpy(self._device_value)
+            self._device_value = None
+        return self._host_value
+
+    def __float__(self):
+        return float(np.asarray(self.numpy()).reshape(-1)[0])
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.numpy())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __format__(self, spec):
+        # format(x, "") must equal str(x): plain f-string interpolation
+        # of event.cost is a read, and reads materialize
+        return format(float(self), spec)
+
+    def __repr__(self):
+        if self._host_value is not None:
+            return f"LazyFetch({self._host_value!r})"
+        return "LazyFetch(<in flight>)"
+
+    # float-like protocol: existing EndIteration handlers do arithmetic,
+    # comparisons and printing on event.cost — each such read IS the
+    # materialization point (Python never falls back to __float__ for
+    # operators, so these must be explicit)
+    @staticmethod
+    def _f(other):
+        return float(other) if isinstance(other, LazyFetch) else other
+
+    def __str__(self):
+        return str(float(self))
+
+    def __bool__(self):
+        return bool(float(self))
+
+    def __hash__(self):
+        return hash(float(self))
+
+    def __eq__(self, other):
+        return float(self) == self._f(other)
+
+    def __lt__(self, other):
+        return float(self) < self._f(other)
+
+    def __le__(self, other):
+        return float(self) <= self._f(other)
+
+    def __gt__(self, other):
+        return float(self) > self._f(other)
+
+    def __ge__(self, other):
+        return float(self) >= self._f(other)
+
+    def __add__(self, other):
+        return float(self) + self._f(other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return float(self) - self._f(other)
+
+    def __rsub__(self, other):
+        return self._f(other) - float(self)
+
+    def __mul__(self, other):
+        return float(self) * self._f(other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return float(self) / self._f(other)
+
+    def __rtruediv__(self, other):
+        return self._f(other) / float(self)
+
+    def __neg__(self):
+        return -float(self)
+
+    def __abs__(self):
+        return abs(float(self))
 
 
 class BeginPass:
@@ -97,7 +213,9 @@ class Trainer:
               checkpoint_every_n_passes: int = 1,
               checkpoint_max_keep: int = 3,
               checkpoint_every_n_iters: int = 0,
-              resume_from: Optional[str] = None):
+              resume_from: Optional[str] = None,
+              prefetch: Optional[int] = None,
+              sync_every_n: Optional[int] = None):
         """reader: batch reader (yields lists of samples per batch).
 
         With `checkpoint_dir`, resumes from the newest valid snapshot there
@@ -117,14 +235,39 @@ class Trainer:
         supervisor finishes with the same step count and params as an
         uninterrupted run.  `resume_from` doubles as the save target when
         `checkpoint_dir` is not given.  The running step count is exposed
-        as `self.step`."""
+        as `self.step`.
+
+        Async hot path: `prefetch=N` (default flag `prefetch_depth`, env
+        PADDLE_TPU_PREFETCH_DEPTH) runs reader + feed packing + H2D on a
+        background thread N batches ahead (reader/pipeline.py);
+        `sync_every_n=K` (default flag `sync_every_n`, env
+        PADDLE_TPU_SYNC_EVERY_N) > 1 threads the cost through
+        `EndIteration` as a `LazyFetch` that materializes only when the
+        callback reads it (or every K steps, bounding the in-flight
+        dispatch queue), so step N+1 dispatches while step N computes.
+        Both default off/1: the default loop is bit-for-bit the serial
+        one, and the async loop runs the SAME ops in the SAME order, so
+        final parameters are bit-identical (test-enforced,
+        tests/test_async_feed.py)."""
         from . import io
         from .core.resilience import fault_injector
+        from .reader.pipeline import prefetch_feeder
 
         self.start()
         event_handler = event_handler or (lambda e: None)
         feeder = feeder or self._feeder()
         fetches = [self.loss] + self.fetch_list
+        if prefetch is None:
+            prefetch = int(get_flag("prefetch_depth"))
+        if sync_every_n is None:
+            sync_every_n = int(get_flag("sync_every_n"))
+        sync_every_n = max(int(sync_every_n), 1)
+        lazy = sync_every_n > 1
+        def make_feeds(rd):
+            if prefetch > 0:
+                return prefetch_feeder(rd, feeder, self.place,
+                                       depth=prefetch)()
+            return (feeder.feed(b) for b in rd())
         if resume_from is not None and checkpoint_dir is None:
             checkpoint_dir = resume_from
         first_pass, skip_batches = 0, 0
@@ -148,50 +291,86 @@ class Trainer:
                               "step": self.step},
                 max_keep=checkpoint_max_keep)
 
+        _no_batch = object()
         for pass_id in range(first_pass, num_passes):
             # in a resumed pass, BeginPass fires only once a batch
             # actually trains: a snapshot taken at the pass's final batch
             # would otherwise replay the whole pass as skips and emit a
             # duplicate BeginPass/EndPass pair (the latter with NaN cost)
-            resuming = skip_batches > 0
+            n_skip = skip_batches
+            skip_batches = 0
+            resuming = n_skip > 0
             trained = False
             if not resuming:
                 event_handler(BeginPass(pass_id))
             pass_costs = []
-            for batch_id, batch in enumerate(reader()):
-                if skip_batches > 0:
-                    # resumed mid-pass: the snapshot already carries the
-                    # effect of these batches; replay the reader past
-                    # them without training
-                    skip_batches -= 1
-                    continue
-                if resuming and not trained:
-                    event_handler(BeginPass(pass_id))
-                trained = True
-                # chaos hook: auto-resume tests kill the trainer here
-                fault_injector().fire("trainer.iteration")
-                event_handler(BeginIteration(pass_id, batch_id))
-                outs = self.exe.run(self.main_program,
-                                    feed=feeder.feed(batch),
-                                    fetch_list=fetches)
-                cost = float(np.asarray(outs[0]).reshape(-1)[0])
-                pass_costs.append(cost)
-                self.step += 1
-                event_handler(EndIteration(pass_id, batch_id, cost,
-                                           metrics=outs[1:]))
-                if checkpoint_dir is not None \
-                        and checkpoint_every_n_iters > 0 \
-                        and self.step % checkpoint_every_n_iters == 0:
-                    _save(pass_id, batch_id + 1)
-            skip_batches = 0
+            if resuming:
+                # resumed mid-pass: the snapshot already carries the
+                # effect of the skipped batches; replay the RAW reader
+                # past them (no feed packing, no H2D — restart latency
+                # must not scale with feed-pack cost of the prefix)
+                def pass_reader(_n=n_skip):
+                    it = iter(reader())
+                    for _ in range(_n):
+                        if next(it, _no_batch) is _no_batch:
+                            return
+                    yield from it
+            else:
+                pass_reader = reader
+            feeds = make_feeds(pass_reader)
+            try:
+                for batch_id, feed in enumerate(feeds, start=n_skip):
+                    if resuming and not trained:
+                        event_handler(BeginPass(pass_id))
+                    trained = True
+                    # chaos hook: auto-resume tests kill the trainer here
+                    fault_injector().fire("trainer.iteration")
+                    event_handler(BeginIteration(pass_id, batch_id))
+                    outs = self.exe.run(self.main_program, feed=feed,
+                                        fetch_list=fetches,
+                                        return_numpy=not lazy)
+                    if lazy:
+                        cost = LazyFetch(outs[0])
+                        # metrics stay RAW device arrays: jax arrays are
+                        # already lazy (async dispatch) and keep
+                        # elementwise semantics — a LazyFetch wrapper
+                        # would collapse vector metrics to [0] under
+                        # arithmetic.  LazyFetch is for the scalar cost
+                        metrics = list(outs[1:])
+                    else:
+                        cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                        metrics = outs[1:]
+                    pass_costs.append(cost)
+                    self.step += 1
+                    if lazy and self.step % sync_every_n == 0:
+                        # periodic fence: bounds the in-flight dispatch
+                        # queue, surfaces device errors at a bounded
+                        # distance from their step, and releases the
+                        # window's cost device buffers (numpy() drops
+                        # the handle) so a long pass doesn't pin one
+                        # live device array per trained step
+                        for c in pass_costs[-sync_every_n:]:
+                            if isinstance(c, LazyFetch):
+                                c.numpy()
+                    event_handler(EndIteration(pass_id, batch_id, cost,
+                                               metrics=metrics))
+                    if checkpoint_dir is not None \
+                            and checkpoint_every_n_iters > 0 \
+                            and self.step % checkpoint_every_n_iters == 0:
+                        _save(pass_id, batch_id + 1)
+            finally:
+                # a prefetching iterator owns a worker thread: an
+                # exception mid-pass must not leak it blocked on the queue
+                if hasattr(feeds, "close"):
+                    feeds.close()
             if resuming and not trained:
                 # the snapshot was taken AT the pass boundary: this pass
                 # is already complete, so no events and no redundant
                 # checkpoint for it — move straight to the next pass
                 continue
             event_handler(EndPass(pass_id, metrics={
-                "avg_cost": float(np.mean(pass_costs)) if pass_costs
-                else float("nan")}))
+                "avg_cost": float(np.mean([float(c) for c in pass_costs]))
+                if pass_costs else float("nan")}))
             if checkpoint_dir is not None and checkpoint_every_n_passes > 0 \
                     and (pass_id + 1) % checkpoint_every_n_passes == 0:
                 _save(pass_id + 1, 0)

@@ -57,6 +57,13 @@ def pytest_configure(config):
         "wall time (6:22 vs 18:41 measured); CI runs the full suite.")
     config.addinivalue_line(
         "markers",
+        "perf: timing-sensitive microbench test (async input pipeline "
+        "overlap, recompile-free hot loops). Tier-1-safe — the "
+        "assertions use best-of-N walls and measured-step-derived "
+        "workloads so they hold on loaded CI hosts. Run just these: "
+        "pytest -m perf")
+    config.addinivalue_line(
+        "markers",
         "chaos: fault-injection test (core/resilience FaultInjector "
         "driving socket drops, truncated frames, corrupt snapshots, "
         "killed trainers). Socket-level single-process cases are fast "

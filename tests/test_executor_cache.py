@@ -108,3 +108,167 @@ def test_single_compile_fresh_executor_same_scope():
     _run_steps(exe2, main, loss, scope, [_feed()] * 3)
     assert all(size == 1 for size in _jit_cache_sizes(exe2)), \
         _jit_cache_sizes(exe2)
+
+
+# ---------------------------------------------------------------------------
+# cache_stats telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_cache_stats_counters_and_steady_state():
+    """hits/misses/compile_s accounting: startup + main each miss once,
+    every further step of the same config is a hit, and the steady-state
+    training loop adds ZERO misses."""
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    assert exe.cache_stats() == {"hits": 0, "misses": 0, "compile_s": 0.0,
+                                 "recompiles_after_warmup": 0,
+                                 "entries": 0}
+    exe.run(startup, scope=scope)
+    _run_steps(exe, main, loss, scope, [_feed()] * 5)
+    s = exe.cache_stats()
+    assert s["misses"] == 2, s      # startup + first main step
+    assert s["hits"] == 4, s        # steps 2..5
+    assert s["entries"] == 2, s
+    assert s["compile_s"] > 0, s
+    assert s["recompiles_after_warmup"] == 0, s
+    # steady state: more identical steps are pure hits — no misses
+    _run_steps(exe, main, loss, scope, [_feed()] * 3)
+    s2 = exe.cache_stats()
+    assert s2["misses"] == 2, s2
+    assert s2["hits"] == 7, s2
+    assert s2["compile_s"] == s["compile_s"], s2
+
+
+def test_recompile_after_warmup_counted_and_warned():
+    """A shape change on a warm program counts as a post-warmup recompile
+    and (with PADDLE_TPU_LOG_RECOMPILES) emits a RuntimeWarning naming
+    the cache-key divergence."""
+    from paddle_tpu.core.flags import set_flags
+
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    _run_steps(exe, main, loss, scope, [_feed()] * 3)  # warm
+    r = np.random.RandomState(1)
+    odd_feed = {"x": r.rand(3, 16).astype(np.float32),  # new batch size
+                "y": r.rand(3, 1).astype(np.float32)}
+    set_flags({"log_recompiles": True})
+    try:
+        with pytest.warns(RuntimeWarning, match="recompile after warmup"):
+            exe.run(main, feed=odd_feed, fetch_list=[loss], scope=scope)
+    finally:
+        set_flags({"log_recompiles": False})
+    s = exe.cache_stats()
+    assert s["recompiles_after_warmup"] == 1, s
+    # without the flag the event is still counted, silently
+    even_odder = {"x": r.rand(5, 16).astype(np.float32),
+                  "y": r.rand(5, 1).astype(np.float32)}
+    exe.run(main, feed=even_odder, fetch_list=[loss], scope=scope)
+    assert exe.cache_stats()["recompiles_after_warmup"] == 2
+
+
+def test_recompile_counter_segmented_counts_once_per_run():
+    """A segmented program (host op between device segments) looks up
+    one executable per segment, but one odd-shaped batch is ONE hot-path
+    re-trace — the counter must not inflate to k."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        h = fluid.layers.Print(h)  # host op -> 2 device segments
+        fluid.layers.mean(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed_a = {"x": np.ones((2, 4), np.float32)}
+    exe.run(main, feed=feed_a, scope=scope)
+    exe.run(main, feed=feed_a, scope=scope)  # warm (segment hits)
+    before = exe.cache_stats()
+    assert before["recompiles_after_warmup"] == 0, before
+    exe.run(main, feed={"x": np.ones((3, 4), np.float32)}, scope=scope)
+    after = exe.cache_stats()
+    assert after["entries"] - before["entries"] >= 2, (before, after)
+    assert after["recompiles_after_warmup"] == 1, after
+
+
+def test_persistent_compilation_cache_wiring(tmp_path):
+    """The `compilation_cache_dir` flag routes compiles into JAX's
+    persistent cache — executables survive process restarts."""
+    from paddle_tpu.core import executor as executor_mod
+    from paddle_tpu.core.flags import set_flags
+
+    set_flags({"compilation_cache_dir": str(tmp_path)})
+    try:
+        main, startup, loss = _build_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+        assert any(tmp_path.iterdir()), \
+            "no persistent cache entries written"
+    finally:
+        # clearing the flag must actually DISABLE the cache (not keep
+        # writing to the stale dir) — effective immediately via the
+        # flags on-change hook, no Executor construction needed
+        set_flags({"compilation_cache_dir": ""})
+    assert jax.config.jax_compilation_cache_dir is None
+    assert executor_mod._persistent_cache_dir is None
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: fp-cache lifetime + local-scope leak
+# ---------------------------------------------------------------------------
+
+
+def test_fp_cache_dropped_with_program():
+    """The fingerprint cache is weakref-keyed: once the program (and the
+    executables closing over its blocks) are gone, no stale entry keyed
+    by a reusable id() survives."""
+    import gc
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    main, startup, loss = _build_mlp()
+    exe.run(startup, scope=scope)
+    _run_steps(exe, main, loss, scope, [_feed()] * 2)
+    assert len(exe._fp_cache) >= 1
+    exe.close()  # drop the executables (their closures hold the blocks)
+    del main, startup, loss
+    gc.collect()
+    assert len(exe._fp_cache) == 0
+
+
+def test_failed_run_does_not_leak_local_scope():
+    """A raising run must not accumulate kid scopes — interpreted mode."""
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for _ in range(3):
+        with pytest.raises(KeyError, match="never produced"):
+            exe.run(main, feed=_feed(), fetch_list=["no_such_var"],
+                    scope=scope, compiled=False)
+    assert scope.kids == [], "interpreted mode leaked local scopes"
+
+
+def test_failed_run_does_not_leak_local_scope_segmented():
+    """Same regression on the segmented path (host op in the program
+    forces it): the failing fetch must release the per-run scope."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        h = fluid.layers.Print(h)  # host op -> segmented execution
+        fluid.layers.mean(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((2, 4), np.float32)}
+    for _ in range(3):
+        with pytest.raises(KeyError, match="never produced"):
+            exe.run(main, feed=feed, fetch_list=["no_such_var"],
+                    scope=scope)
+    assert scope.kids == [], "segmented mode leaked local scopes"
