@@ -11,6 +11,7 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
 """
 from . import (  # noqa: F401
     amp,
+    analysis,
     profiler,
     clip,
     concurrency,

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from .core import registry
 from .core.framework import (
+    EMPTY_VAR_NAMES,
     GRAD_SUFFIX,
     Parameter,
     Program,
@@ -59,7 +60,7 @@ def _relevant_ops(block, target_names: Set[str], stop_names: Set[str]):
 
 
 def _var_needs_grad(block, name, no_grad: Set[str]) -> bool:
-    if name in ("", "@EMPTY@") or name in no_grad:
+    if name in EMPTY_VAR_NAMES or name in no_grad:
         return False
     try:
         v = block.var(name)

@@ -223,10 +223,124 @@ def job_merge(cfg, args):
     print(f"merged model written to {out}")
 
 
+# ---------------------------------------------------------------------------
+# `verify` subcommand: static analysis of saved / buildable programs
+# ---------------------------------------------------------------------------
+
+
+def _programs_from_target(path):
+    """Yield (label, program, feed_names, fetch_names) for one verify
+    target: a model dir saved by io.save_inference_model (`__model__`
+    JSON), or a python file defining build() (CLI config contract, or an
+    example-style build returning Program objects)."""
+    import paddle_tpu as fluid
+
+    if os.path.isdir(path):
+        import json
+
+        from paddle_tpu.io import MODEL_FILENAME
+
+        model_path = os.path.join(path, MODEL_FILENAME)
+        if not os.path.exists(model_path):
+            raise SystemExit(
+                f"{path!r} has no {MODEL_FILENAME} file — not a model "
+                "dir saved by save_inference_model")
+        with open(model_path) as f:
+            payload = json.load(f)
+        yield (f"{path}/{MODEL_FILENAME}",
+               fluid.Program.from_dict(payload["program"]),
+               payload.get("feed_var_names"),
+               payload.get("fetch_var_names"))
+        return
+
+    mod = _load_config(path)
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        out = mod.build()
+    # collect every Program the config touched: returned directly
+    # (example-style tuples / dicts) or built under the ambient guard
+    # (CLI config contract)
+    seen = {}
+
+    def add(label, prog):
+        if isinstance(prog, fluid.Program) and id(prog) not in seen:
+            seen[id(prog)] = (label, prog)
+
+    if isinstance(out, dict):
+        for k, v in out.items():
+            add(f"{path}:{k}", v)
+    elif isinstance(out, (list, tuple)):
+        for i, v in enumerate(out):
+            add(f"{path}:build()[{i}]", v)
+    else:
+        add(f"{path}:build()", out)
+    add(f"{path}:main", main_p)
+    add(f"{path}:startup", startup)
+    for label, prog in seen.values():
+        if prog.global_block().ops or len(prog.blocks) > 1:
+            yield label, prog, None, None
+
+
+def cmd_verify(argv):
+    """`python -m paddle_tpu.cli verify TARGET... [--level error]` —
+    run the static analyzer (paddle_tpu.analysis) over programs saved by
+    io.py or built by config/example files; exit non-zero when any
+    diagnostic reaches --level."""
+    from paddle_tpu.analysis import format_diagnostics, severity_rank
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli verify",
+        description="static analysis of Program IR (docs/analysis.md)")
+    ap.add_argument("targets", nargs="+",
+                    help="model dir (save_inference_model output) or "
+                    "python file defining build()")
+    ap.add_argument("--level", default="error",
+                    choices=["error", "warn", "info"],
+                    help="minimum severity that fails the check")
+    ap.add_argument("--passes", default="",
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--show", default="warning",
+                    choices=["error", "warning", "info"],
+                    help="minimum severity to print")
+    args = ap.parse_args(argv)
+
+    passes = [p for p in args.passes.split(",") if p] or None
+    fail_rank = severity_rank(
+        "warning" if args.level == "warn" else args.level)
+    n_programs = 0
+    failed = False
+    for target in args.targets:
+        for label, prog, feeds, fetches in _programs_from_target(target):
+            n_programs += 1
+            diagnostics = prog.verify(level=None, passes=passes,
+                                      feed_names=feeds,
+                                      fetch_names=fetches)
+            shown = [d for d in diagnostics
+                     if severity_rank(d.severity)
+                     >= severity_rank(args.show)]
+            bad = [d for d in diagnostics
+                   if severity_rank(d.severity) >= fail_rank]
+            status = "FAIL" if bad else "ok"
+            print(f"[{status}] {label}: {len(diagnostics)} diagnostic(s)")
+            if shown:
+                print(format_diagnostics(shown))
+            failed = failed or bool(bad)
+    if not n_programs:
+        raise SystemExit("verify: no programs found in the given targets")
+    print(f"verify: {n_programs} program(s) checked — "
+          + ("FAILED" if failed else "all clean at level "
+             + args.level))
+    return 1 if failed else 0
+
+
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "verify":
+        sys.exit(cmd_verify(argv[1:]))
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli",
-        description="legacy `paddle train` workflow over Program/Executor")
+        description="legacy `paddle train` workflow over Program/Executor"
+        " (plus: `python -m paddle_tpu.cli verify --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

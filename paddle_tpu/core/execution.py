@@ -139,7 +139,7 @@ class ScopeEnv:
 # dispatch
 # ---------------------------------------------------------------------------
 
-_EMPTY = ("", "@EMPTY@")
+from .framework import EMPTY_VAR_NAMES as _EMPTY
 
 
 def gather_inputs(op, env) -> Dict[str, List]:

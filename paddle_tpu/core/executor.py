@@ -219,6 +219,16 @@ class _MissingState(KeyError):
 _persistent_cache_dir: Optional[str] = None
 
 
+def _note_cache_config_issue(what: str, exc: Exception) -> None:
+    """Persistent-cache config knobs vary across jax versions; a missing
+    knob degrades the feature, it must not break execution — but it also
+    must not vanish silently (tools/lint.py bans bare swallow-alls)."""
+    warnings.warn(
+        f"persistent compilation cache: {what} unavailable on this jax "
+        f"({type(exc).__name__}: {exc}); continuing without it",
+        RuntimeWarning, stacklevel=3)
+
+
 def _maybe_enable_persistent_cache():
     """Wire JAX's persistent compilation cache when the
     `compilation_cache_dir` flag (env PADDLE_TPU_COMPILATION_CACHE_DIR) is
@@ -239,8 +249,8 @@ def _maybe_enable_persistent_cache():
                 compilation_cache,
             )
             compilation_cache.reset_cache()
-        except Exception:
-            pass
+        except Exception as e:  # cache module moved/absent in this jax
+            _note_cache_config_issue("reset_cache (disable)", e)
         _persistent_cache_dir = None
         return
     jax.config.update("jax_compilation_cache_dir", d)
@@ -248,15 +258,16 @@ def _maybe_enable_persistent_cache():
                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
         try:
             jax.config.update(opt, val)
-        except Exception:
-            pass  # option renamed/absent in this jax — dir alone suffices
+        except Exception as e:
+            # option renamed/absent in this jax — dir alone suffices
+            _note_cache_config_issue(opt, e)
     try:
         # an earlier compile (e.g. during program build) may have
         # initialized the cache module as disabled; re-point it
         from jax.experimental.compilation_cache import compilation_cache
         compilation_cache.reset_cache()
-    except Exception:
-        pass
+    except Exception as e:
+        _note_cache_config_issue("reset_cache (enable)", e)
     _persistent_cache_dir = d
 
 
@@ -336,6 +347,14 @@ class Executor:
             v.name if isinstance(v, Variable) else str(v)
             for v in (fetch_list or [])
         ]
+        # static pre-flight (PADDLE_TPU_VERIFY=warn|error, default off —
+        # preflight gates internally): catch bad graphs in ms instead of
+        # minutes into a trace; cached per (program, version) so
+        # steady-state loops pay one flag read + dict probe
+        from ..analysis import preflight
+
+        preflight(program, feed_names=feed.keys(),
+                  fetch_names=fetch_names)
         block = program.global_block()
 
         if compiled is None and not self._has_host_ops(block):

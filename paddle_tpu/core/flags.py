@@ -100,6 +100,16 @@ define_flag("compilation_cache_dir", "",
             "trainer pays deserialization instead of XLA compile time "
             "for warm configs.  Wired on Executor init "
             "(core/executor.py:_maybe_enable_persistent_cache)")
+define_flag("verify", "off",
+            "static program verification before execution "
+            "(paddle_tpu.analysis): 'off' = skip; 'warn' = run every "
+            "registered analysis pass and RuntimeWarning on "
+            "error/warning diagnostics; 'error' = additionally raise "
+            "ProgramVerificationError on error-severity diagnostics.  "
+            "Applies to Executor, ParallelExecutor, PipelineExecutor "
+            "and io.load_inference_model; results are cached per "
+            "(program, version) so steady-state loops verify once.  "
+            "Explicit Program.verify(level=...) calls ignore this flag")
 define_flag("prefetch_depth", 0,
             "default Trainer.train prefetch depth: N > 0 runs reader + "
             "DataFeeder.feed + device_put N batches ahead on a "

@@ -29,6 +29,7 @@ __all__ = [
     "Operator",
     "Block",
     "Program",
+    "EMPTY_VAR_NAMES",
     "default_main_program",
     "default_startup_program",
     "program_guard",
@@ -41,6 +42,11 @@ __all__ = [
 ]
 
 GRAD_SUFFIX = "@GRAD"
+
+# sentinel "no variable here" slot entries (reference: kEmptyVarName) —
+# grad descs use them for inputs that need no gradient; every name-based
+# walk (execution dispatch, backward, the analysis passes) skips them
+EMPTY_VAR_NAMES = ("", "@EMPTY@")
 
 
 def grad_var_name(name: str) -> str:
@@ -312,7 +318,31 @@ class Block:
         if name is None:
             name = unique_name("tmp")
         if name in self.vars:
-            return self.vars[name]
+            existing = self.vars[name]
+            # a second create_var for the same name used to silently hand
+            # back the existing var even when the caller asked for a
+            # DIFFERENT shape/dtype — the caller then builds ops against
+            # a type it never gets.  Explicitly conflicting kwargs raise.
+            conflicts = []
+            shape = kw.get("shape")
+            if (shape is not None and existing.shape is not None
+                    and tuple(int(s) for s in shape) != existing.shape):
+                conflicts.append(
+                    f"shape {list(shape)} vs existing "
+                    f"{list(existing.shape)}")
+            dtype = kw.get("dtype")
+            if dtype is not None and existing.dtype is not None:
+                if canonical_dtype(dtype) != existing.dtype:
+                    conflicts.append(
+                        f"dtype {dtype} vs existing {existing.dtype}")
+            if conflicts:
+                raise ValueError(
+                    f"create_var({name!r}) collides with an existing "
+                    f"variable in block {self.idx}: "
+                    + "; ".join(conflicts)
+                    + " — use a unique name (unique_name) or match the "
+                    "existing declaration")
+            return existing
         v = Variable(self, name, **kw)
         self.vars[name] = v
         return v
@@ -450,6 +480,25 @@ class Program:
         import hashlib
 
         return hashlib.sha1(payload.encode()).hexdigest()
+
+    # -- static analysis -----------------------------------------------------
+    def verify(self, level: Optional[str] = "error", passes=None,
+               feed_names=None, fetch_names=None):
+        """Run the static analyzer (paddle_tpu.analysis) over this
+        program and return every Diagnostic.
+
+        `level`: raise ProgramVerificationError when any diagnostic is
+        at or above this severity ("error" default; "warn"/"warning",
+        "info"); None or "off" never raises — inspect the returned list.
+        `passes`: restrict to specific pass ids (docs/analysis.md).
+        `feed_names`/`fetch_names`: optional runtime context that
+        sharpens the def-before-use and dead-op passes.
+        """
+        from ..analysis import verify_program
+
+        return verify_program(self, level=level, passes=passes,
+                              feed_names=feed_names,
+                              fetch_names=fetch_names)
 
     # -- clone / serialization ----------------------------------------------
     def clone(self, for_test: bool = False) -> "Program":

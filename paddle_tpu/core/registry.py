@@ -40,6 +40,12 @@ class OpInfo:
     # accept a list of vars
     inputs: Sequence[str] = ()
     outputs: Sequence[str] = ()
+    # slots that legitimately take MORE THAN ONE var (sum's X, concat's X,
+    # split's Out...) — the reference marks these per-slot with
+    # AsDuplicable() in the OpMaker (framework.proto OpProto::Var.duplicable);
+    # the analysis arity pass flags multi-name bindings to any other slot
+    dup_inputs: Sequence[str] = ()
+    dup_outputs: Sequence[str] = ()
     # attr defaults
     attrs: Dict = dataclasses.field(default_factory=dict)
     # in-place aliases {output_slot: input_slot} (optimizer ops: ParamOut<-Param)
@@ -67,6 +73,8 @@ def register_op(
     random: bool = False,
     not_differentiable: bool = False,
     host: bool = False,
+    dup_inputs: Sequence[str] = (),
+    dup_outputs: Sequence[str] = (),
 ):
     """Decorator: register `fn` as the lowering for op `type`."""
 
@@ -75,6 +83,8 @@ def register_op(
         info.lower = fn
         info.inputs = tuple(inputs)
         info.outputs = tuple(outputs)
+        info.dup_inputs = tuple(dup_inputs)
+        info.dup_outputs = tuple(dup_outputs)
         info.attrs = dict(attrs or {})
         info.diff_inputs = diff_inputs
         info.diff_outputs = diff_outputs

@@ -7,6 +7,12 @@ evaluate the op's jax lowering with `jax.eval_shape`, substituting a sentinel
 size for unknown (-1) dims and mapping it back afterwards.  Ops whose shapes
 depend on runtime metadata (LoD, rows) register explicit infer functions via
 `registry.register_infer_shape`.
+
+Inference failures are NOT silently swallowed into module state: callers
+that care (the analysis package's shape-inference pass, see
+paddle_tpu/analysis/passes.py) pass a `report` callback and receive a
+structured record per failure / dtype conflict; the build-time hot path
+(Block._post_insert) passes nothing and stays cheap.
 """
 from __future__ import annotations
 
@@ -14,16 +20,24 @@ import jax
 
 from . import registry
 from .execution import ExecContext
+from .framework import EMPTY_VAR_NAMES
 from .types import np_dtype
 
 # sentinel for unknown dims; any output dim equal to a multiple/exact match is
 # mapped back to -1.  Chosen large & prime so arithmetic collisions are rare.
 _SENTINEL = 8191
 
-_failed_ops = set()  # op types whose default inference failed (debug aid)
 
-
-def default_infer_shape(op, block):
+def default_infer_shape(op, block, report=None):
+    """Infer output var shapes/dtypes of `op` via jax.eval_shape over its
+    lowering.  `report(kind, **details)` (optional) receives:
+      * kind="infer-fail",     error=exc          — eval_shape raised;
+      * kind="unknown-input",  name=var_name      — an input var has no
+        declared shape/dtype yet, so nothing can be inferred;
+      * kind="dtype-mismatch", name=..., declared=..., inferred=... —
+        the op computes a different dtype than the shared output var
+        already declares (two writers disagreeing on one name).
+    """
     info = registry.get_op_info(op.type)
     if info.type != op.type:
         return  # generic grad op: grads share forward shapes, handled below
@@ -31,11 +45,13 @@ def default_infer_shape(op, block):
     for slot, names in op.inputs.items():
         vals = []
         for n in names:
-            if n in ("", "@EMPTY@"):
+            if n in EMPTY_VAR_NAMES:
                 vals.append(None)
                 continue
             v = block.var(n)
             if v.shape is None or v.dtype is None:
+                if report is not None:
+                    report("unknown-input", name=n)
                 return
             shape = tuple(_SENTINEL if d < 0 else d for d in v.shape)
             vals.append(jax.ShapeDtypeStruct(shape, np_dtype(v.dtype)))
@@ -44,9 +60,12 @@ def default_infer_shape(op, block):
     ctx = ExecContext(jax.random.key(0))
     try:
         outs = jax.eval_shape(lambda i: info.lower(ctx, i, attrs), ins)
-    except Exception:
-        _failed_ops.add(op.type)
+    except Exception as e:  # abstract eval of arbitrary lowerings
+        if report is not None:
+            report("infer-fail", error=e)
         return
+    from .types import canonical_dtype
+
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
         if vals is None:
@@ -54,7 +73,7 @@ def default_infer_shape(op, block):
         if not isinstance(vals, (list, tuple)):
             vals = [vals]
         for name, aval in zip(names, vals):
-            if name in ("", "@EMPTY@") or aval is None:
+            if name in EMPTY_VAR_NAMES or aval is None:
                 continue
             leaves = jax.tree_util.tree_leaves(aval)
             if len(leaves) != 1:
@@ -66,9 +85,43 @@ def default_infer_shape(op, block):
             var.shape = tuple(
                 -1 if d == _SENTINEL else int(d) for d in aval.shape
             )
+            inferred = canonical_dtype(aval.dtype)
+            if (report is not None and var.dtype is not None
+                    and var.dtype != inferred
+                    and var.op is not None and var.op is not op):
+                # a DIFFERENT op (the var's recorded producer) already
+                # declared another dtype for this shared name; a lone
+                # writer re-inferred under changed flags (amp) is not a
+                # program bug
+                report("dtype-mismatch", name=name, declared=var.dtype,
+                       inferred=inferred)
+            var.dtype = inferred
+
+
+def set_output_shape(op, block, slot, shape, dtype=None):
+    """Helper for explicit infer fns: declare shape/dtype for every var
+    bound to output `slot` (sentinel/undeclared names skipped)."""
+    for name in op.output(slot):
+        if name in EMPTY_VAR_NAMES:
+            continue
+        var = block.vars.get(name)
+        if var is None:
+            continue
+        var.shape = tuple(int(d) for d in shape)
+        if dtype is not None and var.dtype is None:
             from .types import canonical_dtype
 
-            var.dtype = canonical_dtype(aval.dtype)
+            var.dtype = canonical_dtype(dtype)
+
+
+def input_var(op, block, slot):
+    """First var bound to input `slot`, or None (explicit infer fns use
+    this to mirror input shapes; KeyError propagates for dangling names
+    so callers see the same contract as default_infer_shape)."""
+    names = op.input(slot)
+    if not names or names[0] in EMPTY_VAR_NAMES:
+        return None
+    return block.var(names[0])
 
 
 def infer_grad_shapes(op, block):

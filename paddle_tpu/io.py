@@ -222,6 +222,13 @@ def load_inference_model(dirname, executor, model_filename=None,
     with open(model_path) as f:
         payload = json.load(f)
     program = Program.from_dict(payload["program"])
+    # deserialized programs come from disk, not from this process's
+    # builders — verify (PADDLE_TPU_VERIFY-gated inside preflight)
+    # before executing anything against them
+    from .analysis import preflight
+
+    preflight(program, feed_names=payload.get("feed_var_names"),
+              fetch_names=payload.get("fetch_var_names"))
     load_persistables(executor, dirname, program,
                       filename=params_filename, scope=scope)
     return (program, payload["feed_var_names"],

@@ -31,7 +31,7 @@ def _host_seed(ctx, attrs) -> int:
     return zlib.crc32(name.encode()) & 0x7FFFFFFF
 
 
-@register_op("fill_constant", outputs=("Out",),
+@register_op("fill_constant", inputs=(), outputs=("Out",),
              attrs={"shape": [1], "value": 0.0, "dtype": "float32",
                     "force_cpu": False},
              not_differentiable=True)
@@ -70,7 +70,7 @@ def assign(ctx, ins, attrs):
     return {"Out": one(ins, "X")}
 
 
-@register_op("assign_value", outputs=("Out",),
+@register_op("assign_value", inputs=(), outputs=("Out",),
              attrs={"shape": [1], "dtype": "float32", "values": []},
              not_differentiable=True)
 def assign_value(ctx, ins, attrs):
@@ -87,13 +87,13 @@ def cast(ctx, ins, attrs):
 
 
 @register_op("increment", inputs=("X",), outputs=("Out",),
-             attrs={"step": 1.0})
+             attrs={"step": 1.0}, inplace={"Out": "X"})
 def increment(ctx, ins, attrs):
     x = data_of(one(ins, "X"))
     return {"Out": x + jnp.asarray(attrs["step"], x.dtype)}
 
 
-@register_op("uniform_random", outputs=("Out",),
+@register_op("uniform_random", inputs=(), outputs=("Out",),
              attrs={"shape": [1], "min": -1.0, "max": 1.0, "seed": 0,
                     "dtype": "float32", "force_cpu": False},
              random=True, not_differentiable=True)
@@ -111,7 +111,7 @@ def uniform_random(ctx, ins, attrs):
         minval=attrs["min"], maxval=attrs["max"]).astype(dt)}
 
 
-@register_op("gaussian_random", outputs=("Out",),
+@register_op("gaussian_random", inputs=(), outputs=("Out",),
              attrs={"shape": [1], "mean": 0.0, "std": 1.0, "seed": 0,
                     "dtype": "float32", "force_cpu": False},
              random=True, not_differentiable=True)

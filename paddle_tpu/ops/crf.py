@@ -277,3 +277,31 @@ def chunk_eval(ctx, ins, attrs):
         "NumLabelChunks": np.int64(n_lab),
         "NumCorrectChunks": np.int64(n_cor),
     }
+
+
+# -- explicit build-time shape inference (LoD-dependent) ---------------------
+
+from ..core.registry import register_infer_shape  # noqa: E402
+from ..core.shape_inference import input_var, set_output_shape  # noqa: E402
+
+
+@register_infer_shape("linear_chain_crf")
+def _infer_linear_chain_crf(op, block):
+    e = input_var(op, block, "Emission")
+    t = input_var(op, block, "Transition")
+    if e is None or e.shape is None:
+        return
+    set_output_shape(op, block, "Alpha", e.shape, e.dtype)
+    set_output_shape(op, block, "EmissionExps", e.shape, e.dtype)
+    if t is not None and t.shape is not None:
+        set_output_shape(op, block, "TransitionExps", t.shape, e.dtype)
+    # one log-likelihood row per sequence (count in the LoD)
+    set_output_shape(op, block, "LogLikelihood", (-1, 1), e.dtype)
+
+
+@register_infer_shape("crf_decoding")
+def _infer_crf_decoding(op, block):
+    e = input_var(op, block, "Emission")
+    if e is None or e.shape is None:
+        return
+    set_output_shape(op, block, "ViterbiPath", (e.shape[0], 1), "int64")

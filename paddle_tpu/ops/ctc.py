@@ -153,3 +153,19 @@ def ctc_align(ctx, ins, attrs):
     data = np.asarray(out_rows, np.int64).reshape(-1, 1) if out_rows \
         else np.zeros((0, 1), np.int64)
     return {"Output": LoDTensor(data, [lod_from_seq_lens(out_lens)])}
+
+
+# -- explicit build-time shape inference (LoD-dependent) ---------------------
+
+from ..core.registry import register_infer_shape  # noqa: E402
+from ..core.shape_inference import input_var, set_output_shape  # noqa: E402
+
+
+@register_infer_shape("warpctc")
+def _infer_warpctc(op, block):
+    logits = input_var(op, block, "Logits")
+    if logits is None or logits.shape is None:
+        return
+    # one loss row per sequence; the count lives in the LoD
+    set_output_shape(op, block, "Loss", (-1, 1), logits.dtype)
+    set_output_shape(op, block, "WarpCTCGrad", logits.shape, logits.dtype)

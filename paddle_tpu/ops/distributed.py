@@ -33,6 +33,7 @@ def reset_clients():
 
 @register_op("send", inputs=("X",), outputs=("Out",),
              attrs={"endpoints": [], "epmap": []},
+             dup_inputs=("X",), dup_outputs=("Out",),
              not_differentiable=True, host=True)
 def send(ctx, ins, attrs):
     """Push grads to their endpoints, barrier, pull updated params
@@ -54,6 +55,7 @@ def send(ctx, ins, attrs):
 
 @register_op("recv", inputs=("X",), outputs=("Out",),
              attrs={"endpoint": ""},
+             dup_inputs=("X",), dup_outputs=("Out",),
              not_differentiable=True, host=True)
 def recv(ctx, ins, attrs):
     """Standalone param fetch (recv_op.cc:28-53)."""
@@ -65,6 +67,7 @@ def recv(ctx, ins, attrs):
 @register_op("listen_and_serv", inputs=("X",), outputs=(),
              attrs={"endpoint": "127.0.0.1:0", "Fanin": 1,
                     "sync_mode": True},
+             dup_inputs=("X",),
              not_differentiable=True, host=True)
 def listen_and_serv(ctx, ins, attrs):
     """Run a VariableServer over this op's sub-block as the optimize

@@ -79,7 +79,8 @@ def scale(ctx, ins, attrs):
 
 
 @register_op("clip", inputs=("X",), outputs=("Out",),
-             attrs={"min": -1.0, "max": 1.0})
+             attrs={"min": -1.0, "max": 1.0},
+             inplace={"Out": "X"})
 def clip(ctx, ins, attrs):
     xv = one(ins, "X")
     out = jnp.clip(data_of(xv), attrs["min"], attrs["max"])
@@ -97,7 +98,8 @@ def clip_by_norm(ctx, ins, attrs):
     return {"Out": with_lod_of(xv, x * factor.astype(x.dtype))}
 
 
-@register_op("sum", inputs=("X",), outputs=("Out",))
+@register_op("sum", inputs=("X",), outputs=("Out",),
+             dup_inputs=("X",))
 def sum_op(ctx, ins, attrs):
     """Fan-in accumulator.  Handles dense + SelectedRows mixtures exactly as
     the reference sum_op / math/selected_rows_functor do: all-sparse in,

@@ -126,6 +126,7 @@ def load_lower(ctx, ins, attrs):
     inputs=("X",),
     outputs=(),
     attrs={"file_path": "", "overwrite": True},
+    dup_inputs=("X",),
     not_differentiable=True,
     host=True,
 )
@@ -152,6 +153,7 @@ def save_combine_lower(ctx, ins, attrs):
     inputs=(),
     outputs=("Out",),
     attrs={"file_path": ""},
+    dup_outputs=("Out",),
     not_differentiable=True,
     host=True,
 )
@@ -286,6 +288,7 @@ def create_batch_reader(ctx, ins, attrs):
 
 
 @register_op("read", inputs=("Reader",), outputs=("Out",),
+             dup_outputs=("Out",),
              not_differentiable=True, host=True)
 def read(ctx, ins, attrs):
     """Pull the next item from a reader into the output vars

@@ -153,3 +153,20 @@ def smooth_l1_loss(ctx, ins, attrs):
         val = val * data_of(ow)
     return {"Diff": diff,
             "Out": jnp.sum(val, axis=tuple(range(1, val.ndim))).reshape(-1, 1)}
+
+
+# -- explicit build-time shape inference -------------------------------------
+
+from ..core.registry import register_infer_shape  # noqa: E402
+from ..core.shape_inference import input_var, set_output_shape  # noqa: E402
+
+
+@register_infer_shape("cross_entropy")
+def _infer_cross_entropy(op, block):
+    """One loss value per row: [..., C] -> [..., 1].  Default inference
+    trips when X and Label carry DIFFERENT -1 row sentinels (both map to
+    the same placeholder size only if the dims really agree)."""
+    x = input_var(op, block, "X")
+    if x is None or x.shape is None:
+        return
+    set_output_shape(op, block, "Y", tuple(x.shape[:-1]) + (1,), x.dtype)

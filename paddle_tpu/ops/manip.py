@@ -36,6 +36,7 @@ def transpose(ctx, ins, attrs):
 
 
 @register_op("concat", inputs=("X",), outputs=("Out",),
+             dup_inputs=("X",),
              attrs={"axis": 0})
 def concat(ctx, ins, attrs):
     vs = many(ins, "X")
@@ -49,6 +50,7 @@ def concat(ctx, ins, attrs):
 
 
 @register_op("split", inputs=("X",), outputs=("Out",),
+             dup_outputs=("Out",),
              attrs={"axis": 0, "num": 0, "sections": []})
 def split(ctx, ins, attrs):
     x = data_of(one(ins, "X"))
@@ -132,7 +134,8 @@ def squeeze(ctx, ins, attrs):
     return {"Out": jnp.squeeze(x, axis=tuple(axes) if axes else None)}
 
 
-@register_op("stack", inputs=("X",), outputs=("Out",), attrs={"axis": 0})
+@register_op("stack", inputs=("X",), outputs=("Out",), attrs={"axis": 0},
+             dup_inputs=("X",))
 def stack(ctx, ins, attrs):
     xs = [data_of(v) for v in many(ins, "X")]
     return {"Out": jnp.stack(xs, axis=attrs["axis"])}

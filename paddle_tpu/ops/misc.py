@@ -129,6 +129,7 @@ def label_smooth(ctx, ins, attrs):
 
 
 @register_op("multiplex", inputs=("Ids", "X"), outputs=("Out",),
+             dup_inputs=("X",),
              diff_inputs=("X",))
 def multiplex(ctx, ins, attrs):
     """Out[i] = X[Ids[i]][i] — per-row gather across candidate tensors
@@ -180,6 +181,7 @@ def cond(ctx, ins, attrs):
 
 
 @register_op("split_selected_rows", inputs=("X",), outputs=("Out",),
+             dup_outputs=("Out",),
              attrs={"height_sections": []},
              not_differentiable=True, host=True)
 def split_selected_rows(ctx, ins, attrs):

@@ -275,3 +275,55 @@ def gru_unit(ctx, ins, attrs):
     h = h_prev + u * (cand - h_prev)
     gate = jnp.concatenate([u, r, cand], axis=1)
     return {"Gate": gate, "ResetHiddenPrev": rh, "Hidden": h}
+
+
+# ---------------------------------------------------------------------------
+# explicit build-time shape inference (LoD-driven recurrences)
+# ---------------------------------------------------------------------------
+# The fused recurrences consume LoDTensors (per-sequence scan boundaries),
+# which eval_shape-based default inference cannot model.  Row counts follow
+# the input rows; widths come from the weight shapes.
+
+from ..core.registry import register_infer_shape  # noqa: E402
+from ..core.shape_inference import input_var, set_output_shape  # noqa: E402
+
+
+@register_infer_shape("lstm")
+def _infer_lstm(op, block):
+    x = input_var(op, block, "Input")
+    w = input_var(op, block, "Weight")
+    if x is None or x.shape is None or w is None or w.shape is None:
+        return
+    n, d = x.shape[0], w.shape[0]
+    set_output_shape(op, block, "Hidden", (n, d), x.dtype)
+    set_output_shape(op, block, "Cell", (n, d), x.dtype)
+    set_output_shape(op, block, "BatchGate", (n, 4 * d), x.dtype)
+    set_output_shape(op, block, "BatchCellPreAct", (n, d), x.dtype)
+
+
+@register_infer_shape("lstmp")
+def _infer_lstmp(op, block):
+    x = input_var(op, block, "Input")
+    w = input_var(op, block, "Weight")          # [P, 4D]
+    pw = input_var(op, block, "ProjWeight")     # [D, P]
+    if any(v is None or v.shape is None for v in (x, w, pw)):
+        return
+    n, d, p = x.shape[0], w.shape[1] // 4, pw.shape[1]
+    set_output_shape(op, block, "Projection", (n, p), x.dtype)
+    set_output_shape(op, block, "Cell", (n, d), x.dtype)
+    set_output_shape(op, block, "BatchGate", (n, 4 * d), x.dtype)
+    set_output_shape(op, block, "BatchHidden", (n, d), x.dtype)
+    set_output_shape(op, block, "BatchCellPreAct", (n, d), x.dtype)
+
+
+@register_infer_shape("gru")
+def _infer_gru(op, block):
+    x = input_var(op, block, "Input")
+    w = input_var(op, block, "Weight")          # [D, 3D]
+    if x is None or x.shape is None or w is None or w.shape is None:
+        return
+    n, d = x.shape[0], w.shape[0]
+    set_output_shape(op, block, "Hidden", (n, d), x.dtype)
+    set_output_shape(op, block, "BatchGate", (n, 3 * d), x.dtype)
+    set_output_shape(op, block, "BatchResetHiddenPrev", (n, d), x.dtype)
+    set_output_shape(op, block, "BatchHidden", (n, d), x.dtype)

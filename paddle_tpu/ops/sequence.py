@@ -171,6 +171,7 @@ def sequence_expand(ctx, ins, attrs):
 
 
 @register_op("sequence_concat", inputs=("X",), outputs=("Out",),
+             dup_inputs=("X",),
              attrs={"axis": 0, "level": 0})
 def sequence_concat(ctx, ins, attrs):
     """Concatenate corresponding sequences from each input (reference
@@ -378,3 +379,58 @@ def sequence_mask(ctx, ins, attrs):
     j = jnp.arange(maxlen)
     return {"Y": (j[None, :] < lens[:, None]).astype(
         attrs.get("out_dtype", "float32"))}
+
+
+# ---------------------------------------------------------------------------
+# explicit build-time shape inference (LoD-dependent ops)
+# ---------------------------------------------------------------------------
+# The default eval_shape-based inference only sees abstract arrays; these
+# lowerings require real LoD metadata, so they would otherwise be reported
+# by the analysis shape-inference pass as inference failures.  Row counts
+# that depend on the LoD are declared as -1 (data-dependent).
+
+from ..core.registry import register_infer_shape  # noqa: E402
+from ..core.shape_inference import input_var, set_output_shape  # noqa: E402
+
+
+@register_infer_shape("sequence_pool")
+def _infer_sequence_pool(op, block):
+    x = input_var(op, block, "X")
+    if x is None or x.shape is None:
+        return
+    # one pooled row per sequence; the sequence count lives in the LoD
+    set_output_shape(op, block, "Out", (-1,) + tuple(x.shape[1:]), x.dtype)
+
+
+@register_infer_shape("sequence_softmax")
+def _infer_sequence_softmax(op, block):
+    x = input_var(op, block, "X")
+    if x is None or x.shape is None:
+        return
+    set_output_shape(op, block, "Out", x.shape, x.dtype)
+
+
+@register_infer_shape("sequence_expand")
+def _infer_sequence_expand(op, block):
+    x = input_var(op, block, "X")
+    if x is None or x.shape is None:
+        return
+    set_output_shape(op, block, "Out", (-1,) + tuple(x.shape[1:]), x.dtype)
+
+
+@register_infer_shape("sequence_conv")
+def _infer_sequence_conv(op, block):
+    x = input_var(op, block, "X")
+    f = input_var(op, block, "Filter")
+    if x is None or x.shape is None or f is None or f.shape is None:
+        return
+    set_output_shape(op, block, "Out", (x.shape[0], f.shape[1]), x.dtype)
+
+
+@register_infer_shape("sequence_reshape")
+def _infer_sequence_reshape(op, block):
+    x = input_var(op, block, "X")
+    if x is None or x.shape is None:
+        return
+    new_dim = int(op.attrs.get("new_dim", 1))
+    set_output_shape(op, block, "Out", (-1, new_dim), x.dtype)

@@ -76,6 +76,13 @@ class ParallelExecutor(ShardedCheckpointMixin):
             v.name if isinstance(v, Variable) else str(v)
             for v in fetch_list
         ]
+        # PADDLE_TPU_VERIFY pre-flight, same contract as Executor.run
+        # (gated inside preflight): a bad graph fails here in ms, not
+        # minutes into the SPMD trace
+        from ..analysis import preflight
+
+        preflight(program, feed_names=self.feed_names,
+                  fetch_names=self.fetch_names)
         self._fn = program_to_fn(program, self.feed_names, self.fetch_names)
         self._seed = seed
         self._step = 0

@@ -156,6 +156,13 @@ class PipelineExecutor(ShardedCheckpointMixin):
         self._seed = seed
         self._step = 0
 
+        # PADDLE_TPU_VERIFY pre-flight, gated inside preflight
+        # (distributed-lint checks the pipeline_stage annotations this
+        # executor is about to trust)
+        from ..analysis import preflight
+
+        preflight(program, feed_names=self.feed_names,
+                  fetch_names=self.fetch_names)
         block = program.global_block()
         self._persistable = {v.name for v in program.list_vars()
                              if v.persistable}

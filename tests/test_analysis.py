@@ -1,0 +1,502 @@
+"""Static program verifier (paddle_tpu.analysis): golden diagnostics.
+
+One deliberately-broken program per analysis pass, asserting the exact
+(pass id, severity, op index) of the expected diagnostic; plus the
+runtime wiring (Program.verify raise levels, Executor pre-flight under
+PADDLE_TPU_VERIFY, cli verify, debugger annotation) and an end-to-end
+check that realistic model programs verify clean at level=error.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import ProgramVerificationError
+from paddle_tpu.core.flags import set_flags
+
+
+def find(diags, pass_id, severity=None):
+    out = [d for d in diags if d.pass_id == pass_id
+           and (severity is None or d.severity == severity)]
+    return out
+
+
+def fresh_block():
+    p = fluid.Program()
+    return p, p.global_block()
+
+
+# ---------------------------------------------------------------------------
+# golden diagnostics, one seeded defect per pass
+# ---------------------------------------------------------------------------
+
+
+def test_def_before_use_dangling_input_is_error():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("relu", {"X": ["never_created"]}, {"Out": ["y"]})
+    d, = find(p.verify(level=None), "def-before-use", "error")
+    assert d.block_idx == 0 and d.op_idx == 0
+    assert "never_created" in d.message
+
+
+def test_def_before_use_read_before_producer_warns():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("relu", {"X": ["late"]}, {"Out": ["y"]})      # reads first
+    b.append_op("relu", {"X": ["x"]}, {"Out": ["late"]})      # produces later
+    d, = find(p.verify(level=None), "def-before-use", "warning")
+    assert d.op_idx == 0 and "'late'" in d.message
+    # ...but a feed by that name makes the read legitimate
+    assert not find(p.verify(level=None, feed_names=["late"]),
+                    "def-before-use", "warning")
+
+
+def test_op_arity_undeclared_slot_is_error():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("relu", {"Bogus": ["x"]}, {"Out": ["y"]})
+    d, = find(p.verify(level=None), "op-arity", "error")
+    assert d.op_idx == 0 and "'Bogus'" in d.message
+
+
+def test_op_arity_unregistered_op_is_error():
+    p, b = fresh_block()
+    b.append_op("no_such_op_exists", {}, {"Out": ["y"]})
+    d, = find(p.verify(level=None), "op-arity", "error")
+    assert d.op_idx == 0 and "not registered" in d.message
+
+
+def test_op_arity_non_duplicable_multi_bind_warns():
+    p, b = fresh_block()
+    for n in ("a", "b"):
+        b.create_var(name=n, shape=[2], dtype="float32")
+    b.append_op("relu", {"X": ["a", "b"]}, {"Out": ["y"]})
+    d, = find(p.verify(level=None), "op-arity", "warning")
+    assert d.op_idx == 0 and "non-duplicable" in d.message
+    # duplicable slots (sum's X) stay clean
+    p2, b2 = fresh_block()
+    for n in ("a", "b"):
+        b2.create_var(name=n, shape=[2], dtype="float32")
+    b2.append_op("sum", {"X": ["a", "b"]}, {"Out": ["s"]})
+    assert not find(p2.verify(level=None), "op-arity")
+
+
+def test_shape_inference_failure_is_reported_not_swallowed():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 3], dtype="float32")
+    b.create_var(name="y", shape=[7, 5], dtype="float32")
+    b.append_op("mul", {"X": ["x"], "Y": ["y"]}, {"Out": ["z"]})
+    d, = find(p.verify(level=None), "shape-inference", "warning")
+    assert d.op_idx == 0 and d.op_type == "mul"
+    assert "shape inference failed" in d.message
+    # the old module-global silent-failure set is gone for good
+    from paddle_tpu.core import shape_inference
+    assert not hasattr(shape_inference, "_failed_ops")
+
+
+def test_shape_inference_dtype_conflict_between_writers():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("relu", {"X": ["x"]}, {"Out": ["shared"]})
+    b.append_op("cast", {"X": ["x"]}, {"Out": ["shared"]},
+                {"out_dtype": "int32"})
+    ds = find(p.verify(level=None), "shape-inference", "warning")
+    assert any("already declared" in d.message and "'shared'" in d.message
+               for d in ds)
+
+
+def test_shape_inference_does_not_mutate_program():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("relu", {"X": ["x"]}, {"Out": ["y"]})
+    before = (b.vars["y"].shape, b.vars["y"].dtype)
+    p.verify(level=None)
+    assert (b.vars["y"].shape, b.vars["y"].dtype) == before
+
+
+def test_dead_op_detected_with_fetch_context():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("tanh", {"X": ["x"]}, {"Out": ["unused"]})
+    b.append_op("relu", {"X": ["x"]}, {"Out": ["y"]})
+    d, = find(p.verify(level=None, fetch_names=["y"]), "dead-op",
+              "warning")
+    assert d.op_idx == 0 and d.op_type == "tanh"
+    # without fetch context the same finding is informational only (and
+    # the leaf op producing 'y' is info-flagged too — it MAY be the
+    # fetch target, the verifier cannot know)
+    infos = find(p.verify(level=None), "dead-op", "info")
+    assert any(d.op_idx == 0 and d.op_type == "tanh" for d in infos)
+    assert not find(p.verify(level=None), "dead-op", "warning")
+
+
+def test_var_shadowing_mismatch_across_blocks_warns():
+    p, b = fresh_block()
+    b.create_var(name="v", shape=[4, 4], dtype="float32")
+    sub = p.create_block()
+    sub.vars["v"] = fluid.core.framework.Variable(
+        sub, "v", shape=[8], dtype="int64")
+    d, = find(p.verify(level=None), "var-shadowing", "warning")
+    assert d.block_idx == 1 and "shadows" in d.message
+
+
+def test_control_flow_invalid_sub_block_index_is_error():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2], dtype="float32")
+    b.append_op("conditional_block", {"X": ["x"]}, {"Out": ["o"]},
+                {"sub_block": {"__block__": 99}})
+    d, = find(p.verify(level=None), "control-flow", "error")
+    assert d.op_idx == 0 and "99" in d.message
+
+
+def test_corrupt_parent_idx_reports_instead_of_crashing():
+    # a deserialized/corrupt program must produce diagnostics from every
+    # pass, not an IndexError inside the verifier
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2], dtype="float32")
+    sub = p.create_block()
+    sub.parent_idx = 99
+    sub.vars["x"] = fluid.core.framework.Variable(
+        sub, "x", shape=[5], dtype="int64")
+    ds = p.verify(level=None)
+    d, = find(ds, "control-flow", "error")
+    assert "invalid parent_idx" in d.message and d.block_idx == 1
+
+
+def test_distributed_lint_honors_registered_attr_defaults():
+    # dispatch overlays registered defaults ({**info.attrs, **op.attrs});
+    # the lint must see the same effective attrs — a collective relying
+    # on the default ring_id='dp' is a legal program
+    p, b = fresh_block()
+    b.create_var(name="g", shape=[4], dtype="float32")
+    b.append_op("c_allreduce_sum", {"X": ["g"]}, {"Out": ["g2"]})
+    assert not find(p.verify(level=None), "distributed-lint", "error")
+    # an explicitly emptied ring_id is still an error
+    p2, b2 = fresh_block()
+    b2.create_var(name="g", shape=[4], dtype="float32")
+    b2.append_op("c_allreduce_sum", {"X": ["g"]}, {"Out": ["g2"]},
+                 {"ring_id": ""})
+    assert find(p2.verify(level=None), "distributed-lint", "error")
+
+
+def test_distributed_send_without_endpoints_is_error():
+    p, b = fresh_block()
+    b.create_var(name="g", shape=[2], dtype="float32")
+    b.append_op("send", {"X": ["g"]}, {"Out": ["p0"]},
+                {"endpoints": [], "epmap": []})
+    d, = find(p.verify(level=None), "distributed-lint", "error")
+    assert d.op_idx == 0 and "send" in d.message
+
+
+def test_distributed_epmap_arity_mismatch_is_error():
+    p, b = fresh_block()
+    for n in ("g1", "g2"):
+        b.create_var(name=n, shape=[2], dtype="float32")
+    b.append_op("send", {"X": ["g1", "g2"]}, {"Out": ["p"]},
+                {"endpoints": ["h:1"], "epmap": ["h:1", "h:1", "h:1"]})
+    ds = find(p.verify(level=None), "distributed-lint", "error")
+    assert any("epmap" in d.message for d in ds)
+
+
+def test_distributed_pipeline_stage_monotonicity():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2], dtype="float32")
+    b.append_op("relu", {"X": ["x"]}, {"Out": ["a"]},
+                {"pipeline_stage": 1})
+    b.append_op("relu", {"X": ["a"]}, {"Out": ["b"]},
+                {"pipeline_stage": 0})
+    d, = find(p.verify(level=None), "distributed-lint", "warning")
+    assert d.op_idx == 1 and "pipeline_stage decreases" in d.message
+    # grad ops inherit stages in reverse order BY DESIGN: not flagged
+    p2, b2 = fresh_block()
+    b2.create_var(name="x", shape=[2], dtype="float32")
+    b2.append_op("relu", {"X": ["x"]}, {"Out": ["a"]},
+                 {"pipeline_stage": 0})
+    b2.append_op("relu", {"X": ["a"]}, {"Out": ["b"]},
+                 {"pipeline_stage": 1})
+    b2.append_op("relu_grad", {"X": ["a"], "Out": ["b"],
+                               "Out@GRAD": ["b@GRAD"]},
+                 {"X@GRAD": ["a@GRAD"]}, {"pipeline_stage": 1})
+    b2.append_op("relu_grad", {"X": ["x"], "Out": ["a"],
+                               "Out@GRAD": ["a@GRAD"]},
+                 {"X@GRAD": ["x@GRAD"]}, {"pipeline_stage": 0})
+    assert not find(p2.verify(level=None), "distributed-lint", "warning")
+
+
+def test_inplace_alias_undeclared_with_later_reader_warns():
+    p, b = fresh_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.append_op("relu", {"X": ["x"]}, {"Out": ["x"]})      # undeclared alias
+    b.append_op("tanh", {"X": ["x"]}, {"Out": ["y"]})      # later reader
+    d, = find(p.verify(level=None), "inplace-alias", "warning")
+    assert d.op_idx == 0 and "'x'" in d.message
+    # declared aliases (sgd Param->ParamOut, increment, clip) stay clean
+    p2, b2 = fresh_block()
+    b2.create_var(name="c", shape=[1], dtype="float32")
+    b2.append_op("increment", {"X": ["c"]}, {"Out": ["c"]}, {"step": 1.0})
+    b2.append_op("scale", {"X": ["c"]}, {"Out": ["d"]})
+    assert not find(p2.verify(level=None), "inplace-alias")
+
+
+# ---------------------------------------------------------------------------
+# verify() surface: levels, pass filtering, custom passes
+# ---------------------------------------------------------------------------
+
+
+def broken_program():
+    p, b = fresh_block()
+    b.append_op("relu", {"X": ["nope"]}, {"Out": ["y"]})
+    return p
+
+
+def test_verify_levels_and_raise():
+    p = broken_program()
+    with pytest.raises(ProgramVerificationError) as ei:
+        p.verify(level="error")
+    assert any(d.pass_id == "def-before-use"
+               for d in ei.value.diagnostics)
+    # level=None returns without raising
+    assert find(p.verify(level=None), "def-before-use", "error")
+
+
+def test_verify_pass_filter():
+    p = broken_program()
+    ds = p.verify(level=None, passes=["dead-op"])
+    assert ds and all(d.pass_id == "dead-op" for d in ds)
+    with pytest.raises(KeyError):
+        p.verify(level=None, passes=["no-such-pass"])
+
+
+def test_custom_pass_registration():
+    pass_id = "test-no-tanh"
+
+    @analysis.register_pass(pass_id)
+    def no_tanh(ctx):
+        for block, idx, op in ctx.iter_ops():
+            if op.type == "tanh":
+                yield ctx.diag("error", "tanh is banned here", block,
+                               idx, op)
+
+    try:
+        p, b = fresh_block()
+        b.create_var(name="x", shape=[2], dtype="float32")
+        b.append_op("tanh", {"X": ["x"]}, {"Out": ["y"]})
+        d, = find(p.verify(level=None, passes=[pass_id]), pass_id)
+        assert d.severity == "error" and d.op_idx == 0
+    finally:
+        analysis.registry._PASSES.pop(pass_id, None)
+
+
+# ---------------------------------------------------------------------------
+# executor pre-flight gated by PADDLE_TPU_VERIFY
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_error_mode_raises_before_execution():
+    set_flags({"verify": "error"})
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(ProgramVerificationError):
+            exe.run(broken_program(), feed={}, fetch_list=[])
+    finally:
+        set_flags({"verify": "off"})
+
+
+def test_preflight_warn_mode_warns_once_and_still_runs():
+    import warnings as warnings_mod
+
+    set_flags({"verify": "warn"})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+            fluid.layers.tanh(x)               # dead op -> warning
+            y = fluid.layers.relu(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"x": np.ones((2, 3), np.float32)}
+        with pytest.warns(RuntimeWarning, match="program verification"):
+            out, = exe.run(main, feed=feed, fetch_list=[y])
+        assert out.shape == (2, 3)
+        # cached per (program, version): the second run must NOT re-warn
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error")
+            out, = exe.run(main, feed=feed, fetch_list=[y])
+    finally:
+        set_flags({"verify": "off"})
+
+
+def test_preflight_no_fetch_run_does_not_fake_fetch_context():
+    # exe.run with no fetch_list means "fetch context unknown", not
+    # "known-empty fetch set" — a warm-up run must not warn that the
+    # program's leaf output is a dead op
+    import warnings as warnings_mod
+
+    set_flags({"verify": "warn"})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            fluid.layers.fc(input=x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with warnings_mod.catch_warnings(record=True) as w:
+            warnings_mod.simplefilter("always")
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)})
+        assert not [x for x in w
+                    if "program verification" in str(x.message)]
+    finally:
+        set_flags({"verify": "off"})
+
+
+def test_preflight_off_is_default_and_skips():
+    exe = fluid.Executor(fluid.CPUPlace())
+    # broken program, flag off: pre-flight silent; failure only at the
+    # missing-lowering point — proves verification is genuinely gated
+    p, b = fresh_block()
+    b.append_op("no_such_op", {}, {"Out": ["y"]})
+    with pytest.raises(NotImplementedError):
+        exe.run(p, feed={}, fetch_list=[])
+
+
+# ---------------------------------------------------------------------------
+# create_var collision (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_create_var_collision_with_conflicting_kwargs_raises():
+    p, b = fresh_block()
+    b.create_var(name="v", shape=[2, 3], dtype="float32")
+    with pytest.raises(ValueError, match="collides"):
+        b.create_var(name="v", shape=[9, 9], dtype="float32")
+    with pytest.raises(ValueError, match="collides"):
+        b.create_var(name="v", shape=[2, 3], dtype="int64")
+    # matching / unspecified kwargs keep returning the existing var
+    assert b.create_var(name="v", shape=[2, 3], dtype="float32") \
+        is b.vars["v"]
+    assert b.create_var(name="v", dtype=None) is b.vars["v"]
+    assert b.create_var(name="v") is b.vars["v"]
+
+
+# ---------------------------------------------------------------------------
+# cli verify + debugger annotation + lint
+# ---------------------------------------------------------------------------
+
+
+def test_cli_verify_model_dir(tmp_path, capsys):
+    from paddle_tpu.cli import cmd_verify
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ok_dir = tmp_path / "ok_model"
+    fluid.io.save_inference_model(str(ok_dir), ["x"], [y], exe,
+                                  main_program=main)
+    assert cmd_verify([str(ok_dir)]) == 0
+    assert "all clean" in capsys.readouterr().out
+
+    bad_dir = tmp_path / "bad_model"
+    bad_dir.mkdir()
+    payload = {"program": broken_program().to_dict(),
+               "feed_var_names": [], "fetch_var_names": ["y"]}
+    with open(bad_dir / "__model__", "w") as f:
+        json.dump(payload, f)
+    assert cmd_verify([str(bad_dir)]) == 1
+    assert "def-before-use" in capsys.readouterr().out
+
+
+def test_debugger_dump_annotates_flagged_ops():
+    from paddle_tpu import debugger
+
+    p = broken_program()
+    ds = p.verify(level=None)
+    code = debugger.program_to_code(p, diagnostics=ds, skip_vars=True)
+    assert "// !! [error] def-before-use" in code
+    dot = debugger.draw_block_graphviz(p.global_block(), diagnostics=ds)
+    assert "salmon" in dot and "def-before-use" in dot
+    # verify=True convenience runs the analyzer itself
+    assert "// !!" in debugger.program_to_code(p, verify=True)
+
+
+def test_repo_lint_rules(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import lint as lint_mod
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad_mod.py"
+    bad.write_text(
+        "@register_op('x', outputs=('Out',))\n"
+        "def f():\n    pass\n")
+    assert lint_mod.lint([str(bad)]) == 1
+    good = tmp_path / "good_mod.py"
+    good.write_text(
+        "@register_op('x', inputs=(), outputs=('Out',))\n"
+        "def f():\n    pass\n")
+    assert lint_mod.lint([str(good)]) == 0
+    # the repo itself must be lint-clean
+    assert lint_mod.lint(lint_mod.DEFAULT_PATHS) == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: realistic programs verify clean at level=error
+# ---------------------------------------------------------------------------
+
+
+def test_trained_mlp_program_verifies_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.Adam(learning_rate=1e-3).minimize(loss)
+    for prog in (main, startup):
+        diags = prog.verify(level="error", feed_names=["x", "y"],
+                            fetch_names=[loss.name])
+        assert not [d for d in diags if d.severity == "error"]
+    # and it actually trains with the pre-flight armed at error level
+    set_flags({"verify": "error"})
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.random.rand(4, 8).astype(np.float32),
+                "y": np.random.randint(0, 4, (4, 1)).astype(np.int64)}
+        out, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out)).all()
+    finally:
+        set_flags({"verify": "off"})
+
+
+def test_rnn_sequence_program_verifies_clean():
+    # exercises the LoD ops that needed explicit infer_shape functions
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                              lod_level=1)
+        emb = fluid.layers.embedding(input=x, size=[50, 8])
+        fc = fluid.layers.fc(input=emb, size=12)
+        gru = fluid.layers.dynamic_gru(input=fc, size=4)
+        pool = fluid.layers.sequence_pool(input=gru, pool_type="max")
+        logits = fluid.layers.fc(input=pool, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits,
+                fluid.layers.data(name="lbl", shape=[1], dtype="int64")))
+        fluid.SGD(learning_rate=0.1).minimize(loss)
+    diags = main.verify(level="error", feed_names=["words", "lbl"],
+                        fetch_names=[loss.name])
+    # the gru/sequence_pool ops must NOT report inference failures now
+    assert not [d for d in diags
+                if d.pass_id == "shape-inference"
+                and "failed" in d.message]

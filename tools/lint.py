@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""AST-based repo lint: codebase invariants the analysis passes rely on.
+
+The static verifier (paddle_tpu/analysis) is only as good as the metadata
+it checks against, so this lint enforces at the SOURCE level:
+
+  1. every `register_op(...)` call declares its slots — both `inputs=`
+     and `outputs=` must be bound (an op with genuinely no inputs says
+     `inputs=()` explicitly).  The op-arity pass validates emitted op
+     descs against these declarations; an undeclared slot list silently
+     weakens it to "anything goes".
+  2. no bare `except Exception: pass` (or bare `except: pass`) inside
+     `paddle_tpu/core` — the silent-swallow pattern that hid per-op
+     shape-inference failures for months.  Handle the exception, narrow
+     it, or surface it (log/warn/report).
+
+Run: `python tools/lint.py [paths...]` (default: the paddle_tpu
+package).  Exits non-zero listing `file:line: message` per violation.
+Used by tools/ci_check.sh.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = [os.path.join(REPO_ROOT, "paddle_tpu")]
+
+# rule 2 scope: the core package only (ISSUE: silent failures in the
+# executor/inference layer are the ones that ate diagnostics)
+CORE_DIR = os.path.join(REPO_ROOT, "paddle_tpu", "core")
+
+
+def _is_register_op_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Name) and f.id == "register_op") or (
+        isinstance(f, ast.Attribute) and f.attr == "register_op")
+
+
+def check_register_op_slots(tree: ast.AST, path: str):
+    """Rule 1: register_op must bind `inputs` and `outputs`."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_register_op_call(node):
+            continue
+        bound = {kw.arg for kw in node.keywords if kw.arg}
+        # positional binding: register_op(type, inputs, outputs, ...)
+        if len(node.args) >= 2:
+            bound.add("inputs")
+        if len(node.args) >= 3:
+            bound.add("outputs")
+        missing = [s for s in ("inputs", "outputs") if s not in bound]
+        if missing:
+            yield (path, node.lineno,
+                   "register_op call does not declare "
+                   + " or ".join(repr(m) for m in missing)
+                   + " — declare every slot list explicitly (use "
+                   "inputs=() / outputs=() for none) so the analysis "
+                   "op-arity pass can validate op descs")
+
+
+def check_silent_excepts(tree: ast.AST, path: str):
+    """Rule 2 (core only): no `except [Exception]: pass`."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        body_is_pass = (len(node.body) == 1
+                        and isinstance(node.body[0], ast.Pass))
+        if broad and body_is_pass:
+            yield (path, node.lineno,
+                   "bare `except Exception: pass` swallows failures "
+                   "silently — narrow the exception type or surface it "
+                   "(warn/log/report)")
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint(paths) -> int:
+    violations = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            violations.append((path, e.lineno or 0,
+                               f"syntax error: {e.msg}"))
+            continue
+        violations.extend(check_register_op_slots(tree, path))
+        if os.path.abspath(path).startswith(CORE_DIR + os.sep):
+            violations.extend(check_silent_excepts(tree, path))
+    for path, line, msg in sorted(violations):
+        rel = os.path.relpath(path, REPO_ROOT)
+        print(f"{rel}:{line}: {msg}")
+    if violations:
+        print(f"lint: {len(violations)} violation(s)")
+        return 1
+    print("lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(lint(sys.argv[1:] or DEFAULT_PATHS))
