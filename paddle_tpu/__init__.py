@@ -12,6 +12,7 @@ Architecture (see /root/repo/SURVEY.md for the reference map):
 from . import (  # noqa: F401
     amp,
     analysis,
+    observability,
     profiler,
     clip,
     concurrency,

@@ -224,6 +224,87 @@ def job_merge(cfg, args):
 
 
 # ---------------------------------------------------------------------------
+# `metrics` / `trace` subcommands: observability surface (docs/
+# observability.md)
+# ---------------------------------------------------------------------------
+
+
+def cmd_metrics(argv):
+    """`python -m paddle_tpu.cli metrics DUMP.json` — render a JSON
+    metrics snapshot (observability.exporters.write_json, or the
+    --metrics_out of `cli trace`) as a table."""
+    import json
+
+    from paddle_tpu.observability.exporters import format_metrics_table
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli metrics",
+        description="render a metrics JSON snapshot as a table")
+    ap.add_argument("snapshot", help="JSON snapshot file written by "
+                    "observability.exporters.write_json")
+    args = ap.parse_args(argv)
+    with open(args.snapshot) as f:
+        snap = json.load(f)
+    n = len(snap.get("metrics", {}))
+    print(f"{args.snapshot}: {n} metric(s) from pid "
+          f"{snap.get('pid', '?')}")
+    print(format_metrics_table(snap))
+    return 0
+
+
+def cmd_trace(argv):
+    """`python -m paddle_tpu.cli trace CONFIG OUT.json [--steps N]` —
+    run a build() config file for a few steps with span recording on and
+    write the Chrome-trace JSON (open in chrome://tracing or
+    https://ui.perfetto.dev)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.observability import exporters, metrics, tracing
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli trace",
+        description="run a config under tracing; emit Chrome trace JSON")
+    ap.add_argument("config", help="python file defining build() "
+                    "(CLI config contract)")
+    ap.add_argument("out", help="Chrome-trace JSON output path")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--use_tpu", type=int, default=1)
+    ap.add_argument("--metrics_out", default="",
+                    help="also write a metrics JSON snapshot here "
+                    "(view with `cli metrics`)")
+    args = ap.parse_args(argv)
+
+    metrics.set_enabled(True)
+    tracing.set_enabled(True)
+    mod = _load_config(args.config)
+    cfg = _build(mod)
+    if "reader" not in cfg:
+        raise SystemExit("trace needs 'reader' from build()")
+    loss = cfg["loss"]
+    opt = cfg.get("optimizer") or fluid.SGD(learning_rate=0.01)
+    with fluid.program_guard(cfg["main"], cfg["startup"]):
+        opt.minimize(loss)
+    exe = fluid.Executor(_place(args.use_tpu))
+    exe.run(cfg["startup"])
+    it = iter(cfg["reader"]())
+    steps = 0
+    with tracing.span("cli.trace", config=args.config):
+        for i in range(args.steps):
+            feed = next(it, None)
+            if feed is None:
+                break
+            with tracing.span("trainer.step", batch_id=i):
+                exe.run(cfg["main"], feed=feed, fetch_list=[loss])
+            steps += 1
+    path = tracing.write_chrome_trace(args.out)
+    print(f"trace: {steps} step(s), {len(tracing.finished_spans())} "
+          f"span(s) -> {path}")
+    if args.metrics_out:
+        print(f"metrics snapshot -> "
+              f"{exporters.write_json(args.metrics_out)}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # `verify` subcommand: static analysis of saved / buildable programs
 # ---------------------------------------------------------------------------
 
@@ -335,12 +416,15 @@ def cmd_verify(argv):
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
-    if argv and argv[0] == "verify":
-        sys.exit(cmd_verify(argv[1:]))
+    subcommands = {"verify": cmd_verify, "metrics": cmd_metrics,
+                   "trace": cmd_trace}
+    if argv and argv[0] in subcommands:
+        sys.exit(subcommands[argv[0]](argv[1:]))
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli",
         description="legacy `paddle train` workflow over Program/Executor"
-        " (plus: `python -m paddle_tpu.cli verify --help`)")
+        " (plus subcommands: `python -m paddle_tpu.cli "
+        "verify|metrics|trace --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

@@ -14,6 +14,20 @@ from typing import List, Optional, Sequence
 
 from paddle_tpu import native
 from paddle_tpu.core.resilience import RetryPolicy, fault_injector
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import tracing as obs_tracing
+
+# task-dispatch telemetry (gated by PADDLE_TPU_METRICS).  The master
+# protocol itself is owned by the native server, so trace context is not
+# carried on this wire; instead each client roundtrip gets a span and
+# the whole chunk-processing window of a task records as `master.task` —
+# reader work done while a task is held nests under it.
+_M_REQUESTS = obs_metrics.counter(
+    "paddle_tpu_master_requests_total",
+    "master-client roundtrips, by verb", ("verb",))
+_M_TASKS = obs_metrics.counter(
+    "paddle_tpu_master_tasks_total",
+    "task lifecycle acks sent to the master", ("result",))
 
 
 def _declare(l):
@@ -173,6 +187,13 @@ class MasterClient:
                 pass
 
     def _roundtrip(self, req: str, read_payload=False):
+        verb = req.split(None, 1)[0] if req.strip() else "?"
+        _M_REQUESTS.labels(verb=verb).inc()
+        with obs_tracing.span("master.client." + verb.lower(),
+                              endpoint=f"{self.host}:{self.port}"):
+            return self._roundtrip_attempts(req, read_payload)
+
+    def _roundtrip_attempts(self, req: str, read_payload=False):
         state = self.policy.begin()
         while True:
             try:
@@ -222,10 +243,16 @@ class MasterClient:
         return int(tid), payload
 
     def task_finished(self, task_id: int) -> bool:
-        return self._roundtrip(f"FIN {task_id}\n")[0] == "OK"
+        ok = self._roundtrip(f"FIN {task_id}\n")[0] == "OK"
+        if ok:  # a rejected stale ack must not count as a completion
+            _M_TASKS.labels(result="finished").inc()
+        return ok
 
     def task_failed(self, task_id: int) -> bool:
-        return self._roundtrip(f"FAIL {task_id}\n")[0] == "OK"
+        ok = self._roundtrip(f"FAIL {task_id}\n")[0] == "OK"
+        if ok:
+            _M_TASKS.labels(result="failed").inc()
+        return ok
 
     def info(self) -> dict:
         line, _ = self._roundtrip("INFO\n")
@@ -280,10 +307,24 @@ def task_record_reader(client, chunk_reader, poll_interval: float = 0.05,
                 time.sleep(poll_interval)  # others hold pending tasks
                 continue
             tid, chunks = got
+            # the task's processing window spans many yields, so a
+            # context-managed span would stay pushed on the consumer's
+            # stack between resumes (and forever, if the reader is
+            # abandoned) — record it detached at the end instead
+            task_parent = obs_tracing.current_context()
+            t_wall, t0 = time.time(), time.perf_counter()
+
+            def _record_task(ok):
+                obs_tracing.record_span(
+                    "master.task", t_wall, time.perf_counter() - t0,
+                    parent=task_parent, task_id=tid,
+                    chunks=len(chunks), ok=ok)
+
             try:
                 for chunk in chunks:
                     yield from chunk_reader(chunk)
             except Exception:
+                _record_task(False)
                 client.task_failed(tid)
                 if on_chunk_error == "raise":
                     raise
@@ -296,6 +337,7 @@ def task_record_reader(client, chunk_reader, poll_interval: float = 0.05,
                     if info["todo"] == 0 and info["pending"] == 0:
                         return
                 continue
+            _record_task(True)
             client.task_finished(tid)
             if stop_after_pass:
                 info = client.info()

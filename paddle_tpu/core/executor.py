@@ -21,6 +21,7 @@ via Param/ParamOut aliasing in optimizer ops, e.g. sgd_op.cc).
 """
 from __future__ import annotations
 
+import itertools
 import os
 import time
 import warnings
@@ -33,6 +34,8 @@ import numpy as np
 
 from . import flags as flags_mod
 from . import registry
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
 from .execution import DictEnv, ExecContext, ScopeEnv, run_op
 from .flags import get_flag
 from .framework import Program, Variable, default_main_program
@@ -279,6 +282,32 @@ flags_mod.on_flag_change("compilation_cache_dir",
 # Executor
 # ---------------------------------------------------------------------------
 
+# cache/compile telemetry lives in the process metrics registry
+# (observability.metrics), one label per Executor instance —
+# cache_stats() below is a per-instance VIEW over these series.
+# always=True: this telemetry predates the PADDLE_TPU_METRICS switch
+# (cache_stats must count with metrics off), and lookups happen once
+# per run, not per op, so the cost is immaterial.
+_EXE_IDS = itertools.count()
+_M_LOOKUPS = obs_metrics.counter(
+    "paddle_tpu_executor_cache_lookups_total",
+    "executable-cache lookups by result (hit/miss)",
+    ("exe", "result"), always=True)
+_M_COMPILE_S = obs_metrics.counter(
+    "paddle_tpu_executor_compile_seconds_total",
+    "wall seconds of first invocations (trace + XLA compile + first "
+    "dispatch)", ("exe",), always=True)
+_M_RECOMPILES = obs_metrics.counter(
+    "paddle_tpu_executor_recompiles_after_warmup_total",
+    "cache misses for a program that already reached steady state",
+    ("exe",), always=True)
+_M_ENTRIES = obs_metrics.gauge(
+    "paddle_tpu_executor_cache_entries",
+    "live executables in the cache", ("exe",), always=True)
+_M_RUN_SECONDS = obs_metrics.histogram(
+    "paddle_tpu_executor_run_seconds",
+    "Executor.run wall latency by execution mode", ("exe", "mode"))
+
 
 class Executor:
     def __init__(self, place=None, seed: int = 0):
@@ -290,8 +319,13 @@ class Executor:
         # program GC, and a recycled id could serve the WRONG fingerprint
         self._fp_cache: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()  # program -> (version, fp)
-        self._stats = {"hits": 0, "misses": 0, "compile_s": 0.0,
-                       "recompiles_after_warmup": 0}
+        self._exe_id = str(next(_EXE_IDS))
+        self._m_hits = _M_LOOKUPS.labels(exe=self._exe_id, result="hit")
+        self._m_misses = _M_LOOKUPS.labels(exe=self._exe_id,
+                                           result="miss")
+        self._m_compile_s = _M_COMPILE_S.labels(exe=self._exe_id)
+        self._m_recompiles = _M_RECOMPILES.labels(exe=self._exe_id)
+        self._m_entries = _M_ENTRIES.labels(exe=self._exe_id)
         self._warm_fps: set = set()
         _maybe_enable_persistent_cache()
 
@@ -302,22 +336,30 @@ class Executor:
         compile + first dispatch), `entries` (live executables), and
         `recompiles_after_warmup` — misses for a program that already had
         a steady-state hit, the signature of a shape/flag leak re-tracing
-        the hot path (PADDLE_TPU_LOG_RECOMPILES=1 also warns per event)."""
-        return {**self._stats, "entries": len(self._cache)}
+        the hot path (PADDLE_TPU_LOG_RECOMPILES=1 also warns per event).
+
+        A view over this instance's series in the process metrics
+        registry (exported with everything else by
+        observability.exporters; see docs/observability.md)."""
+        return {"hits": int(self._m_hits.value),
+                "misses": int(self._m_misses.value),
+                "compile_s": self._m_compile_s.value,
+                "recompiles_after_warmup": int(self._m_recompiles.value),
+                "entries": len(self._cache)}
 
     def _note_lookup(self, hit: bool, fp, cache_key, once=None) -> None:
         """`once`: per-run set deduping the recompile counter/warning —
         a segmented run looks up one executable per device segment, but
         one odd-shaped batch is ONE hot-path re-trace, not k."""
         if hit:
-            self._stats["hits"] += 1
+            self._m_hits.inc()
             self._warm_fps.add(fp)
             return
-        self._stats["misses"] += 1
+        self._m_misses.inc()
         if fp in self._warm_fps and (once is None or fp not in once):
             if once is not None:
                 once.add(fp)
-            self._stats["recompiles_after_warmup"] += 1
+            self._m_recompiles.inc()
             if get_flag("log_recompiles"):
                 warnings.warn(
                     "Executor recompile after warmup: program fingerprint "
@@ -364,38 +406,56 @@ class Executor:
         )
         self._step += 1
 
-        if compiled and self._has_host_ops(block):
-            # host ops can't be jit-traced: "compiled" here means compile
-            # the maximal device segments between them
-            outs = self._run_segmented(
-                program, block, scope, feed, fetch_names, step_key
-            )
-        elif compiled:
-            try:
-                outs = self._run_compiled(
+        if compiled:
+            # host ops can't be jit-traced: "compiled" with host ops
+            # means compile the maximal device segments between them
+            mode = "segmented" if self._has_host_ops(block) else "compiled"
+        elif compiled is None:
+            # host ops present (else compiled was defaulted True above):
+            # compile maximal device segments, interpret host ops
+            # eagerly between them
+            mode = "segmented"
+        else:
+            mode = "interpreted"
+        t0 = time.perf_counter()
+        with obs_tracing.span("executor.run", mode=mode):
+            if mode == "segmented":
+                outs = self._run_segmented(
                     program, block, scope, feed, fetch_names, step_key
                 )
-            except _MissingState as e:
-                raise RuntimeError(
-                    f"persistable variable {e.args[0]!r} has no value in scope "
-                    "— run the startup program first"
-                ) from None
-        elif compiled is None:
-            # host ops present: compile maximal device segments, interpret
-            # host ops eagerly between them
-            outs = self._run_segmented(
-                program, block, scope, feed, fetch_names, step_key
-            )
-        else:
-            outs = self._run_interpreted(
-                program, block, scope, feed, fetch_names, step_key
-            )
+            elif mode == "compiled":
+                try:
+                    outs = self._run_compiled(
+                        program, block, scope, feed, fetch_names, step_key
+                    )
+                except _MissingState as e:
+                    raise RuntimeError(
+                        f"persistable variable {e.args[0]!r} has no value "
+                        "in scope — run the startup program first"
+                    ) from None
+            else:
+                outs = self._run_interpreted(
+                    program, block, scope, feed, fetch_names, step_key
+                )
+        if obs_metrics.enabled():
+            _M_RUN_SECONDS.labels(exe=self._exe_id, mode=mode).observe(
+                time.perf_counter() - t0)
         if return_numpy:
             outs = [_to_numpy(v) for v in outs]
         return outs
 
     def close(self):
         self._cache.clear()
+        self._m_entries.set(0)
+        # reclaim this instance's registry series (cache_stats() keeps
+        # reading the held child objects); processes that churn
+        # Executors must not grow every dump without bound
+        _M_LOOKUPS.remove(exe=self._exe_id, result="hit")
+        _M_LOOKUPS.remove(exe=self._exe_id, result="miss")
+        for fam in (_M_COMPILE_S, _M_RECOMPILES, _M_ENTRIES):
+            fam.remove(exe=self._exe_id)
+        for mode in ("interpreted", "segmented", "compiled"):
+            _M_RUN_SECONDS.remove(exe=self._exe_id, mode=mode)
 
     # -- interpreter ---------------------------------------------------------
     def _has_host_ops(self, block) -> bool:
@@ -556,7 +616,8 @@ class Executor:
         else:
             out = fn(in_vals, key)
         if miss:
-            self._stats["compile_s"] += time.perf_counter() - t0
+            self._m_compile_s.inc(time.perf_counter() - t0)
+            self._m_entries.set(len(self._cache))
         for n, v in out.items():
             env.set(n, v)
 
@@ -643,7 +704,8 @@ class Executor:
         else:
             fetches, state_out = fn(feed_vals, ro, rw, key)
         if miss:
-            self._stats["compile_s"] += time.perf_counter() - t0
+            self._m_compile_s.inc(time.perf_counter() - t0)
+            self._m_entries.set(len(self._cache))
         for n, v in state_out.items():
             scope.set_var(n, v)
         return [fetches[n] for n in fetch_names]

@@ -121,6 +121,23 @@ define_flag("sync_every_n", 1,
             "until read) and fences the dispatch queue every K steps; "
             "1 materializes every step (the serial loop).  Per-call "
             "override: Trainer.train(sync_every_n=K)")
+define_flag("metrics", False,
+            "arm the observability metrics instruments "
+            "(paddle_tpu.observability.metrics): counters/gauges/"
+            "histograms over the executor, trainer, reader pipeline, "
+            "serving, pserver transport and resilience hot paths.  Off "
+            "(default): every instrument is a boolean-test no-op; "
+            "telemetry-API metrics (Executor.cache_stats, "
+            "InferenceServer.stats) count regardless.  Export via "
+            "observability.exporters (Prometheus text / HTTP / JSON) "
+            "or PADDLE_TPU_METRICS_DUMP=<path> at exit")
+define_flag("trace_dir", "",
+            "directory for Chrome-trace JSON dumps "
+            "(paddle_tpu.observability.tracing): setting it enables "
+            "span recording (trace/span/parent ids, propagated over "
+            "the pserver wire protocol and to worker threads) and "
+            "auto-writes trace_<pid>.json at process exit — open in "
+            "chrome://tracing or Perfetto (docs/observability.md)")
 define_flag("flash_pack_heads", True,
             "fold head PAIRS into the 128-lane dim inside the flash "
             "kernel when head_dim == 64 (and the head count is even): "

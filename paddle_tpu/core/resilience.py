@@ -28,11 +28,34 @@ Injection sites currently wired (see docs/resilience.md):
 from __future__ import annotations
 
 import fnmatch
+import logging
 import os
 import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from ..observability import metrics as obs_metrics
+
+# retries and injected faults used to be invisible until a policy
+# exhausted — every retry, backoff sleep and fired fault now emits a
+# structured warning here (configure/silence via the standard logging
+# tree) and counts in the process metrics registry
+_LOG = logging.getLogger("paddle_tpu.resilience")
+
+_M_RETRY_ATTEMPTS = obs_metrics.counter(
+    "paddle_tpu_resilience_retry_attempts_total",
+    "failed attempts recorded by retry policies")
+_M_BACKOFF_SECONDS = obs_metrics.counter(
+    "paddle_tpu_resilience_backoff_seconds_total",
+    "seconds slept in retry backoff")
+_M_EXHAUSTED = obs_metrics.counter(
+    "paddle_tpu_resilience_retries_exhausted_total",
+    "RetryError raises (attempt/deadline budget exhausted)")
+_M_FAULTS = obs_metrics.counter(
+    "paddle_tpu_resilience_faults_fired_total",
+    "chaos faults fired, by injection site and kind",
+    ("site", "kind"))
 
 __all__ = [
     "RetryPolicy",
@@ -65,6 +88,8 @@ class RetryError(OSError):
         super().__init__(
             f"{what} (gave up after {attempts} attempt"
             f"{'s' if attempts != 1 else ''} over {elapsed:.2f}s{detail})")
+        _M_EXHAUSTED.inc()
+        _LOG.warning("retry exhausted: %s", self)
 
 
 class RetryPolicy:
@@ -180,6 +205,7 @@ class RetryState:
         """Count a failed attempt; raise RetryError when no budget is
         left for another one."""
         self.attempts += 1
+        _M_RETRY_ATTEMPTS.inc()
         p = self.policy
         delay = p.delay(self.attempts)
         exhausted = (p.max_attempts is not None
@@ -189,10 +215,16 @@ class RetryState:
         if exhausted:
             raise RetryError(what, self.attempts, self.elapsed,
                              last_error=err) from err
+        _LOG.warning(
+            "%s — attempt %d failed (%s: %s), retrying in %.2fs "
+            "(%.2fs elapsed)", what, self.attempts,
+            type(err).__name__ if err is not None else "error", err,
+            delay, self.elapsed)
         self._next_delay = delay
 
     def sleep(self):
         if self._next_delay > 0:
+            _M_BACKOFF_SECONDS.inc(self._next_delay)
             self.policy._sleep(self._next_delay)
         self._next_delay = 0.0
 
@@ -331,6 +363,12 @@ class FaultInjector:
                     return rule
         return None
 
+    @staticmethod
+    def _note_fired(site: str, rule: FaultRule):
+        _M_FAULTS.labels(site=site, kind=rule.kind).inc()
+        _LOG.warning("fault injected at %s: kind=%s (rule %r)",
+                     site, rule.kind, rule)
+
     def fire(self, site: str):
         """Give error/delay rules a shot at this call site."""
         if not self._rules:
@@ -338,6 +376,7 @@ class FaultInjector:
         rule = self._active_rule(site, ("error", "delay"))
         if rule is None:
             return
+        self._note_fired(site, rule)
         if rule.kind == "delay":
             time.sleep(rule.delay_s)
         else:
@@ -354,6 +393,7 @@ class FaultInjector:
         rule = self._active_rule(site, ("truncate", "corrupt"))
         if rule is None or not data:
             return data
+        self._note_fired(site, rule)
         if rule.kind == "truncate":
             cut = rule.arg if rule.arg is not None else max(len(data) // 2, 1)
             return data[:min(cut, len(data) - 1)]

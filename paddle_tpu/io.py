@@ -377,20 +377,30 @@ def save_checkpoint(executor, dirname, main_program=None, trainer_args=None,
     into `dirname/checkpoint_<uuid>/` with a {uuid, md5, timestamp,
     trainer_args} meta record, atomically publish it as latest, and GC old
     snapshots beyond `max_keep`.  Returns the checkpoint uuid."""
+    import time as time_mod
     import uuid as uuid_mod
 
     from .core.resilience import fault_injector
+    from .observability import metrics as obs_metrics
+    from .observability import tracing as obs_tracing
 
     if max_keep < 0:
         raise ValueError(f"max_keep must be >= 0, got {max_keep}")
     # chaos hook: a process dying mid-snapshot leaves a meta-less (or
     # md5-mismatched) dir that restore must skip and GC must reap
     fault_injector().fire("checkpoint.save")
-    cp_uuid = uuid_mod.uuid4().hex
-    cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
-    os.makedirs(cp_dir, exist_ok=True)
-    save_persistables(executor, cp_dir, main_program, scope=scope)
-    publish_checkpoint(dirname, cp_uuid, cp_dir, trainer_args, max_keep)
+    t0 = time_mod.perf_counter()
+    with obs_tracing.span("checkpoint.save", dirname=dirname):
+        cp_uuid = uuid_mod.uuid4().hex
+        cp_dir = os.path.join(dirname, f"{CHECKPOINT_PREFIX}_{cp_uuid}")
+        os.makedirs(cp_dir, exist_ok=True)
+        save_persistables(executor, cp_dir, main_program, scope=scope)
+        publish_checkpoint(dirname, cp_uuid, cp_dir, trainer_args,
+                           max_keep)
+    obs_metrics.histogram(
+        "paddle_tpu_checkpoint_save_seconds",
+        "save_checkpoint wall latency (persistables + md5 publish)"
+    ).observe(time_mod.perf_counter() - t0)
     return cp_uuid
 
 
@@ -521,8 +531,19 @@ def load_checkpoint(executor, dirname, main_program=None, scope=None):
     """Restore persistables from the latest valid snapshot under `dirname`
     (md5-verified; falls back to older snapshots if the newest is corrupt).
     Returns the snapshot's meta dict, or None if no usable snapshot."""
-    cp_dir, meta = latest_checkpoint(dirname)
-    if cp_dir is None:
-        return None
-    load_persistables(executor, cp_dir, main_program, scope=scope)
+    import time as time_mod
+
+    from .observability import metrics as obs_metrics
+    from .observability import tracing as obs_tracing
+
+    t0 = time_mod.perf_counter()
+    with obs_tracing.span("checkpoint.load", dirname=dirname):
+        cp_dir, meta = latest_checkpoint(dirname)
+        if cp_dir is None:
+            return None
+        load_persistables(executor, cp_dir, main_program, scope=scope)
+    obs_metrics.histogram(
+        "paddle_tpu_checkpoint_load_seconds",
+        "load_checkpoint wall latency (restore of the newest valid "
+        "snapshot)").observe(time_mod.perf_counter() - t0)
     return meta

@@ -1,0 +1,84 @@
+"""Unified observability layer: metrics registry, trace spans, exporters.
+
+    from paddle_tpu.observability import metrics, tracing, exporters
+
+    STEPS = metrics.counter("paddle_tpu_trainer_steps_total", "steps")
+    with tracing.span("trainer.step", batch_id=i):
+        ...
+        STEPS.inc()
+    exporters.write_prometheus("/tmp/metrics.prom")
+    tracing.write_chrome_trace("/tmp/trace.json")
+
+Switches (env at import, or flags/`set_flags` at runtime):
+  * ``PADDLE_TPU_METRICS=on`` — arm the gated instruments (metrics
+    created with ``always=True`` count regardless; everything else is a
+    boolean-test no-op when off).
+  * ``PADDLE_TPU_TRACE=on`` / ``PADDLE_TPU_TRACE_DIR=<dir>`` — record
+    spans; with a dir, auto-write ``trace_<pid>.json`` at exit.
+  * ``PADDLE_TPU_METRICS_DUMP=<path>`` — auto-write the Prometheus text
+    dump at exit.
+
+See docs/observability.md for the full tour.
+"""
+from __future__ import annotations
+
+from . import exporters, metrics, tracing  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    registry,
+)
+from .tracing import SpanContext, activate, current_context, span  # noqa: F401
+
+__all__ = [
+    "metrics",
+    "tracing",
+    "exporters",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "SpanContext",
+    "span",
+    "activate",
+    "current_context",
+]
+
+
+def _sync_from_flags():
+    """Keep the module switches in step with the flag registry so
+    `set_flags({"metrics": True})` / PADDLE_TPU_METRICS both work."""
+    from ..core.flags import get_flag
+
+    metrics.set_enabled(bool(get_flag("metrics")) or metrics.enabled())
+    d = get_flag("trace_dir")
+    if d and not tracing.trace_dir():
+        tracing.set_trace_dir(d)
+
+
+def _wire_flags():
+    from ..core import flags as flags_mod
+    from ..core.flags import get_flag
+
+    flags_mod.on_flag_change(
+        "metrics", lambda: metrics.set_enabled(get_flag("metrics")))
+
+    def _trace_dir_changed():
+        d = get_flag("trace_dir")
+        if d:
+            tracing.set_trace_dir(d)
+
+    flags_mod.on_flag_change("trace_dir", _trace_dir_changed)
+    _sync_from_flags()
+
+
+_wire_flags()

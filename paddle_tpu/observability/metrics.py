@@ -1,0 +1,404 @@
+"""Process-wide metrics registry: labeled Counter/Gauge/Histogram.
+
+Reference shape: the op-based runtimes this reproduction tracks all
+converged on the same substrate — a process-local registry of named,
+labeled series exported in Prometheus text format (TF's monitoring/
+CollectionRegistry, torch.monitor, the reference's stat sets in
+paddle/utils/Stat.h aggregated by ThreadLocalStat).  This module is that
+substrate for paddle_tpu: every subsystem (executor, trainer, reader
+pipeline, serving, pserver transport, resilience) registers its series
+here, and the exporters (observability/exporters.py) render one
+coherent dump instead of each subsystem keeping private dicts.
+
+Cost model: instruments are **gated** by a module-level switch
+(``PADDLE_TPU_METRICS`` env / the ``metrics`` flag) — when off, every
+``inc``/``set``/``observe`` is a single attribute read + boolean test,
+so hot paths can instrument unconditionally.  Metrics created with
+``always=True`` bypass the gate: they back pre-existing telemetry APIs
+(``Executor.cache_stats()``, ``InferenceServer.stats()``) whose
+contracts predate the switch and must keep counting regardless.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "enabled",
+    "set_enabled",
+    "DEFAULT_LATENCY_BUCKETS",
+]
+
+# fixed exponential latency buckets: 0.5 ms .. ~16 s doubling — wide
+# enough for sub-ms op dispatch and multi-second XLA compiles alike
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    0.0005 * 2 ** i for i in range(16))
+
+
+def _env_on(raw: Optional[str]) -> bool:
+    return (raw or "").strip().lower() in ("1", "on", "true", "yes")
+
+
+_ENABLED = _env_on(os.environ.get("PADDLE_TPU_METRICS"))
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+# ---------------------------------------------------------------------------
+# metric children (the objects hot paths actually hold)
+# ---------------------------------------------------------------------------
+
+
+class _CounterChild:
+    __slots__ = ("_metric", "_lock", "_value")
+
+    def __init__(self, metric: "Counter"):
+        self._metric = metric
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not (_ENABLED or self._metric.always):
+            return
+        if amount < 0:
+            raise ValueError(f"counter {self._metric.name} cannot "
+                             f"decrease (inc({amount}))")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _sample(self):
+        return self.value
+
+
+class _GaugeChild:
+    __slots__ = ("_metric", "_lock", "_value")
+
+    def __init__(self, metric: "Gauge"):
+        self._metric = metric
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        if not (_ENABLED or self._metric.always):
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not (_ENABLED or self._metric.always):
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _sample(self):
+        return self.value
+
+
+class _HistogramChild:
+    __slots__ = ("_metric", "_lock", "_counts", "_sum", "_count")
+
+    def __init__(self, metric: "Histogram"):
+        self._metric = metric
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(metric.buckets) + 1)  # +1: overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        if not (_ENABLED or self._metric.always):
+            return
+        buckets = self._metric.buckets
+        i = 0
+        for i, le in enumerate(buckets):  # noqa: B007 — tiny fixed list
+            if value <= le:
+                break
+        else:
+            i = len(buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """[(le, cumulative_count)...] ending with (inf, total) — the
+        Prometheus histogram exposition shape."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for le, c in zip(self._metric.buckets, counts):
+            acc += c
+            out.append((le, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+    def _sample(self):
+        return {"sum": self.sum, "count": self.count,
+                "buckets": [[le, n] for le, n in
+                            self.cumulative_buckets()]}
+
+
+# ---------------------------------------------------------------------------
+# metric families
+# ---------------------------------------------------------------------------
+
+
+class _Metric:
+    kind = "untyped"
+    _child_cls = None
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (), always: bool = False):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.always = always
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not self.labelnames:
+            self._default = self._make_child()
+        else:
+            self._default = None
+
+    def _make_child(self):
+        return self._child_cls(self)
+
+    def labels(self, **labelvalues):
+        """The child series for one label-value combination (created on
+        first use; subsequent calls return the same object, so hot paths
+        should hold the child)."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} has labels {self.labelnames}, "
+                f"got {sorted(labelvalues)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def remove(self, **labelvalues) -> None:
+        """Drop one label combination's series from the family (no-op if
+        absent) — instance-scoped series (per-Executor, per-server) call
+        this on close() so a process that churns instances does not grow
+        the registry and every dump without bound.  A child object the
+        instance still holds keeps counting; it is just no longer
+        exported."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} has labels {self.labelnames}, "
+                f"got {sorted(labelvalues)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def samples(self) -> List[Tuple[Dict[str, str], object]]:
+        """[(label dict, child)...] for every live series."""
+        if self._default is not None:
+            return [({}, self._default)]
+        with self._lock:
+            items = list(self._children.items())
+        return [(dict(zip(self.labelnames, key)), child)
+                for key, child in items]
+
+    def snapshot(self) -> dict:
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "labelnames": list(self.labelnames),
+            "samples": [{"labels": labels, "value": child._sample()}
+                        for labels, child in self.samples()],
+        }
+
+    # unlabeled convenience: metric itself acts as its single child
+    def _default_child(self):
+        if self._default is None:
+            raise ValueError(
+                f"metric {self.name} is labeled {self.labelnames}; "
+                "call .labels(...) first")
+        return self._default
+
+
+class Counter(_Metric):
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    # family-level fast gate before the child indirection: unlabeled
+    # hot-path instruments call these directly, and the disabled cost
+    # must stay at one method call + boolean test
+    def inc(self, amount: float = 1.0):
+        if not (_ENABLED or self.always):
+            return
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def set(self, value: float):
+        if not (_ENABLED or self.always):
+            return
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0):
+        if not (_ENABLED or self.always):
+            return
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    _child_cls = _HistogramChild
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (), always: bool = False,
+                 buckets: Optional[Sequence[float]] = None):
+        self.buckets = tuple(sorted(buckets if buckets is not None
+                                    else DEFAULT_LATENCY_BUCKETS))
+        if not self.buckets:
+            raise ValueError(f"histogram {name} needs >= 1 bucket")
+        super().__init__(name, help, labelnames, always)
+
+    def observe(self, value: float):
+        if not (_ENABLED or self.always):
+            return
+        self._default_child().observe(value)
+
+    @property
+    def sum(self) -> float:
+        return self._default_child().sum
+
+    @property
+    def count(self) -> int:
+        return self._default_child().count
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric map; get-or-create semantics so every
+    subsystem can declare its series at import/instance time without
+    coordinating creation order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def get_or_create(self, cls, name: str, help: str = "",
+                      labelnames: Sequence[str] = (),
+                      always: bool = False, **kwargs) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or \
+                        m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind} with labels {m.labelnames}; cannot "
+                        f"re-register as {cls.kind} with labels "
+                        f"{tuple(labelnames)}")
+                return m
+            m = cls(name, help, labelnames, always, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def snapshot(self) -> Dict[str, dict]:
+        return {m.name: m.snapshot() for m in self.metrics()}
+
+    def clear(self):
+        """Drop every registered metric (tests only — live subsystems
+        hold child references that become orphans)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def counter(name: str, help: str = "", labelnames: Sequence[str] = (),
+            always: bool = False,
+            registry: Optional[MetricsRegistry] = None) -> Counter:
+    return (registry or _REGISTRY).get_or_create(
+        Counter, name, help, labelnames, always)
+
+
+def gauge(name: str, help: str = "", labelnames: Sequence[str] = (),
+          always: bool = False,
+          registry: Optional[MetricsRegistry] = None) -> Gauge:
+    return (registry or _REGISTRY).get_or_create(
+        Gauge, name, help, labelnames, always)
+
+
+def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
+              always: bool = False,
+              buckets: Optional[Sequence[float]] = None,
+              registry: Optional[MetricsRegistry] = None) -> Histogram:
+    return (registry or _REGISTRY).get_or_create(
+        Histogram, name, help, labelnames, always, buckets=buckets)

@@ -1,0 +1,425 @@
+"""Cross-process trace spans: span() context managers + wire propagation.
+
+The reference attributes cost per op with `platform::profiler`
+RecordEvent ranges inside ONE process; a distributed step (trainer ->
+VariableClient -> VariableServer -> optimize block) needs ranges that
+compose ACROSS processes.  This module provides the minimal
+OpenTelemetry-shaped substrate for that:
+
+  * ``span(name, **attrs)`` — a context manager carrying a 128-bit
+    trace id, a 64-bit span id and its parent's span id.  Spans nest via
+    a thread-local context stack, so `with span("trainer.step"):` makes
+    every span opened inside it (same thread) a child.
+  * thread handoff — ``ctx = current_context()`` in the producer,
+    ``with activate(ctx):`` in the worker thread (used by the prefetch
+    pipeline and the serving worker), so background work records under
+    the step that scheduled it.
+  * wire propagation — ``inject()`` returns a small dict to ship in a
+    protocol header (the pserver frame protocol carries it in the JSON
+    head; frames without it keep working), ``extract(head)`` +
+    ``activate`` on the receiving side parents the server-side span
+    under the remote caller: one training step yields a single coherent
+    trace across trainer, pserver and master.
+
+Finished spans collect in a bounded in-process buffer and export as
+Chrome-trace JSON (``chrome://tracing`` / Perfetto; see
+observability/exporters.py).  Tracing is off (spans cost one boolean
+test) unless ``PADDLE_TPU_TRACE=on`` or ``PADDLE_TPU_TRACE_DIR`` is set
+— the latter also auto-writes ``trace_<pid>.json`` into the directory
+at process exit, so a multi-process run drops one merge-able trace file
+per process.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import random
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+__all__ = [
+    "SpanContext",
+    "span",
+    "activate",
+    "current_context",
+    "inject",
+    "extract",
+    "enabled",
+    "set_enabled",
+    "trace_dir",
+    "finished_spans",
+    "clear",
+    "chrome_trace_events",
+    "write_chrome_trace",
+]
+
+_TRACE_DIR = os.environ.get("PADDLE_TPU_TRACE_DIR", "")
+_ENABLED = bool(_TRACE_DIR) or (os.environ.get("PADDLE_TPU_TRACE", "")
+                                .strip().lower() in ("1", "on", "true",
+                                                     "yes"))
+
+# bounded buffer: a runaway loop under tracing must degrade (drop +
+# count) instead of eating the host's memory
+_MAX_SPANS = 100_000
+_spans: List[dict] = []
+_dropped = 0
+_lock = threading.Lock()
+_tls = threading.local()
+_rng = random.Random()
+
+
+def _after_fork_in_child():
+    """A forked worker must not share the parent's id stream (identical
+    trace/span ids across processes) nor its span buffer (the child
+    would re-dump the parent's spans under its own pid), and the buffer
+    lock may have been held by a parent thread at fork time."""
+    global _spans, _dropped, _lock
+    _rng.seed()  # fresh OS entropy
+    _lock = threading.Lock()
+    _spans = []
+    _dropped = 0
+
+
+if hasattr(os, "register_at_fork"):  # posix
+    os.register_at_fork(after_in_child=_after_fork_in_child)
+
+
+class SpanContext(NamedTuple):
+    trace_id: str  # 32 hex chars
+    span_id: str   # 16 hex chars
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def set_trace_dir(path: str) -> None:
+    """Point the exit-time auto-dump at `path` (also enables tracing)."""
+    global _TRACE_DIR
+    _TRACE_DIR = path
+    if path:
+        set_enabled(True)
+
+
+def trace_dir() -> str:
+    return _TRACE_DIR
+
+
+def _new_trace_id() -> str:
+    return f"{_rng.getrandbits(128):032x}"
+
+
+def _new_span_id() -> str:
+    return f"{_rng.getrandbits(64):016x}"
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_context() -> Optional[SpanContext]:
+    """The active span's context on THIS thread (or an activated remote
+    context), else None."""
+    s = _stack()
+    return s[-1] if s else None
+
+
+def inject() -> Optional[Dict[str, str]]:
+    """Wire header for the current context: ``{"tid": ..., "sid": ...}``
+    — small enough to ride in any JSON protocol head.  None when there
+    is no active span (callers must omit the field, keeping old peers'
+    parsers untouched)."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    return {"tid": ctx.trace_id, "sid": ctx.span_id}
+
+
+def extract(header) -> Optional[SpanContext]:
+    """SpanContext from a wire header produced by inject(); tolerant of
+    None / missing / malformed values (old peers)."""
+    if not isinstance(header, dict):
+        return None
+    tid, sid = header.get("tid"), header.get("sid")
+    if not (isinstance(tid, str) and isinstance(sid, str) and tid and sid):
+        return None
+    return SpanContext(tid, sid)
+
+
+class Span:
+    """Mutable handle yielded by span() — attrs set during the block are
+    recorded at exit."""
+
+    __slots__ = ("name", "context", "parent_id", "attrs",
+                 "_t0", "_wall")
+
+    def __init__(self, name: str, context: SpanContext,
+                 parent_id: Optional[str], attrs: dict):
+        self.name = name
+        self.context = context
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self._wall = time.time()
+        self._t0 = time.perf_counter()
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+
+def _record(s: Span, duration: float) -> None:
+    global _dropped
+    rec = {
+        "name": s.name,
+        "trace_id": s.context.trace_id,
+        "span_id": s.context.span_id,
+        "parent_id": s.parent_id,
+        "ts": s._wall,
+        "dur": duration,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "thread": threading.current_thread().name,
+        "attrs": dict(s.attrs),
+    }
+    with _lock:
+        if len(_spans) >= _MAX_SPANS:
+            _dropped += 1
+            return
+        _spans.append(rec)
+
+
+class _NoopCtx:
+    """Singleton returned on every disabled span()/activate(): hot paths
+    pay one boolean test + a pre-built `with` target, never a generator
+    frame (contextlib.contextmanager costs ~µs per entry — too much for
+    per-op/per-request sites when tracing is off)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopCtx()
+
+
+class _SpanCtx:
+    __slots__ = ("_name", "_attrs", "_span")
+
+    def __init__(self, name: str, attrs: dict):
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> Span:
+        stack = _stack()
+        parent = stack[-1] if stack else None
+        ctx = SpanContext(
+            parent.trace_id if parent is not None else _new_trace_id(),
+            _new_span_id())
+        s = Span(self._name, ctx,
+                 parent.span_id if parent is not None else None,
+                 self._attrs)
+        stack.append(ctx)
+        self._span = s
+        return s
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        _record(self._span, time.perf_counter() - self._span._t0)
+        return False
+
+
+def span(name: str, **attrs):
+    """Open a trace span around the block.  No-op (yields None) when
+    tracing is off; otherwise the `with` target is the Span (set_attr
+    for values known only mid-block)."""
+    if not _ENABLED:
+        return _NOOP
+    return _SpanCtx(name, attrs)
+
+
+class _ActivateCtx:
+    __slots__ = ("_ctx",)
+
+    def __init__(self, ctx: SpanContext):
+        self._ctx = ctx
+
+    def __enter__(self):
+        _stack().append(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def activate(ctx: Optional[SpanContext]):
+    """Install `ctx` as this thread's current context WITHOUT recording
+    a span — the receiving half of a thread handoff or wire extract.
+    `None` is a no-op so call sites need no conditional."""
+    if not _ENABLED or ctx is None:
+        return _NOOP
+    return _ActivateCtx(ctx)
+
+
+def record_span(name: str, ts: float, dur: float,
+                parent: Optional[SpanContext] = None,
+                **attrs) -> Optional[SpanContext]:
+    """Record an already-timed span WITHOUT touching the thread's
+    context stack — for ranges that outlive a `with` frame (e.g. a
+    generator-held work window, where an abandoned consumer would leave
+    a context-managed span permanently pushed).  `ts` is wall-clock
+    seconds (time.time()), `dur` seconds; `parent` parents it into an
+    existing trace, else it starts its own.  Returns the recorded
+    context (None when tracing is off)."""
+    global _dropped
+    if not _ENABLED:
+        return None
+    ctx = SpanContext(
+        parent.trace_id if parent is not None else _new_trace_id(),
+        _new_span_id())
+    rec = {
+        "name": name,
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+        "parent_id": parent.span_id if parent is not None else None,
+        "ts": ts,
+        "dur": dur,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "thread": threading.current_thread().name,
+        "attrs": dict(attrs),
+    }
+    with _lock:
+        if len(_spans) >= _MAX_SPANS:
+            _dropped += 1
+            return ctx
+        _spans.append(rec)
+    return ctx
+
+
+def finished_spans() -> List[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def dropped_spans() -> int:
+    with _lock:
+        return _dropped
+
+
+def clear() -> None:
+    global _dropped
+    with _lock:
+        _spans.clear()
+        _dropped = 0
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace ("catapult") export — open in chrome://tracing or Perfetto
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace_events(include_profiler: bool = True) -> List[dict]:
+    """Finished spans (and, optionally, the profiler's aggregated range
+    events) as Chrome-trace event dicts (`ph: "X"`, microsecond ts/dur,
+    trace/span ids in args)."""
+    events = []
+    for s in finished_spans():
+        events.append({
+            "ph": "X",
+            "cat": "span",
+            "name": s["name"],
+            "ts": s["ts"] * 1e6,
+            "dur": s["dur"] * 1e6,
+            "pid": s["pid"],
+            "tid": s["tid"],
+            "args": {
+                "trace_id": s["trace_id"],
+                "span_id": s["span_id"],
+                "parent_id": s["parent_id"],
+                **s["attrs"],
+            },
+        })
+    if include_profiler:
+        events.extend(_profiler_chrome_events())
+    return events
+
+
+def _profiler_chrome_events() -> List[dict]:
+    """The profiler's per-name duration lists as back-to-back events on
+    one synthetic track per name.  The profiler stores durations only
+    (no wall placement), so these tracks visualize per-event COST
+    distribution, not real concurrency — the span tracks carry the
+    wall-clock story."""
+    from paddle_tpu import profiler
+
+    events = []
+    pid = os.getpid()
+    with profiler._events_lock:
+        snapshot = {name: list(ts) for name, ts in
+                    profiler._events.items()}
+    for i, (name, durations) in enumerate(sorted(snapshot.items())):
+        tid = 1_000_000 + i
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": f"profiler:{name}"},
+        })
+        ts = 0.0
+        for dur in durations:
+            events.append({
+                "ph": "X", "cat": "profiler", "name": name,
+                "ts": ts, "dur": dur * 1e6, "pid": pid, "tid": tid,
+            })
+            ts += dur * 1e6
+    return events
+
+
+def write_chrome_trace(path: Optional[str] = None,
+                       include_profiler: bool = True) -> str:
+    """Write `{"traceEvents": [...]}` JSON; default path is
+    ``<trace_dir>/trace_<pid>.json``.  Returns the path written."""
+    import json
+
+    if path is None:
+        if not _TRACE_DIR:
+            raise ValueError(
+                "no path given and PADDLE_TPU_TRACE_DIR is not set")
+        os.makedirs(_TRACE_DIR, exist_ok=True)
+        path = os.path.join(_TRACE_DIR, f"trace_{os.getpid()}.json")
+    payload = {
+        "traceEvents": chrome_trace_events(include_profiler),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "paddle_tpu.observability",
+                      "dropped_spans": dropped_spans()},
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def _atexit_dump():
+    # only when the env asked for it AND something was recorded — an
+    # idle import must not litter the trace dir with empty files
+    if _TRACE_DIR and finished_spans():
+        try:
+            write_chrome_trace()
+        except OSError:
+            pass  # exit-time dump is best-effort (read-only FS, etc.)
+
+
+atexit.register(_atexit_dump)

@@ -22,6 +22,8 @@ propagation.
 """
 from __future__ import annotations
 
+import itertools
+import time
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -32,8 +34,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.executor import CPUPlace, Executor, program_to_fn
 from ..core.framework import Variable, default_startup_program
 from ..core.scope import Scope
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
 from .checkpoint import ShardedCheckpointMixin
 from .mesh import make_mesh
+
+# same series as core.executor's run histogram (get-or-create by name),
+# with a "pe<N>" instance label and mode="parallel"
+_PE_IDS = itertools.count()
+_M_RUN_SECONDS = obs_metrics.histogram(
+    "paddle_tpu_executor_run_seconds",
+    "Executor.run wall latency by execution mode", ("exe", "mode"))
 
 
 def _amp_enabled() -> bool:
@@ -166,23 +177,40 @@ class ParallelExecutor(ShardedCheckpointMixin):
 
     # -- execution -----------------------------------------------------------
     def run(self, feed: Dict, fetch_list=None, return_numpy=True):
+        t0 = time.perf_counter()
         self._refresh_trace_flags()
         fetch_names = ([v.name if isinstance(v, Variable) else str(v)
                         for v in fetch_list]
                        if fetch_list is not None else self.fetch_names)
         assert fetch_names == self.fetch_names, \
             "fetch_list must match construction-time fetch_list"
-        feeds = {
-            n: jax.device_put(np.asarray(v), self._data_sharding)
-            for n, v in feed.items()
-        }
-        key = jax.random.fold_in(jax.random.key(self._seed), self._step)
-        self._step += 1
-        fetches, self._states = self._jit_step(feeds, self._states, key)
-        out = [fetches[n] for n in fetch_names]
-        if return_numpy:
-            out = [np.asarray(v) for v in out]
+        with obs_tracing.span("executor.run", mode="parallel"):
+            feeds = {
+                n: jax.device_put(np.asarray(v), self._data_sharding)
+                for n, v in feed.items()
+            }
+            key = jax.random.fold_in(jax.random.key(self._seed),
+                                     self._step)
+            self._step += 1
+            fetches, self._states = self._jit_step(feeds, self._states,
+                                                   key)
+            out = [fetches[n] for n in fetch_names]
+            if return_numpy:
+                out = [np.asarray(v) for v in out]
+        if obs_metrics.enabled():
+            if not hasattr(self, "_m_run"):
+                self._m_run_id = f"pe{next(_PE_IDS)}"
+                self._m_run = _M_RUN_SECONDS.labels(
+                    exe=self._m_run_id, mode="parallel")
+            self._m_run.observe(time.perf_counter() - t0)
         return out
+
+    def close(self):
+        """Reclaim this instance's registry series (per-instance
+        telemetry contract: churned executors must not grow every
+        metrics dump without bound).  The executor stays usable."""
+        if hasattr(self, "_m_run"):
+            _M_RUN_SECONDS.remove(exe=self._m_run_id, mode="parallel")
 
     def compiled_collectives(self, feed: Dict) -> Dict[str, int]:
         """Counts of cross-device collective ops in the optimized HLO of

@@ -82,6 +82,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+
 from ..core.execution import DictEnv, ExecContext, run_op
 from ..core.framework import (GRAD_SUFFIX, Parameter, Variable,
                               default_startup_program, grad_var_name)
@@ -1015,33 +1018,53 @@ class PipelineExecutor(ShardedCheckpointMixin):
     # public API
     # ------------------------------------------------------------------
     def run(self, feed: Dict, fetch_list=None, return_numpy=True):
+        import time as _time
+
+        t0 = _time.perf_counter()
         self._refresh_trace_flags()
         fetch_names = ([v.name if isinstance(v, Variable) else str(v)
                         for v in fetch_list]
                        if fetch_list is not None else self.fetch_names)
         assert fetch_names == self.fetch_names, \
             "fetch_list must match construction-time fetch_list"
-        dp = self.mesh.shape[self.batch_axis]
-        feeds = {}
-        for n, v in feed.items():
-            v = np.asarray(v)
-            if v.shape[0] % self.n_micro:
-                raise ValueError(
-                    f"batch {v.shape[0]} not divisible by n_micro "
-                    f"{self.n_micro}")
-            if (v.shape[0] // self.n_micro) % dp:
-                raise ValueError(
-                    f"microbatch {v.shape[0] // self.n_micro} not "
-                    f"divisible by the '{self.batch_axis}' axis ({dp})")
-            feeds[n] = jax.device_put(v, self._data_sharding)
-        key = jax.random.fold_in(jax.random.key(self._seed), self._step)
-        self._step += 1
-        fetches, _loss, self._states = self._jit_step(
-            feeds, self._states, key)
-        out = [fetches[n] for n in fetch_names]
-        if return_numpy:
-            out = [np.asarray(v) for v in out]
+        with obs_tracing.span("executor.run", mode="pipeline"):
+            dp = self.mesh.shape[self.batch_axis]
+            feeds = {}
+            for n, v in feed.items():
+                v = np.asarray(v)
+                if v.shape[0] % self.n_micro:
+                    raise ValueError(
+                        f"batch {v.shape[0]} not divisible by n_micro "
+                        f"{self.n_micro}")
+                if (v.shape[0] // self.n_micro) % dp:
+                    raise ValueError(
+                        f"microbatch {v.shape[0] // self.n_micro} not "
+                        f"divisible by the '{self.batch_axis}' axis "
+                        f"({dp})")
+                feeds[n] = jax.device_put(v, self._data_sharding)
+            key = jax.random.fold_in(jax.random.key(self._seed),
+                                     self._step)
+            self._step += 1
+            fetches, _loss, self._states = self._jit_step(
+                feeds, self._states, key)
+            out = [fetches[n] for n in fetch_names]
+            if return_numpy:
+                out = [np.asarray(v) for v in out]
+        if obs_metrics.enabled():
+            if not hasattr(self, "_m_run"):
+                from .executor import _M_RUN_SECONDS, _PE_IDS
+                self._m_run_id = f"pipe{next(_PE_IDS)}"
+                self._m_run = _M_RUN_SECONDS.labels(
+                    exe=self._m_run_id, mode="pipeline")
+            self._m_run.observe(_time.perf_counter() - t0)
         return out
+
+    def close(self):
+        """Reclaim this instance's registry series (per-instance
+        telemetry contract, same as ParallelExecutor.close)."""
+        if hasattr(self, "_m_run"):
+            from .executor import _M_RUN_SECONDS
+            _M_RUN_SECONDS.remove(exe=self._m_run_id, mode="pipeline")
 
     def state(self, name, return_numpy=True):
         kind, store, idx = self._state_map[name]

@@ -29,12 +29,36 @@ import numpy as np
 
 from ..core.lod import LoDTensor, SelectedRows
 from ..core.resilience import RetryPolicy, fault_injector
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
 
 __all__ = ["VariableServer", "VariableClient", "BarrierTimeoutError",
            "serialize_var", "deserialize_var", "prebind_endpoint",
            "discard_prebound"]
 
 _HDR = struct.Struct("<I")
+
+# transport telemetry (gated by PADDLE_TPU_METRICS); trace context rides
+# the frame head's optional "trace" field, so a trainer-side span and
+# the pserver-side handling span share one trace id
+_M_BYTES_SENT = obs_metrics.counter(
+    "paddle_tpu_pserver_bytes_sent_total",
+    "frame bytes written to pserver connections (both roles)")
+_M_BYTES_RECV = obs_metrics.counter(
+    "paddle_tpu_pserver_bytes_recv_total",
+    "frame bytes read from pserver connections (both roles)")
+_M_REQUESTS = obs_metrics.counter(
+    "paddle_tpu_pserver_requests_total",
+    "server-side requests handled, by verb", ("verb",))
+_M_BARRIER_WAIT = obs_metrics.histogram(
+    "paddle_tpu_pserver_barrier_wait_seconds",
+    "client wall time blocked in send_batch_barrier (fan-in + optimize)")
+_M_OPTIMIZE_SECONDS = obs_metrics.histogram(
+    "paddle_tpu_pserver_optimize_seconds",
+    "server-side fan-in grad merge + optimize-program latency")
+
+_KNOWN_VERBS = frozenset(
+    {"HELLO", "SEND", "BARRIER", "GET", "STOP", "OK", "ERR", "VAR"})
 
 # frame-length sanity: a header larger than 1 MiB or a payload larger
 # than 2 GiB is protocol desync / corruption, not a real request —
@@ -147,18 +171,29 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _frame_bytes(verb: str, name: str = "", payload: bytes = b"") -> bytes:
-    head = json.dumps({"verb": verb, "name": name}).encode()
+def _frame_bytes(verb: str, name: str = "", payload: bytes = b"",
+                 trace=None) -> bytes:
+    """`trace` is an optional tracing.inject() dict; the field is simply
+    absent for untraced senders, so peers predating it (and frames it
+    never saw) parse unchanged — wire-compatible both directions."""
+    head_d = {"verb": verb, "name": name}
+    if trace is not None:
+        head_d["trace"] = trace
+    head = json.dumps(head_d).encode()
     return (_HDR.pack(len(head)) + _HDR.pack(len(payload)) + head +
             payload)
 
 
 def _send_frame(sock: socket.socket, verb: str, name: str = "",
-                payload: bytes = b""):
-    sock.sendall(_frame_bytes(verb, name, payload))
+                payload: bytes = b"", trace=None):
+    frame = _frame_bytes(verb, name, payload, trace)
+    _M_BYTES_SENT.inc(len(frame))
+    sock.sendall(frame)
 
 
 def _recv_frame(sock: socket.socket):
+    """-> (verb, name, payload, trace) — `trace` is the propagated trace
+    header dict, or None for frames that lack it (older peers)."""
     (hlen,) = _HDR.unpack(_read_exact(sock, 4))
     (plen,) = _HDR.unpack(_read_exact(sock, 4))
     if hlen > _MAX_HEAD or plen > _MAX_PAYLOAD:
@@ -168,7 +203,8 @@ def _recv_frame(sock: socket.socket):
             "corrupt frame")
     head = json.loads(_read_exact(sock, hlen))
     payload = _read_exact(sock, plen) if plen else b""
-    return head["verb"], head["name"], payload
+    _M_BYTES_RECV.inc(8 + hlen + plen)
+    return head["verb"], head["name"], payload, head.get("trace")
 
 
 # ---------------------------------------------------------------------------
@@ -295,7 +331,7 @@ class VariableServer:
         try:
             while True:
                 try:
-                    verb, name, payload = _recv_frame(conn)
+                    verb, name, payload, trace = _recv_frame(conn)
                 except (ValueError, KeyError, TypeError) as e:
                     # malformed frame (bad lengths / non-JSON head): the
                     # byte stream is desynced, so this CONNECTION is done,
@@ -308,35 +344,46 @@ class VariableServer:
                     except OSError:
                         pass
                     return
+                _M_REQUESTS.labels(
+                    verb=verb if verb in _KNOWN_VERBS else "other").inc()
                 try:
-                    if verb == "HELLO":
-                        peer = name
-                        _send_frame(conn, "OK")
-                    elif verb == "SEND":
-                        tid = self._trainer_id(peer or "anon")
-                        value = deserialize_var(payload)
-                        if self.sync:
-                            with self._lock:
-                                # per-trainer grad rename
-                                # (listen_and_serv :82)
-                                self.scope.set_var(f"{name}.trainer_{tid}",
-                                                   value)
+                    # the propagated trace context (when the frame has
+                    # one) parents this server-side span under the
+                    # remote caller's span: one trace id across the wire
+                    with obs_tracing.activate(obs_tracing.extract(trace)), \
+                            obs_tracing.span(
+                                "pserver." + str(verb).lower(),
+                                var=name):
+                        if verb == "HELLO":
+                            peer = name
+                            _send_frame(conn, "OK")
+                        elif verb == "SEND":
+                            tid = self._trainer_id(peer or "anon")
+                            value = deserialize_var(payload)
+                            if self.sync:
+                                with self._lock:
+                                    # per-trainer grad rename
+                                    # (listen_and_serv :82)
+                                    self.scope.set_var(
+                                        f"{name}.trainer_{tid}", value)
+                            else:
+                                self._apply_async(name, value)
+                            _send_frame(conn, "OK")
+                        elif verb == "BARRIER":
+                            if self.sync:
+                                self._barrier()
+                            _send_frame(conn, "OK")
+                        elif verb == "GET":
+                            val = self._blocking_get(name)
+                            _send_frame(conn, "VAR", name,
+                                        serialize_var(val))
+                        elif verb == "STOP":
+                            _send_frame(conn, "OK")
+                            self.stop()
+                            return
                         else:
-                            self._apply_async(name, value)
-                        _send_frame(conn, "OK")
-                    elif verb == "BARRIER":
-                        if self.sync:
-                            self._barrier()
-                        _send_frame(conn, "OK")
-                    elif verb == "GET":
-                        val = self._blocking_get(name)
-                        _send_frame(conn, "VAR", name, serialize_var(val))
-                    elif verb == "STOP":
-                        _send_frame(conn, "OK")
-                        self.stop()
-                        return
-                    else:
-                        _send_frame(conn, "ERR", f"unknown verb {verb}")
+                            _send_frame(conn, "ERR",
+                                        f"unknown verb {verb}")
                 except (ConnectionError, OSError):
                     raise
                 except Exception as e:
@@ -520,6 +567,14 @@ class VariableServer:
             self._write_snapshot(snap)
 
     def _run_optimize(self):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with obs_tracing.span("pserver.optimize", round=self._round):
+            self._run_optimize_inner()
+        _M_OPTIMIZE_SECONDS.observe(_time.perf_counter() - t0)
+
+    def _run_optimize_inner(self):
         # sum per-trainer grads into the canonical grad var, then run the
         # optimize program (the reference generates sum ops in the pserver
         # program; here the fan-in sum is part of the serving contract).
@@ -653,7 +708,7 @@ class VariableClient:
                 _time.sleep(0.2)
         self.sock.settimeout(None)
         _send_frame(self.sock, "HELLO", self._cid)
-        verb, name, _ = _recv_frame(self.sock)
+        verb, name, _, _ = _recv_frame(self.sock)
         if verb != "OK":
             raise RuntimeError(f"pserver error: {name or verb}")
 
@@ -681,16 +736,28 @@ class VariableClient:
         from "never arrived"."""
         timeout = self.request_timeout if timeout is None else timeout
         state = self._policy.begin()
+        # the client-side span covers the whole request (reconnects and
+        # resends included); its context rides the frame head, so the
+        # server-side handling span is its child in the same trace
+        with obs_tracing.span("pserver.client." + verb.lower(),
+                              endpoint=self.endpoint, var=name):
+            trace = obs_tracing.inject()
+            return self._request_attempts(state, verb, name, payload,
+                                          idempotent, timeout, trace)
+
+    def _request_attempts(self, state, verb, name, payload, idempotent,
+                          timeout, trace):
         while True:
             sent = False
             try:
                 if self.sock is None:
                     self._connect()
                 fault_injector().fire("pserver.request")
-                frame = _frame_bytes(verb, name, payload)
+                frame = _frame_bytes(verb, name, payload, trace)
                 data = fault_injector().mangle("pserver.send", frame)
                 self.sock.settimeout(timeout)
                 try:
+                    _M_BYTES_SENT.inc(len(data))
                     self.sock.sendall(data)
                     if data != frame:
                         # injected mid-write crash / wire corruption: the
@@ -699,7 +766,7 @@ class VariableClient:
                         raise ConnectionError(
                             "fault injection: mangled frame")
                     sent = True
-                    rverb, rname, rpayload = _recv_frame(self.sock)
+                    rverb, rname, rpayload, _ = _recv_frame(self.sock)
                 finally:
                     if self.sock is not None:
                         self.sock.settimeout(None)
@@ -739,10 +806,14 @@ class VariableClient:
         barrier_timeout) bounds the wait; expiry raises
         BarrierTimeoutError — the sync-SGD signature of a trainer that
         died before barriering this round."""
+        import time as _time
+
         timeout = self.barrier_timeout if timeout is None else timeout
+        t0 = _time.perf_counter()
         try:
             rverb, _, _ = self._request("BARRIER", idempotent=False,
                                         timeout=timeout)
+            _M_BARRIER_WAIT.observe(_time.perf_counter() - t0)
         except (socket.timeout, TimeoutError) as e:
             raise BarrierTimeoutError(
                 f"pserver {self.endpoint}: no barrier release within "

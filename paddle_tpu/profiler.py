@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .observability import tracing as _tracing
+
 __all__ = [
     "enable_profiler",
     "disable_profiler",
@@ -50,19 +52,35 @@ def is_enabled() -> bool:
 @contextlib.contextmanager
 def record_event(name: str, sync=None):
     """RAII range event (reference platform::RecordEvent).  `sync` is
-    called before reading the clock (device fence, e.g. block_until_ready)."""
-    if not _enabled:
+    called before reading the clock (device fence, e.g. block_until_ready).
+
+    When trace-span recording is on (observability.tracing), the range
+    also opens a span, so profiler events land in the Chrome trace with
+    real wall-clock placement alongside the subsystem spans."""
+    if not _enabled and not _tracing.enabled():
         yield
         return
     t0 = time.perf_counter()
+    span_cm = _tracing.span(name)
+    span_cm.__enter__()
     try:
         yield
     finally:
-        if sync is not None:
-            sync()
-        dt = time.perf_counter() - t0
-        with _events_lock:
-            _events.setdefault(name, []).append(dt)
+        try:
+            if sync is not None:
+                sync()
+        finally:
+            # close the span AFTER the fence so span and event time the
+            # same range, but ALWAYS close it (inner finally): a raising
+            # fence must not leave the context pushed on the thread's
+            # span stack, which would mis-parent every later span.  Exc
+            # info deliberately not forwarded: a raising op still
+            # records its range, same as the event list.
+            span_cm.__exit__(None, None, None)
+            if _enabled:
+                dt = time.perf_counter() - t0
+                with _events_lock:
+                    _events.setdefault(name, []).append(dt)
 
 
 def enable_profiler(state: str = "All"):
@@ -88,6 +106,10 @@ def disable_profiler(sorted_key: Optional[str] = None, print_table=True):
 
 
 def profiler_summary(sorted_key: Optional[str] = None):
+    """Aggregated rows; `sorted_key=None` defaults to "total" descending
+    (the reference PrintProfiler's default ordering — insertion order was
+    a bug: the table's point is ranking hotspots).  Pass "insertion" to
+    keep recording order."""
     rows = []
     with _events_lock:
         snapshot = {name: list(ts) for name, ts in _events.items()}
@@ -96,7 +118,7 @@ def profiler_summary(sorted_key: Optional[str] = None):
             "name": name, "calls": len(ts), "total": sum(ts),
             "min": min(ts), "max": max(ts), "ave": sum(ts) / len(ts),
         })
-    key = sorted_key or "default"
+    key = sorted_key if sorted_key is not None else "total"
     if key in ("calls", "total", "min", "max", "ave"):
         rows.sort(key=lambda r: -r[key])
     return rows

@@ -24,6 +24,7 @@ worker's batch assembly (serving.py), so both hot paths emit the same
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -32,6 +33,23 @@ __all__ = ["prefetch_feeder", "PrefetchIterator", "PrefetchReader",
            "stage_to_device"]
 
 from . import _Error
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+
+# pipeline telemetry (gated by PADDLE_TPU_METRICS): queue occupancy
+# answers "is the reader keeping up" (pinned near `depth` = yes, near 0
+# with high wait = the reader is the bottleneck; docs/performance.md).
+# The gauge is labeled per iterator — concurrent streams must not
+# clobber one series — and close() reclaims it, so a finished stream
+# does not export a stale depth forever.
+_PIPE_IDS = itertools.count()
+_M_QUEUE_DEPTH = obs_metrics.gauge(
+    "paddle_tpu_pipeline_queue_depth",
+    "prefetch queue occupancy (packed device-resident batches ready)",
+    ("pipe",))
+_M_WAIT_SECONDS = obs_metrics.histogram(
+    "paddle_tpu_pipeline_wait_seconds",
+    "consumer blocked on an empty prefetch queue per batch")
 
 
 class _End:
@@ -80,6 +98,12 @@ class PrefetchIterator:
         self.wait_s = 0.0
         self._feeder = feeder
         self._device_put = device_put
+        # thread handoff: batches prepared on the worker record under
+        # the span that constructed the iterator (e.g. trainer.step /
+        # the pass that opened the reader)
+        self._trace_ctx = obs_tracing.current_context()
+        self._pipe_id = str(next(_PIPE_IDS))
+        self._m_depth = _M_QUEUE_DEPTH.labels(pipe=self._pipe_id)
         place = place or getattr(feeder, "place", None)
         self._device = place.jax_device() if place is not None else None
         if device_put and self._device is None:
@@ -116,12 +140,17 @@ class PrefetchIterator:
 
     def _work(self, reader):
         try:
-            for batch in reader():
-                if self._stop.is_set():
-                    return
-                if not self._put(self._prepare(batch)):
-                    return
-            self._put(_End)
+            with obs_tracing.activate(self._trace_ctx):
+                for batch in reader():
+                    if self._stop.is_set():
+                        return
+                    with obs_tracing.span("pipeline.prepare"):
+                        item = self._prepare(batch)
+                    if not self._put(item):
+                        return
+                    if obs_metrics.enabled():
+                        self._m_depth.set(self._q.qsize())
+                self._put(_End)
         except BaseException as e:  # propagate, don't truncate the stream
             self._put(_Error(e))
 
@@ -137,7 +166,11 @@ class PrefetchIterator:
         with profiler.record_event("pipeline.wait"):
             t0 = time.perf_counter()
             item = self._q.get()
-            self.wait_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.wait_s += dt
+        if obs_metrics.enabled():
+            _M_WAIT_SECONDS.observe(dt)
+            self._m_depth.set(self._q.qsize())
         if item is _End:
             self._done = True
             self.thread.join(timeout=5)
@@ -152,6 +185,7 @@ class PrefetchIterator:
         """Stop the worker and join it (safe to call more than once)."""
         self._done = True
         self._stop.set()
+        _M_QUEUE_DEPTH.remove(pipe=self._pipe_id)
         while True:  # drain so a blocked put wakes immediately
             try:
                 self._q.get_nowait()

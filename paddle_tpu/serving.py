@@ -27,6 +27,7 @@ without changing any request's numerics.
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -36,9 +37,41 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from .core.resilience import fault_injector
+from .observability import metrics as obs_metrics
+from .observability import tracing as obs_tracing
 from .reader.pipeline import stage_to_device
 
 __all__ = ["InferenceServer", "ServerSaturated", "RequestDeadlineExceeded"]
+
+# serving telemetry, one label per server instance.  The counters that
+# back stats() are always=True (the stats() contract predates the
+# PADDLE_TPU_METRICS switch); the latency/batch/queue series are gated.
+_SERVER_IDS = itertools.count()
+_M_REQUESTS = obs_metrics.counter(
+    "paddle_tpu_serving_requests_total",
+    "requests dispatched to the device", ("server",), always=True)
+_M_DISPATCHES = obs_metrics.counter(
+    "paddle_tpu_serving_dispatches_total",
+    "coalesced device dispatches (dispatches << requests shows "
+    "aggregation)", ("server",), always=True)
+_M_SHED = obs_metrics.counter(
+    "paddle_tpu_serving_shed_total",
+    "submits rejected with ServerSaturated (queue full)",
+    ("server",), always=True)
+_M_DEADLINE = obs_metrics.counter(
+    "paddle_tpu_serving_deadline_expired_total",
+    "requests dropped because their deadline expired while queued",
+    ("server",), always=True)
+_M_LATENCY = obs_metrics.histogram(
+    "paddle_tpu_serving_request_seconds",
+    "submit -> result-delivered wall latency", ("server",))
+_M_BATCH = obs_metrics.histogram(
+    "paddle_tpu_serving_batch_size",
+    "requests coalesced per dispatch", ("server",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+_M_QDEPTH = obs_metrics.gauge(
+    "paddle_tpu_serving_queue_depth",
+    "requests waiting in the batching queue", ("server",))
 
 
 class ServerSaturated(RuntimeError):
@@ -116,8 +149,14 @@ class InferenceServer:
         # the stop check could enqueue AFTER close() drained the queue,
         # leaving its Future unresolved forever
         self._submit_lock = threading.Lock()
-        self._dispatches = 0
-        self._requests = 0
+        sid = self._sid = str(next(_SERVER_IDS))
+        self._m_requests = _M_REQUESTS.labels(server=sid)
+        self._m_dispatches = _M_DISPATCHES.labels(server=sid)
+        self._m_shed = _M_SHED.labels(server=sid)
+        self._m_deadline = _M_DEADLINE.labels(server=sid)
+        self._m_latency = _M_LATENCY.labels(server=sid)
+        self._m_batch = _M_BATCH.labels(server=sid)
+        self._m_qdepth = _M_QDEPTH.labels(server=sid)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -138,6 +177,8 @@ class InferenceServer:
         fut: Future = Future()
         expires = (time.monotonic() + deadline_ms / 1000.0
                    if deadline_ms is not None else None)
+        item = (x, fut, expires, time.perf_counter(),
+                obs_tracing.current_context())
         with self._submit_lock:
             if self._stop:
                 raise RuntimeError("InferenceServer is closed")
@@ -146,12 +187,15 @@ class InferenceServer:
                 # full queue (worker stalled) would wedge every submitter
                 # on the lock and deadlock close(), whose failure-drain
                 # path needs the same lock
-                self._q.put_nowait((x, fut, expires))
+                self._q.put_nowait(item)
             except queue.Full:
+                self._m_shed.inc()
                 raise ServerSaturated(
                     "InferenceServer queue full "
                     f"({self._q.maxsize} pending) — backpressure: retry "
                     "later or raise max_queue") from None
+        if obs_metrics.enabled():
+            self._m_qdepth.set(self._q.qsize())
         return fut
 
     def infer(self, x, timeout: Optional[float] = None):
@@ -160,19 +204,32 @@ class InferenceServer:
         return np.asarray(self.submit(x).result(timeout))
 
     def stats(self) -> Dict[str, int]:
-        """{'requests': N, 'dispatches': M} — M < N shows aggregation."""
-        return {"requests": self._requests,
-                "dispatches": self._dispatches}
+        """Serving telemetry (a view over this server's series in the
+        process metrics registry): `requests`/`dispatches` (dispatches
+        << requests shows aggregation), `shed` (ServerSaturated
+        rejections), `deadline_expired` (queued requests dropped at
+        their deadline) and the instantaneous `queue_depth`."""
+        return {"requests": int(self._m_requests.value),
+                "dispatches": int(self._m_dispatches.value),
+                "shed": int(self._m_shed.value),
+                "deadline_expired": int(self._m_deadline.value),
+                "queue_depth": self._q.qsize()}
 
     def close(self):
         with self._submit_lock:
             self._stop = True
         self._worker.join(timeout=5)
+        # reclaim this instance's registry series (stats() keeps working
+        # off the held child objects) — a process that churns servers
+        # must not grow every dump without bound
+        for fam in (_M_REQUESTS, _M_DISPATCHES, _M_SHED, _M_DEADLINE,
+                    _M_LATENCY, _M_BATCH, _M_QDEPTH):
+            fam.remove(server=self._sid)
         # fail any requests still queued — abandoning them would hang
         # callers blocked in fut.result() forever
         while True:
             try:
-                _, fut, _ = self._q.get_nowait()
+                _, fut, _, _, _ = self._q.get_nowait()
             except queue.Empty:
                 break
             fut.set_exception(RuntimeError("InferenceServer closed"))
@@ -182,9 +239,10 @@ class InferenceServer:
         """Shed a dead request at dequeue time: resolving its future with
         the deadline error costs nothing; batching it would spend a batch
         slot (and possibly a bigger bucket) on an answer nobody awaits."""
-        _, fut, expires = item
+        _, fut, expires, _, _ = item
         if expires is None or time.monotonic() < expires:
             return False
+        self._m_deadline.inc()
         _deliver(fut, exception=RequestDeadlineExceeded(
             "request deadline expired while queued"))
         return True
@@ -226,7 +284,7 @@ class InferenceServer:
             try:
                 fault_injector().fire("serving.dispatch")
             except Exception as e:
-                for _, fut, _ in batch:
+                for _, fut, _, _, _ in batch:
                     _deliver(fut, exception=e)
                 continue
             n = len(batch)
@@ -234,23 +292,37 @@ class InferenceServer:
             xs = [item[0] for item in batch]
             if bucket > n:  # pad with the last request, sliced away below
                 xs += [xs[-1]] * (bucket - n)
-            # batch assembly reuses the training pipeline's H2D staging
-            # stage (same `pipeline.h2d` profiler event): the transfer on
-            # this worker thread overlaps the PREVIOUS dispatch's device
-            # compute; the dispatch below is async
-            staged = stage_to_device(np.concatenate(xs, axis=0),
-                                     self._device)
-            try:
-                out = self._compiled[bucket](
-                    {self._feed_name: staged}, self._states)
-            except Exception as e:  # deliver, don't kill the loop
-                for _, fut, _ in batch:
-                    _deliver(fut, exception=e)
-                continue
-            self._dispatches += 1
-            self._requests += n
-            for i, (_, fut, _) in enumerate(batch):
+            # dispatch span parents under the FIRST request's submitter
+            # context (thread handoff over the queue) — one coalesced
+            # dispatch belongs to many requests; the first is the one
+            # whose latency it bounds
+            with obs_tracing.activate(batch[0][4]), \
+                    obs_tracing.span("serving.dispatch", batch=n,
+                                     bucket=bucket):
+                # batch assembly reuses the training pipeline's H2D
+                # staging stage (same `pipeline.h2d` profiler event):
+                # the transfer on this worker thread overlaps the
+                # PREVIOUS dispatch's device compute; the dispatch
+                # below is async
+                staged = stage_to_device(np.concatenate(xs, axis=0),
+                                         self._device)
+                try:
+                    out = self._compiled[bucket](
+                        {self._feed_name: staged}, self._states)
+                except Exception as e:  # deliver, don't kill the loop
+                    for _, fut, _, _, _ in batch:
+                        _deliver(fut, exception=e)
+                    continue
+            self._m_dispatches.inc()
+            self._m_requests.inc(n)
+            metrics_on = obs_metrics.enabled()
+            if metrics_on:
+                self._m_batch.observe(n)
+                self._m_qdepth.set(self._q.qsize())
+            for i, (_, fut, _, t0, _) in enumerate(batch):
                 _deliver(fut, result=out[i:i + 1])
+                if metrics_on:
+                    self._m_latency.observe(time.perf_counter() - t0)
 
 
 def _deliver(fut: Future, result=None, exception=None):

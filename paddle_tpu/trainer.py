@@ -8,6 +8,7 @@ Program + Executor.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -20,6 +21,36 @@ from .core.framework import (
     default_startup_program,
 )
 from .data_feeder import DataFeeder
+from .observability import metrics as obs_metrics
+from .observability import tracing as obs_tracing
+
+# train-loop telemetry (docs/observability.md): gated by
+# PADDLE_TPU_METRICS, so the serial loop's semantics and cost are
+# untouched when off
+_M_STEPS = obs_metrics.counter(
+    "paddle_tpu_trainer_steps_total", "training steps completed")
+_M_EXAMPLES = obs_metrics.counter(
+    "paddle_tpu_trainer_examples_total",
+    "examples consumed (leading dim of the first feed value)")
+_M_STEP_SECONDS = obs_metrics.histogram(
+    "paddle_tpu_trainer_step_seconds",
+    "train-loop iteration wall latency (feed ready -> dispatch done)")
+_M_COST = obs_metrics.gauge(
+    "paddle_tpu_trainer_last_cost", "most recently materialized cost")
+_M_FETCH_SYNC = obs_metrics.histogram(
+    "paddle_tpu_trainer_fetch_sync_seconds",
+    "blocking device->host fetch-sync stalls (LazyFetch reads)")
+
+
+def _feed_batch_size(feed) -> int:
+    """Leading dim of the first feed value (0 when indeterminable)."""
+    if isinstance(feed, dict) and feed:
+        v = next(iter(feed.values()))
+        v = getattr(v, "data", v)  # LoDTensor wrapper
+        shape = getattr(v, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
 
 __all__ = [
     "infer",
@@ -64,7 +95,9 @@ class LazyFetch:
             from . import profiler
 
             with profiler.record_event("pipeline.fetch_sync"):
+                t0 = time.perf_counter()
                 self._host_value = _to_numpy(self._device_value)
+                _M_FETCH_SYNC.observe(time.perf_counter() - t0)
             self._device_value = None
         return self._host_value
 
@@ -326,9 +359,13 @@ class Trainer:
                     # chaos hook: auto-resume tests kill the trainer here
                     fault_injector().fire("trainer.iteration")
                     event_handler(BeginIteration(pass_id, batch_id))
-                    outs = self.exe.run(self.main_program, feed=feed,
-                                        fetch_list=fetches,
-                                        return_numpy=not lazy)
+                    t_step = time.perf_counter()
+                    with obs_tracing.span("trainer.step",
+                                          pass_id=pass_id,
+                                          batch_id=batch_id):
+                        outs = self.exe.run(self.main_program, feed=feed,
+                                            fetch_list=fetches,
+                                            return_numpy=not lazy)
                     if lazy:
                         cost = LazyFetch(outs[0])
                         # metrics stay RAW device arrays: jax arrays are
@@ -342,6 +379,15 @@ class Trainer:
                         metrics = outs[1:]
                     pass_costs.append(cost)
                     self.step += 1
+                    if obs_metrics.enabled():
+                        _M_STEPS.inc()
+                        _M_STEP_SECONDS.observe(
+                            time.perf_counter() - t_step)
+                        bs = _feed_batch_size(feed)
+                        if bs:
+                            _M_EXAMPLES.inc(bs)
+                        if not lazy:
+                            _M_COST.set(cost)
                     if lazy and self.step % sync_every_n == 0:
                         # periodic fence: bounds the in-flight dispatch
                         # queue, surfaces device errors at a bounded
@@ -352,6 +398,8 @@ class Trainer:
                         for c in pass_costs[-sync_every_n:]:
                             if isinstance(c, LazyFetch):
                                 c.numpy()
+                        if obs_metrics.enabled() and pass_costs:
+                            _M_COST.set(float(pass_costs[-1]))
                     event_handler(EndIteration(pass_id, batch_id, cost,
                                                metrics=metrics))
                     if checkpoint_dir is not None \

@@ -12,17 +12,17 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 export PADDLE_TPU_DATASET="${PADDLE_TPU_DATASET:-synthetic}"
 
-echo "== [1/3] repo lint (tools/lint.py) =="
+echo "== [1/4] repo lint (tools/lint.py) =="
 python tools/lint.py
 
-echo "== [2/3] static verification of example programs =="
+echo "== [2/4] static verification of example programs =="
 python -m paddle_tpu.cli verify \
     examples/transformer_lm.py \
     examples/pipeline_transformer_lm.py \
     examples/serve_image_classifier.py \
     examples/dist_ckpt_worker.py
 
-echo "== [3/3] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
+echo "== [3/4] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
 PADDLE_TPU_VERIFY=error python -m pytest \
     tests/test_analysis.py \
     tests/test_registry.py \
@@ -36,5 +36,15 @@ PADDLE_TPU_VERIFY=error python -m pytest \
 # (TestSoftmax::test_grad is a pre-existing finite-difference tolerance
 # flake — it fails identically on the pre-PR tree, unrelated to
 # verification)
+
+echo "== [4/4] observability subset with PADDLE_TPU_METRICS=on =="
+# the instrumented hot paths must behave identically with the metric
+# instruments armed (docs/observability.md)
+PADDLE_TPU_METRICS=on python -m pytest \
+    tests/test_observability.py \
+    tests/test_executor_cache.py \
+    tests/test_serving.py \
+    tests/test_pserver.py \
+    -q -m 'not slow' -p no:cacheprovider
 
 echo "ci_check: all green"

@@ -13,6 +13,11 @@ it checks against, so this lint enforces at the SOURCE level:
      `paddle_tpu/core` — the silent-swallow pattern that hid per-op
      shape-inference failures for months.  Handle the exception, narrow
      it, or surface it (log/warn/report).
+  3. no bare `print(` inside `paddle_tpu/core` or `paddle_tpu/parallel`
+     — runtime-layer diagnostics go through `logging` or the
+     observability registry/exporters (docs/observability.md) so
+     production processes (pservers, serving workers) stay scrape-able
+     instead of spraying stdout.
 
 Run: `python tools/lint.py [paths...]` (default: the paddle_tpu
 package).  Exits non-zero listing `file:line: message` per violation.
@@ -30,6 +35,11 @@ DEFAULT_PATHS = [os.path.join(REPO_ROOT, "paddle_tpu")]
 # rule 2 scope: the core package only (ISSUE: silent failures in the
 # executor/inference layer are the ones that ate diagnostics)
 CORE_DIR = os.path.join(REPO_ROOT, "paddle_tpu", "core")
+
+# rule 3 scope: runtime layers that run inside long-lived server
+# processes (core + the pserver/parallel machinery)
+NO_PRINT_DIRS = (CORE_DIR, os.path.join(REPO_ROOT, "paddle_tpu",
+                                        "parallel"))
 
 
 def _is_register_op_call(node: ast.Call) -> bool:
@@ -76,6 +86,19 @@ def check_silent_excepts(tree: ast.AST, path: str):
                    "(warn/log/report)")
 
 
+def check_no_prints(tree: ast.AST, path: str):
+    """Rule 3 (core + parallel): no `print(...)` calls."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            yield (path, node.lineno,
+                   "bare print() in a runtime layer — use logging or "
+                   "the observability registry/exporters "
+                   "(docs/observability.md) so server processes stay "
+                   "scrape-able")
+
+
 def iter_py_files(paths):
     for p in paths:
         if os.path.isfile(p):
@@ -99,8 +122,11 @@ def lint(paths) -> int:
                                f"syntax error: {e.msg}"))
             continue
         violations.extend(check_register_op_slots(tree, path))
-        if os.path.abspath(path).startswith(CORE_DIR + os.sep):
+        abspath = os.path.abspath(path)
+        if abspath.startswith(CORE_DIR + os.sep):
             violations.extend(check_silent_excepts(tree, path))
+        if any(abspath.startswith(d + os.sep) for d in NO_PRINT_DIRS):
+            violations.extend(check_no_prints(tree, path))
     for path, line, msg in sorted(violations):
         rel = os.path.relpath(path, REPO_ROOT)
         print(f"{rel}:{line}: {msg}")
