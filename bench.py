@@ -25,7 +25,9 @@ BENCH_AMP=0 (pure-bf16 mode, reported as the secondary number in
 benchmark/README.md), BENCH_CONVERGENCE=0, BENCH_PREFETCH=N (input
 pipeline microbench: serial vs prefetch-depth-N + lazy-fetch steps/s
 with the host-blocked fraction of each loop; BENCH_PREFETCH_ITERS
-steps).
+steps), BENCH_COMM=1 (pserver comm microbench: per-var serial wire
+path vs bucketed+concurrent CommPool over 2 in-process pservers x 64
+small grads, with a byte-identical final-params check).
 """
 import json
 import os
@@ -264,6 +266,124 @@ def run_prefetch_bench(depth, steps=None):
                              / serial["steps_per_sec"], 3)}
 
 
+def run_comm_bench(n_grads=64, dim=16, rounds=4, pservers=2, trials=3):
+    """Pserver comm microbench (BENCH_COMM=1): one trainer, `pservers`
+    in-process VariableServers, `n_grads` small grads per sync round.
+    Baseline = the pre-bucketing wire path (one SEND frame per var,
+    endpoints visited serially, per-var GETs); fused = parallel/comm's
+    CommPool (arrival-order SEND_BATCH buckets, concurrent endpoints,
+    one batched GET per endpoint).  Walls are best-of-`trials` over the
+    post-warmup rounds — round 0 absorbs the optimize-program compile on
+    both sides — and the dict also reports whether both paths left the
+    pservers with byte-identical parameters (they must)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import comm
+    from paddle_tpu.parallel.pserver import VariableClient, VariableServer
+
+    names = [f"bw{i}" for i in range(n_grads)]
+    owner = {n: i % pservers for i, n in enumerate(names)}
+    rng = np.random.RandomState(7)
+    grads = [{n: rng.rand(dim).astype(np.float32) for n in names}
+             for _ in range(rounds + 1)]  # +1: untimed warmup round
+
+    def build_servers():
+        servers = []
+        for s in range(pservers):
+            scope = fluid.Scope()
+            prog = fluid.Program()
+            with fluid.program_guard(prog, fluid.Program()):
+                blk = prog.global_block()
+                blk.create_var(name="lr", shape=[1], dtype="float32",
+                               persistable=True)
+                for n in names:
+                    if owner[n] != s:
+                        continue
+                    blk.create_var(name=n, shape=[dim], dtype="float32",
+                                   persistable=True)
+                    blk.create_var(name=n + "@GRAD", shape=[dim],
+                                   dtype="float32", persistable=True)
+                    blk.append_op("sgd",
+                                  {"Param": [n], "Grad": [n + "@GRAD"],
+                                   "LearningRate": ["lr"]},
+                                  {"ParamOut": [n]}, {})
+            scope.set_var("lr", np.asarray([0.1], np.float32))
+            for n in names:
+                if owner[n] == s:
+                    scope.set_var(n, np.ones(dim, np.float32))
+            srv = VariableServer(prog, scope,
+                                 fluid.Executor(fluid.CPUPlace()),
+                                 fan_in=1)
+            srv.serve(0)
+            servers.append(srv)
+        return servers, [f"127.0.0.1:{s.port}" for s in servers]
+
+    def run_serial(eps):
+        clients = {ep: VariableClient(ep, client_id="bench-serial")
+                   for ep in eps}
+
+        def one_round(r):
+            for n in names:
+                clients[eps[owner[n]]].send_var(n + "@GRAD", grads[r][n])
+            for ep in eps:
+                clients[ep].send_batch_barrier()
+            for n in names:
+                clients[eps[owner[n]]].get_var(n)
+
+        one_round(0)  # warmup: optimize-program compile on the servers
+        t0 = time.perf_counter()
+        for r in range(1, rounds + 1):
+            one_round(r)
+        wall = time.perf_counter() - t0
+        params = {n: np.asarray(clients[eps[owner[n]]].get_var(n))
+                  for n in names}
+        for c in clients.values():
+            c.close()
+        return wall, params
+
+    def run_fused(eps):
+        pool = comm.CommPool()
+
+        def one_round(r):
+            pool.send_round(
+                [(eps[owner[n]], n + "@GRAD", grads[r][n])
+                 for n in names],
+                [(eps[owner[n]], n) for n in names])
+
+        one_round(0)
+        t0 = time.perf_counter()
+        for r in range(1, rounds + 1):
+            one_round(r)
+        wall = time.perf_counter() - t0
+        vals = pool.send_round([], [(eps[owner[n]], n) for n in names])
+        params = {n: np.asarray(v) for n, v in zip(names, vals)}
+        pool.close()
+        return wall, params
+
+    best = {"serial": float("inf"), "fused": float("inf")}
+    params_serial = params_fused = None
+    for _ in range(trials):
+        for mode, runner in (("serial", run_serial), ("fused", run_fused)):
+            servers, eps = build_servers()
+            try:
+                wall, params = runner(eps)
+            finally:
+                for s in servers:
+                    s.stop()
+            best[mode] = min(best[mode], wall)
+            if mode == "serial":
+                params_serial = params
+            else:
+                params_fused = params
+    identical = all(params_serial[n].tobytes() == params_fused[n].tobytes()
+                    for n in names)
+    return {"n_grads": n_grads, "dim": dim, "rounds": rounds,
+            "pservers": pservers,
+            "serial_seconds": round(best["serial"], 4),
+            "fused_seconds": round(best["fused"], 4),
+            "speedup": round(best["serial"] / best["fused"], 3),
+            "params_identical": identical}
+
+
 def main():
     import paddle_tpu as fluid
     from harness import gated_time_program
@@ -304,6 +424,9 @@ def main():
     prefetch_depth = int(os.environ.get("BENCH_PREFETCH", "0"))
     if prefetch_depth > 0:
         out["prefetch_pipeline"] = run_prefetch_bench(prefetch_depth)
+    if os.environ.get("BENCH_COMM", "0").lower() in ("1", "true", "yes",
+                                                     "on"):
+        out["comm"] = run_comm_bench()
     if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
             "0", "false", "no", "off"):
         conv = run_convergence()

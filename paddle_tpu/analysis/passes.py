@@ -545,12 +545,15 @@ def check_distributed(ctx):
     listen_eps: Set[str] = set()
     send_eps: Set[str] = set()
     num_places_seen: Dict[int, int] = {}  # num_places -> first op idx
+    bucketed_sends: List[int] = []    # op idx: per-var epmap present
+    unbucketed_sends: List[int] = []  # op idx: endpoints only
 
     for block, idx, op in ctx.iter_ops():
         attrs = _effective_attrs(ctx, op)
         if op.type == "send":
             endpoints = list(attrs.get("endpoints") or ())
             epmap = list(attrs.get("epmap") or ())
+            out_epmap = list(attrs.get("out_epmap") or ())
             if not endpoints and not epmap:
                 yield ctx.diag(
                     "error",
@@ -559,6 +562,7 @@ def check_distributed(ctx):
                     block, idx, op,
                 )
                 continue
+            (bucketed_sends if epmap else unbucketed_sends).append(idx)
             n_in = len(op.input("X"))
             if epmap and len(epmap) != n_in:
                 yield ctx.diag(
@@ -568,14 +572,24 @@ def check_distributed(ctx):
                     "1:1",
                     block, idx, op,
                 )
-            for ep in endpoints + epmap:
+            n_out = len(op.output("Out"))
+            if out_epmap and len(out_epmap) != n_out:
+                yield ctx.diag(
+                    "error",
+                    f"send op out_epmap has {len(out_epmap)} endpoints "
+                    f"for {n_out} output vars — per-var mapping must "
+                    "match 1:1",
+                    block, idx, op,
+                )
+            for ep in endpoints + epmap + out_epmap:
                 d = _check_endpoint(ctx, block, idx, op, "endpoints", ep)
                 if d is not None:
                     yield d
                 else:
                     send_eps.add(ep)
-            if endpoints and epmap:
-                stray = sorted(set(epmap) - set(endpoints))
+            if endpoints and (epmap or out_epmap):
+                stray = sorted((set(epmap) | set(out_epmap)) -
+                               set(endpoints))
                 if stray:
                     yield ctx.diag(
                         "warning",
@@ -618,6 +632,18 @@ def check_distributed(ctx):
             if np_:
                 num_places_seen.setdefault(np_, idx)
 
+    if bucketed_sends and unbucketed_sends:
+        yield ctx.diag(
+            "warning",
+            "program mixes bucketed send ops (per-var epmap, ops "
+            f"{bucketed_sends}) with unbucketed ones (endpoints only, "
+            f"ops {unbucketed_sends}) — rounds behind the unbucketed "
+            "ops cannot fuse transfers or overlap endpoints",
+            ctx.program.blocks[0],
+            hint="give every send op a per-var epmap (+ out_epmap for "
+                 "the pulls) — the transpiler emits one fused send per "
+                 "program in exactly that shape",
+        )
     if len(num_places_seen) > 1:
         yield ctx.diag(
             "warning",

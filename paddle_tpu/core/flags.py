@@ -138,6 +138,16 @@ define_flag("trace_dir", "",
             "the pserver wire protocol and to worker threads) and "
             "auto-writes trace_<pid>.json at process exit — open in "
             "chrome://tracing or Perfetto (docs/observability.md)")
+define_flag("comm_bucket_bytes", 4 << 20,
+            "size cap (bytes) for fused pserver transfers: send ops "
+            "pack grads into arrival-order buckets (DDP-style) and "
+            "ship each bucket as ONE SEND_BATCH frame "
+            "(parallel/comm.py + parallel/pserver.py).  0 disables "
+            "fusion — every var goes in its own legacy SEND frame "
+            "(the pre-bucketing wire path; also the automatic "
+            "fallback against a server that predates the batch "
+            "verbs).  An oversized var still ships, alone in its "
+            "bucket")
 define_flag("flash_pack_heads", True,
             "fold head PAIRS into the 128-lane dim inside the flash "
             "kernel when head_dim == 64 (and the head count is even): "

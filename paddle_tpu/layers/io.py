@@ -73,16 +73,31 @@ class ListenAndServ:
              "sync_mode": self.sync_mode})
 
 
-def Send(endpoint, send_vars, get_vars):
+def Send(endpoint, send_vars, get_vars, epmap=None, out_epmap=None):
     """Push `send_vars`, barrier, pull `get_vars` (reference layers Send /
-    send_op.cc:44)."""
+    send_op.cc:44).  `endpoint` may be one 'host:port' or a list; with
+    several, `epmap`/`out_epmap` route each var to its pserver.  An
+    omitted `out_epmap` follows `epmap` when the arities line up (each
+    param pulled from the server its grad went to — the transpiler
+    pairing), else everything defaults to the first endpoint.  The
+    runtime fuses each endpoint's vars into bucketed frames and serves
+    endpoints concurrently (parallel/comm.py)."""
+    eps = [endpoint] if isinstance(endpoint, str) else list(endpoint)
+    epmap = list(epmap) if epmap else [eps[0]] * len(send_vars)
+    if out_epmap:
+        out_epmap = list(out_epmap)
+    elif len(epmap) == len(get_vars):
+        out_epmap = list(epmap)
+    else:
+        out_epmap = [eps[0]] * len(get_vars)
     helper_block = default_main_program().current_block
     helper_block.append_op(
         "send",
         {"X": [v.name for v in send_vars]},
         {"Out": [v.name for v in get_vars]},
-        {"endpoints": [endpoint],
-         "epmap": [endpoint] * len(send_vars)})
+        {"endpoints": eps,
+         "epmap": epmap,
+         "out_epmap": out_epmap})
     return get_vars
 
 

@@ -12,44 +12,38 @@ from __future__ import annotations
 from ..core.execution import data_of, many, one
 from ..core.registry import register_op
 
-_CLIENTS = {}
-
-
-def _client(endpoint: str):
-    from ..parallel.pserver import VariableClient
-
-    c = _CLIENTS.get(endpoint)
-    if c is None:
-        c = VariableClient(endpoint)
-        _CLIENTS[endpoint] = c
-    return c
-
-
 def reset_clients():
-    for c in _CLIENTS.values():
-        c.close()
-    _CLIENTS.clear()
+    from ..parallel.comm import reset_comm_pool
+
+    reset_comm_pool()
 
 
 @register_op("send", inputs=("X",), outputs=("Out",),
-             attrs={"endpoints": [], "epmap": []},
+             attrs={"endpoints": [], "epmap": [], "out_epmap": [],
+                    "bucket_bytes": -1},
              dup_inputs=("X",), dup_outputs=("Out",),
              not_differentiable=True, host=True)
 def send(ctx, ins, attrs):
     """Push grads to their endpoints, barrier, pull updated params
     (send_op.cc:44-94: AsyncSendVariable / SendBatchBarrier /
-    AsyncGetVariable)."""
+    AsyncGetVariable).  Grads are packed into arrival-order buckets
+    (SEND_BATCH frames, cap = `bucket_bytes` attr or the
+    comm_bucket_bytes flag) and each endpoint's send→barrier→pull
+    chain runs on its own pooled connection, so pservers are served
+    concurrently instead of one serial round per endpoint."""
+    from ..parallel.comm import comm_pool
+
     xs = many(ins, "X")
     in_names = ctx.op.input("X")
     out_names = ctx.op.output("Out")
     epmap = attrs["epmap"] or [attrs["endpoints"][0]] * len(in_names)
-    for name, val, ep in zip(in_names, xs, epmap):
-        _client(ep).send_var(name, data_of(val))
-    for ep in sorted(set(epmap)):
-        _client(ep).send_batch_barrier()
     out_epmap = (attrs.get("out_epmap") or
                  [attrs["endpoints"][0]] * len(out_names))
-    outs = [_client(ep).get_var(n) for n, ep in zip(out_names, out_epmap)]
+    bucket = int(attrs.get("bucket_bytes", -1))
+    outs = comm_pool().send_round(
+        [(ep, n, data_of(v)) for n, v, ep in zip(in_names, xs, epmap)],
+        list(zip(out_epmap, out_names)),
+        bucket_bytes=None if bucket < 0 else bucket)
     return {"Out": outs}
 
 
@@ -58,10 +52,14 @@ def send(ctx, ins, attrs):
              dup_inputs=("X",), dup_outputs=("Out",),
              not_differentiable=True, host=True)
 def recv(ctx, ins, attrs):
-    """Standalone param fetch (recv_op.cc:28-53)."""
+    """Standalone param fetch (recv_op.cc:28-53), batched into
+    GET_BATCH frames."""
+    from ..parallel.comm import comm_pool
+
     out_names = ctx.op.output("Out")
-    c = _client(attrs["endpoint"])
-    return {"Out": [c.get_var(n) for n in out_names]}
+    ep = attrs["endpoint"]
+    outs = comm_pool().send_round([], [(ep, n) for n in out_names])
+    return {"Out": outs}
 
 
 @register_op("listen_and_serv", inputs=("X",), outputs=(),

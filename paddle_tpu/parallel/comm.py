@@ -1,0 +1,224 @@
+"""Fused, pipelined parameter-server communication (client side).
+
+The naive wire path pushed one variable per frame, one endpoint at a
+time, on a single thread — a sync round over many small grads was
+dominated by per-frame overhead and serialized RTTs.  This module adds
+the canonical fixes (PyTorch DDP's gradient buckets, Horovod's tensor
+fusion) on top of the batch verbs in parallel/pserver.py:
+
+* **arrival-order gradient buckets** — `VariableClient.send_vars`
+  packs grads in the order they arrive into buckets capped by the
+  ``comm_bucket_bytes`` flag (``PADDLE_TPU_COMM_BUCKET_BYTES``) and
+  ships each bucket as one ``SEND_BATCH`` frame;
+* **a per-endpoint connection/worker pool** (`CommPool`) — each
+  pserver gets its own client + single-thread worker, so a round's
+  per-endpoint chain (bucketed sends → barrier → one batched GET) runs
+  concurrently across pservers while staying ordered within each;
+* **round telemetry** on the observability registry — end-to-end round
+  latency, bytes moved per round by direction, and (in pserver.py)
+  bucket fill/size histograms — so a Prometheus dump shows whether
+  buckets actually fill and rounds actually overlap.
+
+Wire compatibility is the client's job: a `VariableClient` whose server
+answers ERR to a batch verb falls back to per-var frames permanently
+for that endpoint (see pserver.py), so one `CommPool` can serve mixed
+old/new pserver fleets.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+from .pserver import VariableClient
+
+__all__ = ["CommPool", "comm_pool", "reset_comm_pool"]
+
+# 64 B .. 1 GiB, x4 steps — grad rounds span tiny RNN cells to
+# full embedding tables
+_BYTE_BUCKETS = tuple(float(1 << i) for i in range(6, 31, 2))
+
+_M_ROUND_SECONDS = obs_metrics.histogram(
+    "paddle_tpu_comm_round_seconds",
+    "end-to-end pserver round latency: bucketed sends + barrier + "
+    "param pull across all endpoints (send/recv op)")
+_M_ROUND_BYTES = obs_metrics.histogram(
+    "paddle_tpu_comm_round_bytes",
+    "serialized payload bytes moved per round, by direction (frame "
+    "heads excluded so the directions are comparable)",
+    ("direction",), buckets=_BYTE_BUCKETS)
+
+
+class CommPool:
+    """Per-endpoint connection + worker pool.
+
+    One `VariableClient` and one single-thread executor per endpoint:
+    within an endpoint requests stay ordered (sends must precede the
+    barrier, the barrier must precede the pull), across endpoints they
+    overlap — the serial `for ep in endpoints` loop the send op used to
+    run paid one full round trip chain per pserver."""
+
+    def __init__(self, client_factory=None):
+        self._factory = client_factory or VariableClient
+        self._clients: Dict[str, VariableClient] = {}
+        self._workers: Dict[str, ThreadPoolExecutor] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def client(self, endpoint: str) -> VariableClient:
+        with self._lock:
+            c = self._clients.get(endpoint)
+            closed = self._closed
+        if c is not None:
+            # existing clients keep serving while close() drains the
+            # workers — only NEW connections are refused, so an
+            # in-flight round finishes instead of failing mid-round
+            return c
+        if closed:
+            raise RuntimeError("CommPool is closed")
+        # connect OUTSIDE the lock: a booting pserver can take
+        # seconds, and other endpoints' clients must not wait on it
+        c = self._factory(endpoint)
+        with self._lock:
+            if self._closed:
+                extant = None
+            else:
+                extant = self._clients.setdefault(endpoint, c)
+        if extant is None:
+            c.close()
+            raise RuntimeError("CommPool is closed")
+        if extant is not c:
+            c.close()
+            c = extant
+        return c
+
+    def _worker(self, endpoint: str) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("CommPool is closed")
+            w = self._workers.get(endpoint)
+            if w is None:
+                w = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"comm-{endpoint}")
+                self._workers[endpoint] = w
+            return w
+
+    def send_round(self, send_items: Sequence[Tuple[str, str, object]],
+                   get_items: Sequence[Tuple[str, str]],
+                   bucket_bytes: Optional[int] = None) -> List[object]:
+        """One fused synchronous round.
+
+        ``send_items``: [(endpoint, name, value)] grads in arrival
+        order; ``get_items``: [(endpoint, name)] params to pull.  Per
+        endpoint that received grads: bucketed sends, then the batch
+        barrier, then one batched GET — chained on that endpoint's
+        worker so endpoints overlap.  Endpoints appearing only in
+        ``get_items`` are read without a barrier (recv-op semantics).
+        Returns pulled values aligned with ``get_items``."""
+        t0 = time.perf_counter()
+        sends: Dict[str, list] = {}
+        for ep, name, value in send_items:
+            sends.setdefault(ep, []).append((name, value))
+        gets: Dict[str, list] = {}
+        for ep, name in get_items:
+            gets.setdefault(ep, []).append(name)
+        ctx = obs_tracing.current_context()
+
+        def run_ep(ep):
+            c = self.client(ep)
+            s0, r0 = c.bytes_sent, c.bytes_recv
+            with obs_tracing.activate(ctx), \
+                    obs_tracing.span("comm.endpoint_round", endpoint=ep):
+                if ep in sends:
+                    c.send_vars(sends[ep], bucket_bytes)
+                    c.send_batch_barrier()
+                vals = (c.get_vars(gets[ep], bucket_bytes)
+                        if ep in gets else [])
+            return vals, c.bytes_sent - s0, c.bytes_recv - r0
+
+        eps = sorted(set(sends) | set(gets))
+        results: Dict[str, tuple] = {}
+        if eps:
+            # ALWAYS go through the per-endpoint worker — even for one
+            # endpoint: two caller threads sharing the pool would
+            # otherwise interleave frames on the same non-thread-safe
+            # client socket; the worker is what serializes them
+            futs = {}
+            submit_exc = None
+            for ep in eps:
+                try:
+                    futs[ep] = self._worker(ep).submit(run_ep, ep)
+                except BaseException as e:
+                    # pool closed mid-loop: stop submitting, but still
+                    # drain what IS in flight below
+                    submit_exc = e
+                    break
+            first_exc = None
+            for ep, f in futs.items():
+                # drain EVERY submitted future before raising: an
+                # abandoned in-flight worker would race the caller's
+                # error handling on the shared clients
+                try:
+                    results[ep] = f.result()
+                except BaseException as e:
+                    if first_exc is None:
+                        first_exc = e
+            if first_exc is None:
+                first_exc = submit_exc
+            if first_exc is not None:
+                raise first_exc
+        out, idx = [], {ep: 0 for ep in gets}
+        for ep, name in get_items:
+            out.append(results[ep][0][idx[ep]])
+            idx[ep] += 1
+        _M_ROUND_SECONDS.observe(time.perf_counter() - t0)
+        _M_ROUND_BYTES.labels(direction="sent").observe(
+            sum(r[1] for r in results.values()))
+        _M_ROUND_BYTES.labels(direction="recv").observe(
+            sum(r[2] for r in results.values()))
+        return out
+
+    def close(self):
+        # order matters: mark closed (new rounds and NEW connections
+        # fail fast; existing clients keep serving), drain the workers
+        # so in-flight rounds finish against those live clients, and
+        # only then close the sockets — closing first would let a
+        # draining round register a fresh connection into an
+        # already-swept pool and leak it
+        with self._lock:
+            self._closed = True
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for w in workers:
+            w.shutdown(wait=True)
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()
+
+
+_POOL: Optional[CommPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def comm_pool() -> CommPool:
+    """The process-wide pool the send/recv ops route through."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = CommPool()
+        return _POOL
+
+
+def reset_comm_pool():
+    """Close every pooled connection/worker (tests, cluster teardown)."""
+    global _POOL
+    with _POOL_LOCK:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.close()

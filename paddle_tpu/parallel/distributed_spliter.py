@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import hashlib
 
-__all__ = ["hash_name", "round_robin"]
+__all__ = ["hash_name", "round_robin", "balanced_split"]
 
 
 def _stable_hash(name: str) -> int:
@@ -31,3 +31,46 @@ def round_robin(varlist, pserver_endpoints):
         eps.append(pserver_endpoints[i])
         i = (i + 1) % len(pserver_endpoints)
     return eps
+
+
+def _var_nbytes(v) -> int:
+    """Best-effort serialized size from program metadata: product of
+    |dims| (unknown/-1 dims count 1) x dtype itemsize.  Trainer and
+    pserver compute this from the SAME var descs, so placement stays
+    deterministic across processes."""
+    import numpy as np
+
+    n = 1
+    for d in (getattr(v, "shape", None) or ()):
+        try:
+            n *= max(abs(int(d)), 1)
+        except (TypeError, ValueError):
+            pass
+    try:
+        item = np.dtype(str(getattr(v, "dtype", None) or
+                            "float32")).itemsize
+    except TypeError:
+        item = 4
+    return n * item
+
+
+def balanced_split(varlist, pserver_endpoints):
+    """Size-weighted placement: largest var first, greedily onto the
+    least-loaded endpoint (ties broken by endpoint order).  round_robin
+    and hash_name count VARIABLES, so one pserver can end up owning
+    nearly all the BYTES (one embedding table next to dozens of bias
+    vectors); weighting by serialized size keeps per-round traffic and
+    optimize work near-even.  Deterministic: same varlist + endpoints
+    -> same placement in every process."""
+    varlist = list(varlist)
+    sizes = [_var_nbytes(v) for v in varlist]
+    order = sorted(range(len(varlist)),
+                   key=lambda i: (-sizes[i],
+                                  getattr(varlist[i], "name", ""), i))
+    load = [0] * len(pserver_endpoints)
+    assign = [0] * len(varlist)
+    for i in order:
+        j = min(range(len(load)), key=lambda k: (load[k], k))
+        assign[i] = j
+        load[j] += sizes[i]
+    return [pserver_endpoints[j] for j in assign]

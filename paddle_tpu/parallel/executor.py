@@ -293,14 +293,15 @@ class DistributeTranspiler:
 
     # -- real pserver mode (multi-process CPU clusters / host-side path) ----
     def _transpile_pserver(self, params_grads, split_method=None):
-        """Rewrite the trainer program: optimizer ops out, send ops in
-        (reference distribute_transpiler.py:134-231; whole-param placement
-        per a distributed_spliter policy, default round_robin as in
-        distribute_transpiler_simple.py)."""
+        """Rewrite the trainer program: optimizer ops out, ONE fused
+        send op in (reference distribute_transpiler.py:134-231;
+        whole-param placement per a distributed_spliter policy, default
+        balanced_split — size-weighted so no pserver owns nearly all
+        the bytes; round_robin/hash_name stay selectable)."""
         from . import distributed_spliter
 
         if split_method is None:
-            split_method = distributed_spliter.round_robin
+            split_method = distributed_spliter.balanced_split
         eps = self._endpoints
         self._pairs_by_ep = {ep: [] for ep in eps}
         placement = split_method([p for p, _ in params_grads], eps)
@@ -311,15 +312,22 @@ class DistributeTranspiler:
         block = self._program.global_block()
         drop = set(id(op) for op in self._optimize_ops)
         block.ops[:] = [op for op in block.ops if id(op) not in drop]
-        for ep in eps:
-            pairs = self._pairs_by_ep[ep]
-            if not pairs:
-                continue
+        if params_grads:
+            # one bucketed send across ALL endpoints: per-var epmap for
+            # the grads, out_epmap for the param pulls.  The runtime
+            # (ops/distributed.py + parallel/comm.py) packs each
+            # endpoint's grads into arrival-order buckets and overlaps
+            # endpoints; the per-endpoint send ops emitted before this
+            # forced one serial round per pserver.
             block.append_op(
                 "send",
-                {"X": [g.name for _, g in pairs]},
-                {"Out": [p.name for p, _ in pairs]},
-                {"endpoints": [ep], "epmap": [ep] * len(pairs)})
+                {"X": [g.name for _, g in params_grads]},
+                {"Out": [p.name for p, _ in params_grads]},
+                {"endpoints": list(eps),
+                 "epmap": [self._assign[p.name]
+                           for p, _ in params_grads],
+                 "out_epmap": [self._assign[p.name]
+                               for p, _ in params_grads]})
         self._program.bump_version()
 
     def get_trainer_program(self):

@@ -33,8 +33,9 @@ from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 
 __all__ = ["VariableServer", "VariableClient", "BarrierTimeoutError",
-           "serialize_var", "deserialize_var", "prebind_endpoint",
-           "discard_prebound"]
+           "serialize_var", "deserialize_var", "serialize_var_parts",
+           "serialize_batch_parts", "deserialize_batch",
+           "prebind_endpoint", "discard_prebound"]
 
 _HDR = struct.Struct("<I")
 
@@ -56,9 +57,25 @@ _M_BARRIER_WAIT = obs_metrics.histogram(
 _M_OPTIMIZE_SECONDS = obs_metrics.histogram(
     "paddle_tpu_pserver_optimize_seconds",
     "server-side fan-in grad merge + optimize-program latency")
+# fused-transfer telemetry (parallel/comm.py carries the per-round
+# latency/bytes histograms; these profile the bucket packer itself)
+_M_BUCKET_VARS = obs_metrics.histogram(
+    "paddle_tpu_comm_bucket_vars",
+    "variables fused into one SEND_BATCH bucket",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+_M_BUCKET_FILL = obs_metrics.histogram(
+    "paddle_tpu_comm_bucket_fill",
+    "bucket payload bytes / comm_bucket_bytes cap (>1: one oversized "
+    "var shipped alone)",
+    buckets=(0.0625, 0.125, 0.25, 0.5, 0.75, 1.0, 2.0))
+_M_BATCH_FALLBACK = obs_metrics.counter(
+    "paddle_tpu_comm_batch_fallback_total",
+    "batch-capable clients that dropped to per-var frames after a "
+    "legacy server rejected SEND_BATCH/GET_BATCH")
 
 _KNOWN_VERBS = frozenset(
-    {"HELLO", "SEND", "BARRIER", "GET", "STOP", "OK", "ERR", "VAR"})
+    {"HELLO", "SEND", "SEND_BATCH", "BARRIER", "GET", "GET_BATCH",
+     "STOP", "OK", "ERR", "VAR", "VARS"})
 
 # frame-length sanity: a header larger than 1 MiB or a payload larger
 # than 2 GiB is protocol desync / corruption, not a real request —
@@ -115,7 +132,27 @@ atexit.register(discard_prebound)
 # ---------------------------------------------------------------------------
 
 
-def serialize_var(value) -> bytes:
+def _as_u8(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of an array's bytes — no copy once contiguous."""
+    a = np.ascontiguousarray(arr)
+    return a.reshape(-1).view(np.uint8)
+
+
+def _blen(part) -> int:
+    return part.nbytes if hasattr(part, "nbytes") else len(part)
+
+
+def _join_parts(parts) -> bytes:
+    return b"".join(p if isinstance(p, (bytes, bytearray)) else bytes(p)
+                    for p in parts)
+
+
+def serialize_var_parts(value):
+    """-> (head dict, [flat-uint8 buffers]): the zero-copy wire form.
+    The buffers are views over the value's own memory, written with
+    scatter-gather (`_sendall_parts`) instead of `tobytes()` concat;
+    joining `parts` after the JSON head reproduces the legacy
+    `serialize_var` payload byte-for-byte."""
     if isinstance(value, SelectedRows):
         # sparse message: rows + row values + dense height — the
         # reference's large-model path ships sparse rows to pservers
@@ -123,65 +160,182 @@ def serialize_var(value) -> bytes:
         # SerializeToMessage's SELECTED_ROWS branch)
         rows = np.ascontiguousarray(np.asarray(value.rows))
         data = np.ascontiguousarray(np.asarray(value.value))
-        head = json.dumps({
+        head = {
             "kind": "selected_rows", "height": int(value.height),
             "rows_dtype": str(rows.dtype), "n_rows": int(rows.shape[0]),
             "dtype": str(data.dtype), "shape": list(data.shape),
-        }).encode()
-        return (_HDR.pack(len(head)) + head + rows.tobytes() +
-                data.tobytes())
+        }
+        return head, [_as_u8(rows), _as_u8(data)]
     if isinstance(value, LoDTensor):
         data = np.asarray(value.data)
         lod = [list(map(int, lvl)) for lvl in value.lod]
     else:
         data = np.asarray(value)
         lod = None
-    head = json.dumps({
-        "dtype": str(data.dtype), "shape": list(data.shape), "lod": lod,
-    }).encode()
-    raw = np.ascontiguousarray(data).tobytes()
-    return _HDR.pack(len(head)) + head + raw
+    head = {"dtype": str(data.dtype), "shape": list(data.shape),
+            "lod": lod}
+    return head, [_as_u8(data)]
 
 
-def deserialize_var(payload: bytes):
-    (hlen,) = _HDR.unpack_from(payload)
-    head = json.loads(payload[_HDR.size:_HDR.size + hlen])
-    raw = payload[_HDR.size + hlen:]
+def _var_payload_parts(head: dict, parts) -> list:
+    hb = json.dumps(head).encode()
+    return [_HDR.pack(len(hb)) + hb, *parts]
+
+
+def serialize_var(value) -> bytes:
+    head, parts = serialize_var_parts(value)
+    return _join_parts(_var_payload_parts(head, parts))
+
+
+def _batch_payload_parts(prepared) -> list:
+    """`prepared`: [(name, head, parts, nbytes)] -> scatter-gather
+    buffer list for one batch payload: HDR(len(bh)) + bh + concatenated
+    var bytes, bh = {"vars": [{"name", "nbytes", **var_head}, ...]}."""
+    heads = [{"name": n, "nbytes": nb, **h} for n, h, _, nb in prepared]
+    bh = json.dumps({"vars": heads}).encode()
+    out = [_HDR.pack(len(bh)), bh]
+    for _, _, parts, _ in prepared:
+        out.extend(parts)
+    return out
+
+
+def _prepare_vars(items):
+    """[(name, value)] -> [(name, head, parts, nbytes)] (no copies)."""
+    prepared = []
+    for n, v in items:
+        head, parts = serialize_var_parts(v)
+        prepared.append((n, head, parts, sum(_blen(p) for p in parts)))
+    return prepared
+
+
+def serialize_batch_parts(items) -> list:
+    """[(name, value)] -> buffer list for one SEND_BATCH/VARS payload."""
+    return _batch_payload_parts(_prepare_vars(items))
+
+
+def _value_from_head(head: dict, raw, copy: bool = True):
+    """Value from a var head + its raw bytes (`raw` may be a memoryview
+    slice of a larger frame; copy=False returns arrays viewing it)."""
     if head.get("kind") == "selected_rows":
         rows_dt = np.dtype(head["rows_dtype"])
         split = head["n_rows"] * rows_dt.itemsize
-        rows = np.frombuffer(raw[:split], dtype=rows_dt).copy()
+        rows = np.frombuffer(raw[:split], dtype=rows_dt)
         data = np.frombuffer(raw[split:], dtype=np.dtype(head["dtype"])) \
-            .reshape(head["shape"]).copy()
+            .reshape(head["shape"])
+        if copy:
+            rows, data = rows.copy(), data.copy()
         return SelectedRows(rows, data, head["height"])
     data = np.frombuffer(raw, dtype=np.dtype(head["dtype"])).reshape(
-        head["shape"]).copy()
-    if head["lod"] is not None:
+        head["shape"])
+    if copy:
+        data = data.copy()
+    if head.get("lod") is not None:
         return LoDTensor(data, [tuple(lvl) for lvl in head["lod"]])
     return data
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def deserialize_var(payload, copy: bool = True):
+    """copy=False skips the defensive `.copy()` for payloads the CALLER
+    owns (each frame's payload is a fresh buffer, so the wire paths pass
+    False); keep the default for buffers that are reused after the
+    call — the returned arrays would silently change under the reader."""
+    mv = memoryview(payload)
+    (hlen,) = _HDR.unpack_from(mv)
+    head = json.loads(bytes(mv[_HDR.size:_HDR.size + hlen]))
+    return _value_from_head(head, mv[_HDR.size + hlen:], copy=copy)
+
+
+def deserialize_batch(payload, copy: bool = False):
+    """Batch payload -> [(name, value)].  Default copy=False: values
+    slice ONE frame buffer instead of copying per var (the buffer is
+    fresh per frame on both ends, so views are safe and keep the whole
+    bucket alive only as long as its vars are)."""
+    mv = memoryview(payload)
+    (hlen,) = _HDR.unpack_from(mv)
+    bh = json.loads(bytes(mv[_HDR.size:_HDR.size + hlen]))
+    off = _HDR.size + hlen
+    out = []
+    for h in bh["vars"]:
+        n = int(h["nbytes"])
+        out.append((h["name"],
+                    _value_from_head(h, mv[off:off + n], copy=copy)))
+        off += n
+    return out
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly n bytes into ONE preallocated buffer via recv_into
+    (the old `bytes += chunk` loop was O(n^2) and re-copied the prefix
+    on every chunk)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed")
-        buf += chunk
+        got += r
     return buf
 
 
-def _frame_bytes(verb: str, name: str = "", payload: bytes = b"",
-                 trace=None) -> bytes:
-    """`trace` is an optional tracing.inject() dict; the field is simply
+# sendmsg iovec batching: IOV_MAX is 1024 on Linux; stay well under it
+_IOV_CHUNK = 64
+
+# names per GET_BATCH frame.  A count cap alone cannot bound the reply
+# payload (param sizes are unknown client-side), so the server answers
+# ERR "batch too large" for a chunk that would overflow _MAX_PAYLOAD
+# and the client re-fetches that chunk per-var.
+_GET_BATCH_CHUNK = 256
+
+
+def _sendall_parts(sock: socket.socket, parts) -> int:
+    """Write a list of buffers without concatenating them: scatter-
+    gather via sendmsg where available, sequential sendall otherwise.
+    Returns total bytes written."""
+    views, total = [], 0
+    for p in parts:
+        v = memoryview(p)
+        if v.itemsize != 1:
+            v = v.cast("B")
+        total += v.nbytes
+        if v.nbytes:
+            views.append(v)
+    if not hasattr(sock, "sendmsg"):
+        for v in views:
+            sock.sendall(v)
+        return total
+    i = 0
+    while i < len(views):
+        sent = sock.sendmsg(views[i:i + _IOV_CHUNK])
+        while sent > 0:
+            v = views[i]
+            if sent >= v.nbytes:
+                sent -= v.nbytes
+                i += 1
+            else:
+                views[i] = v[sent:]
+                sent = 0
+    return total
+
+
+def _frame_parts(verb: str, name: str = "", payload_parts=(),
+                 trace=None) -> list:
+    """Scatter-gather frame: [8-byte lengths + head, *payload buffers].
+    `trace` is an optional tracing.inject() dict; the field is simply
     absent for untraced senders, so peers predating it (and frames it
     never saw) parse unchanged — wire-compatible both directions."""
     head_d = {"verb": verb, "name": name}
     if trace is not None:
         head_d["trace"] = trace
     head = json.dumps(head_d).encode()
-    return (_HDR.pack(len(head)) + _HDR.pack(len(payload)) + head +
-            payload)
+    plen = sum(_blen(p) for p in payload_parts)
+    return [_HDR.pack(len(head)) + _HDR.pack(plen) + head,
+            *payload_parts]
+
+
+def _frame_bytes(verb: str, name: str = "", payload: bytes = b"",
+                 trace=None) -> bytes:
+    return _join_parts(_frame_parts(verb, name, [payload], trace))
 
 
 def _send_frame(sock: socket.socket, verb: str, name: str = "",
@@ -189,6 +343,23 @@ def _send_frame(sock: socket.socket, verb: str, name: str = "",
     frame = _frame_bytes(verb, name, payload, trace)
     _M_BYTES_SENT.inc(len(frame))
     sock.sendall(frame)
+
+
+def _send_frame_parts(sock: socket.socket, verb: str, name: str = "",
+                      payload_parts=(), trace=None) -> int:
+    n = _sendall_parts(sock, _frame_parts(verb, name, payload_parts,
+                                          trace))
+    _M_BYTES_SENT.inc(n)
+    return n
+
+
+def _bucket_cap(bucket_bytes=None) -> int:
+    """Effective SEND bucket size cap: explicit arg, else the
+    comm_bucket_bytes flag (PADDLE_TPU_COMM_BUCKET_BYTES)."""
+    if bucket_bytes is not None:
+        return int(bucket_bytes)
+    from ..core.flags import get_flag
+    return int(get_flag("comm_bucket_bytes"))
 
 
 def _recv_frame(sock: socket.socket):
@@ -223,11 +394,16 @@ class VariableServer:
 
     def __init__(self, optimize_program, scope, executor, fan_in: int = 1,
                  sync: bool = True, snapshot_dir: Optional[str] = None,
-                 snapshot_every: int = 0):
+                 snapshot_every: int = 0, enable_batch: bool = True):
         self.program = optimize_program
         self.scope = scope
         self.exe = executor
         self.fan_in = fan_in
+        # enable_batch=False turns off the fused SEND_BATCH/GET_BATCH
+        # verbs, making this server answer exactly like one predating
+        # them (ERR "unknown verb") — the wire-compat tests pin the
+        # batch-capable client's fallback against it
+        self.enable_batch = enable_batch
         # per-shard checkpointing (reference go/pserver/service.go:
         # 120-203,346: each pserver snapshots ITS OWN shard with
         # {uuid, md5, timestamp} meta and restores on restart).  Each
@@ -359,7 +535,7 @@ class VariableServer:
                             _send_frame(conn, "OK")
                         elif verb == "SEND":
                             tid = self._trainer_id(peer or "anon")
-                            value = deserialize_var(payload)
+                            value = deserialize_var(payload, copy=False)
                             if self.sync:
                                 with self._lock:
                                     # per-trainer grad rename
@@ -369,14 +545,50 @@ class VariableServer:
                             else:
                                 self._apply_async(name, value)
                             _send_frame(conn, "OK")
+                        elif verb == "SEND_BATCH" and self.enable_batch:
+                            tid = self._trainer_id(peer or "anon")
+                            # deserialize the whole bucket OUTSIDE the
+                            # lock (views over the frame buffer, no
+                            # per-var copies), apply under ONE
+                            # acquisition
+                            pairs = deserialize_batch(payload)
+                            if self.sync:
+                                with self._lock:
+                                    for n, v in pairs:
+                                        self.scope.set_var(
+                                            f"{n}.trainer_{tid}", v)
+                            else:
+                                self._apply_async_bucket(pairs)
+                            _send_frame(conn, "OK")
+                        elif verb == "GET_BATCH" and self.enable_batch:
+                            names = json.loads(bytes(payload))
+                            vals = self._blocking_get_many(names)
+                            parts = serialize_batch_parts(
+                                list(zip(names, vals)))
+                            if sum(_blen(p)
+                                   for p in parts) > _MAX_PAYLOAD:
+                                # chunking is by NAME count, so huge
+                                # params can overflow the frame cap —
+                                # tell the client to fetch this chunk
+                                # per-var instead of shipping a frame
+                                # its parser must reject
+                                _send_frame(
+                                    conn, "ERR",
+                                    f"batch too large: {len(names)} "
+                                    "vars exceed the frame payload cap")
+                            else:
+                                _send_frame_parts(conn, "VARS", "",
+                                                  parts)
                         elif verb == "BARRIER":
                             if self.sync:
                                 self._barrier()
                             _send_frame(conn, "OK")
                         elif verb == "GET":
                             val = self._blocking_get(name)
-                            _send_frame(conn, "VAR", name,
-                                        serialize_var(val))
+                            _send_frame_parts(
+                                conn, "VAR", name,
+                                _var_payload_parts(
+                                    *serialize_var_parts(val)))
                         elif verb == "STOP":
                             _send_frame(conn, "OK")
                             self.stop()
@@ -542,29 +754,43 @@ class VariableServer:
         self._async_built = True
 
     def _apply_async(self, name, value):
-        snap = None
+        self._apply_async_bucket([(name, value)])
+
+    def _apply_async_bucket(self, pairs):
+        """ASGD application for one or many grads under ONE lock
+        acquisition (a SEND_BATCH bucket must not interleave with other
+        trainers' grads mid-bucket)."""
+        snaps = []
         with self._lock:
-            self.scope.set_var(name, value)
-            if self.program is None:
-                return
-            assert self._async_built  # built (and validated) in __init__
-            prog = self._async_progs.get(name)
-            if prog is not None:
-                self.exe.run(prog, scope=self.scope)
-                self._async_seen.add(name)
-                snap = self._maybe_snapshot_data()
-                if isinstance(value, SelectedRows):
-                    # applied rows must not survive to the next arrival
-                    self.scope.erase(name)
-            # epilogue fires once per full sweep of DISTINCT grads (Adam
-            # beta pows / global step advance at the sync round rate);
-            # non-grad sends and resends don't advance the cadence
-            if (self._async_epilogue is not None and self._async_grads
-                    and self._async_seen >= self._async_grads):
-                self.exe.run(self._async_epilogue, scope=self.scope)
-                self._async_seen.clear()
-        if snap is not None:
+            for name, value in pairs:
+                snap = self._apply_async_locked(name, value)
+                if snap is not None:
+                    snaps.append(snap)
+        for snap in snaps:
             self._write_snapshot(snap)
+
+    def _apply_async_locked(self, name, value):
+        self.scope.set_var(name, value)
+        if self.program is None:
+            return None
+        assert self._async_built  # built (and validated) in __init__
+        snap = None
+        prog = self._async_progs.get(name)
+        if prog is not None:
+            self.exe.run(prog, scope=self.scope)
+            self._async_seen.add(name)
+            snap = self._maybe_snapshot_data()
+            if isinstance(value, SelectedRows):
+                # applied rows must not survive to the next arrival
+                self.scope.erase(name)
+        # epilogue fires once per full sweep of DISTINCT grads (Adam
+        # beta pows / global step advance at the sync round rate);
+        # non-grad sends and resends don't advance the cadence
+        if (self._async_epilogue is not None and self._async_grads
+                and self._async_seen >= self._async_grads):
+            self.exe.run(self._async_epilogue, scope=self.scope)
+            self._async_seen.clear()
+        return snap
 
     def _run_optimize(self):
         import time as _time
@@ -632,6 +858,23 @@ class VariableServer:
             raise KeyError(f"pserver has no variable {name!r}")
         return v
 
+    def _blocking_get_many(self, names):
+        """GET_BATCH read: all names under ONE lock acquisition, so the
+        whole bucket reads from the same round's state (a per-name loop
+        could straddle an optimize)."""
+        with self._lock:
+            vals = []
+            for n in names:
+                # absent names raise KeyError in find_var; declared-
+                # but-unset vars come back None — same curated error
+                # for both
+                v = (self.scope.find_var(n)
+                     if self.scope.has_var(n) else None)
+                if v is None:
+                    raise KeyError(f"pserver has no variable {n!r}")
+                vals.append(v)
+        return vals
+
 
 # ---------------------------------------------------------------------------
 # client (grpc_client.h AsyncSendVariable/AsyncGetVariable/SendBatchBarrier)
@@ -685,6 +928,16 @@ class VariableClient:
         # routing this trainer to its original grad slot.
         self._cid = client_id or f"{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
         self.sock: Optional[socket.socket] = None
+        # None = capability unknown (probe on first batch verb); False =
+        # the server answered ERR "unknown verb" once, so every later
+        # call goes straight to per-var frames without re-probing
+        self._batch_supported: Optional[bool] = None
+        # per-instance accounting of serialized PAYLOAD bytes by
+        # direction (frame heads excluded, so the two directions are
+        # comparable) — comm.CommPool deltas these around a round to
+        # feed the round-bytes histogram without double-serializing
+        self.bytes_sent = 0
+        self.bytes_recv = 0
         self._connect(connect_timeout)
 
     def _connect(self, connect_timeout: Optional[float] = None):
@@ -722,7 +975,7 @@ class VariableClient:
 
     def _request(self, verb: str, name: str = "", payload: bytes = b"",
                  idempotent: bool = True,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None, payload_parts=None):
         """One framed roundtrip.  Connection-level failures (peer died,
         truncated frame, request timeout) reconnect + resend when
         `idempotent`; protocol-level ERR replies raise RuntimeError
@@ -743,30 +996,55 @@ class VariableClient:
                               endpoint=self.endpoint, var=name):
             trace = obs_tracing.inject()
             return self._request_attempts(state, verb, name, payload,
-                                          idempotent, timeout, trace)
+                                          idempotent, timeout, trace,
+                                          payload_parts)
 
     def _request_attempts(self, state, verb, name, payload, idempotent,
-                          timeout, trace):
+                          timeout, trace, payload_parts=None):
         while True:
             sent = False
             try:
                 if self.sock is None:
                     self._connect()
                 fault_injector().fire("pserver.request")
-                frame = _frame_bytes(verb, name, payload, trace)
-                data = fault_injector().mangle("pserver.send", frame)
                 self.sock.settimeout(timeout)
                 try:
-                    _M_BYTES_SENT.inc(len(data))
-                    self.sock.sendall(data)
-                    if data != frame:
-                        # injected mid-write crash / wire corruption: the
-                        # server got a mangled frame; fail our side like
-                        # the sender process died
-                        raise ConnectionError(
-                            "fault injection: mangled frame")
+                    if (payload_parts is not None
+                            and not fault_injector().rules()):
+                        # zero-copy path: the frame never exists as one
+                        # contiguous buffer — lengths + head in the
+                        # first iovec, value views after it
+                        n = _sendall_parts(
+                            self.sock,
+                            _frame_parts(verb, name, payload_parts,
+                                         trace))
+                        _M_BYTES_SENT.inc(n)
+                        payload_n = sum(_blen(p)
+                                        for p in payload_parts)
+                    else:
+                        # chaos rules mangle whole frames, so join the
+                        # parts when the injector is armed
+                        if payload_parts is not None:
+                            payload = _join_parts(payload_parts)
+                        frame = _frame_bytes(verb, name, payload, trace)
+                        data = fault_injector().mangle(
+                            "pserver.send", frame)
+                        _M_BYTES_SENT.inc(len(data))
+                        self.sock.sendall(data)
+                        payload_n = len(payload)
+                        if data != frame:
+                            # injected mid-write crash / wire
+                            # corruption: the server got a mangled
+                            # frame; fail our side like the sender
+                            # process died
+                            raise ConnectionError(
+                                "fault injection: mangled frame")
                     sent = True
                     rverb, rname, rpayload, _ = _recv_frame(self.sock)
+                    # account the COMPLETED roundtrip only — a counted
+                    # failed attempt would break sent/recv symmetry
+                    self.bytes_sent += payload_n
+                    self.bytes_recv += len(rpayload)
                 finally:
                     if self.sock is not None:
                         self.sock.settimeout(None)
@@ -797,9 +1075,122 @@ class VariableClient:
                 state.sleep()
 
     def send_var(self, name: str, value):
-        rverb, _, _ = self._request("SEND", name, serialize_var(value))
+        head, parts = serialize_var_parts(value)
+        rverb, _, _ = self._request(
+            "SEND", name, payload_parts=_var_payload_parts(head, parts))
         if rverb != "OK":
             raise RuntimeError(f"pserver error sending {name!r}: {rverb}")
+
+    # -- fused transfers (SEND_BATCH/GET_BATCH with legacy fallback) --------
+    def send_vars(self, items, bucket_bytes: Optional[int] = None):
+        """Fused SEND: pack `[(name, value)]` into arrival-order buckets
+        capped at `bucket_bytes` (default: the comm_bucket_bytes flag /
+        PADDLE_TPU_COMM_BUCKET_BYTES) and ship each bucket as ONE
+        SEND_BATCH frame.  Falls back to per-var legacy SENDs against a
+        server that answers ERR (wire compat both ways) or when
+        bucketing is disabled (cap <= 0)."""
+        items = list(items)
+        cap = _bucket_cap(bucket_bytes)
+        if cap <= 0 or self._batch_supported is False or len(items) <= 1:
+            for n, v in items:
+                self.send_var(n, v)
+            return
+        prepared = _prepare_vars(items)
+        # DDP-style packing: arrival order, close a bucket when the next
+        # var would push it past the cap (an oversized var ships alone)
+        buckets, cur, cur_b = [], [], 0
+        for it in prepared:
+            if cur and cur_b + it[3] > cap:
+                buckets.append(cur)
+                cur, cur_b = [], 0
+            cur.append(it)
+            cur_b += it[3]
+        if cur:
+            buckets.append(cur)
+        for bi, bucket in enumerate(buckets):
+            if not self._send_bucket(bucket, cap):
+                # legacy server: this and every later bucket per-var
+                for later in buckets[bi:]:
+                    for n, head, parts, _ in later:
+                        rverb, _, _ = self._request(
+                            "SEND", n,
+                            payload_parts=_var_payload_parts(head,
+                                                             parts))
+                        if rverb != "OK":
+                            raise RuntimeError(
+                                f"pserver error sending {n!r}: {rverb}")
+                return
+
+    def _send_bucket(self, bucket, cap: int) -> bool:
+        """One SEND_BATCH frame; False (nothing sent) when the server
+        does not speak batch."""
+        if self._batch_supported is False:
+            return False
+        try:
+            rverb, _, _ = self._request(
+                "SEND_BATCH", "",
+                payload_parts=_batch_payload_parts(bucket))
+        except RuntimeError as e:
+            if "unknown verb" in str(e):
+                self._batch_supported = False
+                _M_BATCH_FALLBACK.inc()
+                return False
+            raise
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error on SEND_BATCH: {rverb}")
+        self._batch_supported = True
+        _M_BUCKET_VARS.observe(len(bucket))
+        _M_BUCKET_FILL.observe(sum(it[3] for it in bucket) / cap)
+        return True
+
+    def get_vars(self, names, bucket_bytes: Optional[int] = None):
+        """Fused GET: one GET_BATCH frame per `_GET_BATCH_CHUNK` names
+        (the reply slices a single buffer — no per-var copies); per-var
+        GETs against a legacy server, or whenever fusion is disabled
+        (cap <= 0 — the same switch send_vars honors, so
+        comm_bucket_bytes=0 really is the whole legacy wire path).
+        Returns values in `names` order."""
+        names = list(names)
+        fused = _bucket_cap(bucket_bytes) > 0
+        out = []
+        i = 0
+        while i < len(names):
+            if (not fused or self._batch_supported is False
+                    or len(names) - i == 1):
+                out.append(self.get_var(names[i]))
+                i += 1
+                continue
+            chunk = names[i:i + _GET_BATCH_CHUNK]
+            try:
+                rverb, _, rpayload = self._request(
+                    "GET_BATCH", "", json.dumps(chunk).encode())
+            except RuntimeError as e:
+                msg = str(e)
+                if "unknown verb" in msg:
+                    self._batch_supported = False
+                    _M_BATCH_FALLBACK.inc()
+                    continue  # redo this chunk per-var
+                if "batch too large" in msg:
+                    # this chunk's params overflow one reply frame —
+                    # per-var GETs for IT only; the endpoint still
+                    # speaks batch
+                    out.extend(self.get_var(n) for n in chunk)
+                    i += len(chunk)
+                    continue
+                raise
+            if rverb != "VARS":
+                raise RuntimeError(
+                    f"pserver error on GET_BATCH: {rverb}")
+            pairs = deserialize_batch(rpayload)
+            got = [n for n, _ in pairs]
+            if got != chunk:
+                raise RuntimeError(
+                    f"GET_BATCH answered vars {got[:3]}... for request "
+                    f"{chunk[:3]}...: protocol desync")
+            self._batch_supported = True
+            out.extend(v for _, v in pairs)
+            i += len(chunk)
+        return out
 
     def send_batch_barrier(self, timeout: Optional[float] = None):
         """Sync-round barrier.  `timeout` (or the instance-level
@@ -826,7 +1217,8 @@ class VariableClient:
         rverb, _, rpayload = self._request("GET", name)
         if rverb != "VAR":
             raise RuntimeError(f"pserver error fetching {name!r}: {rverb}")
-        return deserialize_var(rpayload)
+        # the reply buffer is this frame's alone — a view is safe
+        return deserialize_var(rpayload, copy=False)
 
     def stop_server(self):
         rverb, _, _ = self._request("STOP", idempotent=False)

@@ -1,0 +1,498 @@
+"""Fused, pipelined pserver communication (parallel/comm.py + the
+SEND_BATCH/GET_BATCH wire verbs in parallel/pserver.py).
+
+Wire-compat matrix pinned here:
+  * legacy per-var frames are byte-identical to the pre-batch format;
+  * old client <-> new server: the per-var verbs are still served;
+  * new client <-> old server: ERR "unknown verb" drops the client to
+    per-var frames, permanently for that endpoint;
+  * batch <-> batch leaves byte-identical final params vs the per-var
+    baseline path.
+"""
+import json
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import comm, distributed_spliter
+from paddle_tpu.parallel.pserver import (
+    VariableClient,
+    VariableServer,
+    _frame_bytes,
+    _join_parts,
+    deserialize_batch,
+    deserialize_var,
+    serialize_batch_parts,
+    serialize_var,
+)
+
+
+def _server(params, fan_in=1, sync=True, enable_batch=True, lr=0.1):
+    """VariableServer over an sgd-per-param optimize program.
+    `params`: {name: init ndarray}; grads are `<name>@GRAD`."""
+    scope = fluid.Scope()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        blk.create_var(name="lr", shape=[1], dtype="float32",
+                       persistable=True)
+        for n, v in params.items():
+            blk.create_var(name=n, shape=list(v.shape), dtype="float32",
+                           persistable=True)
+            blk.create_var(name=n + "@GRAD", shape=list(v.shape),
+                           dtype="float32", persistable=True)
+            blk.append_op("sgd",
+                          {"Param": [n], "Grad": [n + "@GRAD"],
+                           "LearningRate": ["lr"]},
+                          {"ParamOut": [n]}, {})
+    scope.set_var("lr", np.asarray([lr], np.float32))
+    for n, v in params.items():
+        scope.set_var(n, v.copy())
+    srv = VariableServer(prog, scope, fluid.Executor(fluid.CPUPlace()),
+                         fan_in=fan_in, sync=sync,
+                         enable_batch=enable_batch)
+    port = srv.serve(0)
+    return srv, f"127.0.0.1:{port}"
+
+
+# ---------------------------------------------------------------------------
+# wire format: legacy frames pinned byte-for-byte
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_frame_and_payload_bytes_pinned():
+    """The zero-copy refactor must not change a single legacy byte: an
+    old peer parses these frames with no knowledge of this PR."""
+    x = np.arange(4, dtype=np.float32)
+    vhead = json.dumps({"dtype": "float32", "shape": [4],
+                        "lod": None}).encode()
+    payload = serialize_var(x)
+    assert payload == (struct.pack("<I", len(vhead)) + vhead +
+                       x.tobytes())
+    fhead = json.dumps({"verb": "SEND", "name": "w"}).encode()
+    assert _frame_bytes("SEND", "w", payload) == (
+        struct.pack("<I", len(fhead)) + struct.pack("<I", len(payload)) +
+        fhead + payload)
+
+
+def test_batch_payload_roundtrip_all_kinds():
+    from paddle_tpu.core.lod import LoDTensor, SelectedRows
+
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    lt = LoDTensor(x.copy(), [(0, 1, 3)])
+    sr = SelectedRows(np.array([4, 1], np.int32),
+                      x[:2].copy(), height=16)
+    items = [("a", x), ("lt", lt), ("sr", sr)]
+    blob = bytearray(_join_parts(serialize_batch_parts(items)))
+    pairs = deserialize_batch(blob)
+    assert [n for n, _ in pairs] == ["a", "lt", "sr"]
+    np.testing.assert_array_equal(pairs[0][1], x)
+    np.testing.assert_array_equal(np.asarray(pairs[1][1].data), x)
+    assert tuple(pairs[1][1].lod) == ((0, 1, 3),)
+    np.testing.assert_array_equal(np.asarray(pairs[2][1].rows), [4, 1])
+    assert pairs[2][1].height == 16
+
+
+def test_deserialize_var_copy_semantics():
+    """copy=False returns a view of the caller-owned buffer (the batch
+    path slices one frame buffer); the default still copies."""
+    x = np.arange(4, dtype=np.float32)
+    buf = bytearray(serialize_var(x))
+    view = deserialize_var(buf, copy=False)
+    owned = deserialize_var(bytes(buf), copy=True)
+    buf[-4:] = struct.pack("<f", 99.0)
+    assert view[-1] == 99.0
+    assert owned[-1] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# compat matrix over real sockets
+# ---------------------------------------------------------------------------
+
+
+def test_batch_client_batch_server_round():
+    params = {f"w{i}": np.full(8, float(i + 1), np.float32)
+              for i in range(6)}
+    srv, ep = _server(params)
+    c = VariableClient(ep, client_id="t0")
+    grads = {n + "@GRAD": np.full(8, 0.5, np.float32) for n in params}
+    # tiny cap -> several buckets in one send_vars call
+    c.send_vars(list(grads.items()), bucket_bytes=2 * 8 * 4)
+    c.send_batch_barrier()
+    got = c.get_vars(list(params))
+    assert c._batch_supported is True
+    for n, v in zip(params, got):
+        np.testing.assert_allclose(np.asarray(v),
+                                   params[n] - 0.1 * 0.5, rtol=1e-6)
+    c.close()
+    srv.stop()
+
+
+def test_old_client_new_server_legacy_verbs():
+    """A client that only speaks per-var SEND/GET (the pre-batch
+    protocol) must work unchanged against a batch-capable server."""
+    params = {"w": np.ones(4, np.float32)}
+    srv, ep = _server(params)
+    c = VariableClient(ep, client_id="t0")
+    c.send_var("w@GRAD", np.full(4, 2.0, np.float32))
+    c.send_batch_barrier()
+    got = c.get_var("w")
+    np.testing.assert_allclose(np.asarray(got), 1.0 - 0.1 * 2.0,
+                               rtol=1e-6)
+    c.close()
+    srv.stop()
+
+
+def test_new_client_old_server_falls_back_per_var():
+    """enable_batch=False makes the server answer exactly like one
+    predating the batch verbs (ERR "unknown verb"): the client must
+    drop to per-var frames, produce the same result, and remember the
+    endpoint is legacy (no re-probing)."""
+    params = {f"w{i}": np.ones(4, np.float32) for i in range(5)}
+    srv, ep = _server(params, enable_batch=False)
+    c = VariableClient(ep, client_id="t0")
+    grads = [(n + "@GRAD", np.full(4, 1.0, np.float32)) for n in params]
+    c.send_vars(grads)
+    assert c._batch_supported is False
+    c.send_batch_barrier()
+    got = c.get_vars(list(params))
+    for v in got:
+        np.testing.assert_allclose(np.asarray(v), 0.9, rtol=1e-6)
+    c.close()
+    srv.stop()
+
+
+def test_get_vars_falls_back_when_only_gets_probe():
+    """A round with no sends (recv op) must also discover a legacy
+    server through GET_BATCH's ERR and fall back."""
+    params = {"a": np.full(4, 3.0, np.float32),
+              "b": np.full(4, 5.0, np.float32)}
+    srv, ep = _server(params, enable_batch=False)
+    c = VariableClient(ep, client_id="t0")
+    got = c.get_vars(["a", "b"])
+    assert c._batch_supported is False
+    np.testing.assert_allclose(np.asarray(got[0]), 3.0)
+    np.testing.assert_allclose(np.asarray(got[1]), 5.0)
+    c.close()
+    srv.stop()
+
+
+def test_batch_vs_pervar_final_params_byte_identical():
+    """Acceptance: the fused path must be a pure transport change — N
+    rounds through arrival-order buckets + concurrent endpoints leave
+    EXACTLY the bytes the per-var serial baseline leaves."""
+    names = [f"p{i}" for i in range(8)]
+    rng = np.random.RandomState(3)
+    init = {n: rng.rand(16).astype(np.float32) for n in names}
+    rounds = [
+        {n: rng.rand(16).astype(np.float32) for n in names}
+        for _ in range(3)]
+
+    def final_params(bucketed):
+        servers, eps = [], []
+        for half in (names[:4], names[4:]):
+            srv, ep = _server({n: init[n] for n in half})
+            servers.append(srv)
+            eps.append(ep)
+        owner = {n: eps[0] if n in names[:4] else eps[1] for n in names}
+        try:
+            if bucketed:
+                pool = comm.CommPool()
+                for grads in rounds:
+                    pool.send_round(
+                        [(owner[n], n + "@GRAD", grads[n])
+                         for n in names],
+                        [(owner[n], n) for n in names])
+                vals = pool.send_round(
+                    [], [(owner[n], n) for n in names])
+                out = {n: np.asarray(v).tobytes()
+                       for n, v in zip(names, vals)}
+                pool.close()
+            else:
+                clients = {ep: VariableClient(ep, client_id="t0")
+                           for ep in eps}
+                for grads in rounds:
+                    for n in names:
+                        clients[owner[n]].send_var(n + "@GRAD",
+                                                   grads[n])
+                    for ep in eps:
+                        clients[ep].send_batch_barrier()
+                    for n in names:
+                        clients[owner[n]].get_var(n)
+                out = {n: np.asarray(
+                    clients[owner[n]].get_var(n)).tobytes()
+                    for n in names}
+                for c in clients.values():
+                    c.close()
+            return out
+        finally:
+            for s in servers:
+                s.stop()
+
+    assert final_params(bucketed=True) == final_params(bucketed=False)
+
+
+def test_get_batch_too_large_falls_back_per_var(monkeypatch):
+    """A GET_BATCH whose reply would overflow the frame payload cap
+    gets ERR "batch too large": the client re-fetches that chunk
+    per-var WITHOUT demoting the endpoint to legacy."""
+    from paddle_tpu.parallel import pserver as ps
+
+    params = {"a": np.full(64, 3.0, np.float32),
+              "b": np.full(64, 5.0, np.float32)}
+    srv, ep = _server(params)
+    c = VariableClient(ep, client_id="t0")
+    # between one per-var reply (~350 B) and the 2-var batch reply
+    # (~750 B): the batch overflows, singles still fit the frame cap
+    monkeypatch.setattr(ps, "_MAX_PAYLOAD", 600)
+    got = c.get_vars(["a", "b"])
+    assert c._batch_supported is not False  # endpoint still batch-able
+    np.testing.assert_allclose(np.asarray(got[0]), 3.0)
+    np.testing.assert_allclose(np.asarray(got[1]), 5.0)
+    c.close()
+    srv.stop()
+
+
+def test_send_batch_async_server_applies_each_once():
+    """sync=False (ASGD): a SEND_BATCH bucket applies each grad's
+    program slice exactly once, under one lock acquisition."""
+    params = {"w": np.ones(4, np.float32), "v": np.ones(3, np.float32)}
+    srv, ep = _server(params, fan_in=99, sync=False)
+    c = VariableClient(ep, client_id="t0")
+    c.send_vars([("w@GRAD", np.full(4, 1.0, np.float32)),
+                 ("v@GRAD", np.full(3, 2.0, np.float32))])
+    w, v = c.get_vars(["w", "v"])
+    np.testing.assert_allclose(np.asarray(w), 1.0 - 0.1 * 1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v), 1.0 - 0.1 * 2.0, rtol=1e-6)
+    c.close()
+    srv.stop()
+
+
+def test_commpool_preserves_interleaved_get_order():
+    """send_round returns values aligned with get_items even when the
+    requested order interleaves endpoints."""
+    srv_a, ep_a = _server({"a0": np.full(2, 1.0, np.float32),
+                           "a1": np.full(2, 2.0, np.float32)})
+    srv_b, ep_b = _server({"b0": np.full(2, 3.0, np.float32)})
+    pool = comm.CommPool()
+    try:
+        vals = pool.send_round(
+            [], [(ep_a, "a0"), (ep_b, "b0"), (ep_a, "a1")])
+        got = [float(np.asarray(v)[0]) for v in vals]
+        assert got == [1.0, 3.0, 2.0]
+    finally:
+        pool.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_send_op_multi_endpoint_epmap():
+    """Full layer/op path: one fused send op routing two grads to two
+    different pservers via epmap/out_epmap."""
+    srv_a, ep_a = _server({"wa": np.full(4, 2.0, np.float32)}, lr=0.5)
+    srv_b, ep_b = _server({"wb": np.full(4, 4.0, np.float32)}, lr=0.5)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ga = fluid.layers.data(name="wa@GRAD", shape=[4],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            gb = fluid.layers.data(name="wb@GRAD", shape=[4],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            blk = main.global_block()
+            wa = blk.create_var(name="wa", shape=[4], dtype="float32")
+            wb = blk.create_var(name="wb", shape=[4], dtype="float32")
+            # out_epmap omitted: it must follow epmap (each param
+            # pulled from the server its grad went to) — pulling both
+            # from ep_a would KeyError on "wb"
+            fluid.layers.Send([ep_a, ep_b], [ga, gb], [wa, wb],
+                              epmap=[ep_a, ep_b])
+        exe = fluid.Executor(fluid.CPUPlace())
+        oa, ob = exe.run(
+            main,
+            feed={"wa@GRAD": np.ones(4, np.float32),
+                  "wb@GRAD": np.full(4, 2.0, np.float32)},
+            fetch_list=[wa, wb], scope=fluid.Scope())
+        np.testing.assert_allclose(np.asarray(oa), 2.0 - 0.5 * 1.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(ob), 4.0 - 0.5 * 2.0,
+                                   rtol=1e-6)
+    finally:
+        from paddle_tpu.ops.distributed import reset_clients
+        reset_clients()
+        srv_a.stop()
+        srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# placement + transpiler + lint
+# ---------------------------------------------------------------------------
+
+
+class _V:
+    def __init__(self, name, shape, dtype="float32"):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+def test_balanced_split_weights_bytes_not_counts():
+    """Weights interleaved with their tiny biases (the typical
+    params_grads order): round_robin's count-based cycle lands EVERY
+    weight matrix on the same pserver; balanced_split must keep byte
+    loads near-even (and stay deterministic across calls)."""
+    vs = []
+    for i in range(4):
+        vs.append(_V(f"w{i}", [256, 256]))
+        vs.append(_V(f"b{i}", [256]))
+    eps = ["a:1", "b:2"]
+
+    def loads(placement):
+        out = {ep: 0 for ep in eps}
+        for v, ep in zip(vs, placement):
+            n = 1
+            for d in v.shape:
+                n *= d
+            out[ep] += n * 4
+        return out
+
+    rr = loads(distributed_spliter.round_robin(vs, eps))
+    assert max(rr.values()) / sum(rr.values()) > 0.95  # the pathology
+    got = distributed_spliter.balanced_split(vs, eps)
+    assert got == distributed_spliter.balanced_split(vs, eps)
+    bal = loads(got)
+    assert max(bal.values()) / sum(bal.values()) < 0.6, bal
+    # the old count-based policies remain selectable
+    assert distributed_spliter.round_robin(vs, eps)[0] == "a:1"
+    assert set(distributed_spliter.hash_name(vs, eps)) <= set(eps)
+
+
+def test_transpiler_emits_one_fused_send():
+    eps = ["127.0.0.1:7001", "127.0.0.1:7002"]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=8, act=None)
+        pred = fluid.layers.fc(input=pred, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        opt_ops, pg = fluid.SGD(learning_rate=0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(optimize_ops=opt_ops, params_grads=pg, trainers=1,
+                    pservers=",".join(eps))
+    sends = [op for op in main.global_block().ops if op.type == "send"]
+    assert len(sends) == 1
+    op = sends[0]
+    assert op.attrs["endpoints"] == eps
+    assert len(op.attrs["epmap"]) == len(op.input("X")) == len(pg)
+    assert len(op.attrs["out_epmap"]) == len(op.output("Out"))
+    # grads and their params ride to the same endpoint
+    assert op.attrs["epmap"] == op.attrs["out_epmap"]
+    assert set(op.attrs["epmap"]) <= set(eps)
+    # the fused shape verifies clean under the distributed lint
+    diags = [d for d in main.verify(level=None)
+             if d.pass_id == "distributed-lint"
+             and d.severity in ("error", "warning")]
+    assert not diags, diags
+
+
+def test_lint_out_epmap_arity_mismatch_is_error():
+    p = fluid.Program()
+    b = p.global_block()
+    b.append_op("send", {"X": ["g"]}, {"Out": ["p0", "p1"]},
+                {"endpoints": ["h:1"], "epmap": ["h:1"],
+                 "out_epmap": ["h:1"]})
+    ds = [d for d in p.verify(level=None)
+          if d.pass_id == "distributed-lint" and d.severity == "error"]
+    assert any("out_epmap" in d.message for d in ds)
+
+
+def test_lint_mixed_bucketed_unbucketed_sends_warn():
+    p = fluid.Program()
+    b = p.global_block()
+    b.append_op("send", {"X": ["g0"]}, {"Out": ["p0"]},
+                {"endpoints": ["h:1"], "epmap": ["h:1"]})
+    b.append_op("send", {"X": ["g1"]}, {"Out": ["p1"]},
+                {"endpoints": ["h:1"], "epmap": []})
+    ds = [d for d in p.verify(level=None)
+          if d.pass_id == "distributed-lint"
+          and d.severity == "warning" and "mixes bucketed" in d.message]
+    assert len(ds) == 1
+    # uniform bucketed sends do not warn
+    p2 = fluid.Program()
+    b2 = p2.global_block()
+    for i in range(2):
+        b2.append_op("send", {"X": [f"g{i}"]}, {"Out": [f"p{i}"]},
+                     {"endpoints": ["h:1"], "epmap": ["h:1"]})
+    assert not [d for d in p2.verify(level=None)
+                if "mixes bucketed" in d.message]
+
+
+# ---------------------------------------------------------------------------
+# fan-in + concurrency + perf
+# ---------------------------------------------------------------------------
+
+
+def test_two_trainer_fan_in_with_batched_sends():
+    """fan_in=2 with both trainers on SEND_BATCH: grads still sum
+    before the optimize program runs (sync-round semantics survive the
+    fused transport)."""
+    params = {"w": np.ones(4, np.float32)}
+    srv, ep = _server(params, fan_in=2)
+    g = [np.full(4, 1.0, np.float32), np.full(4, 3.0, np.float32)]
+    results = {}
+
+    def trainer(tid):
+        c = VariableClient(ep, client_id=f"t{tid}")
+        c.send_vars([("w@GRAD", g[tid])])
+        c.send_batch_barrier()
+        results[tid] = np.asarray(c.get_vars(["w"])[0])
+        c.close()
+
+    ts = [threading.Thread(target=trainer, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    srv.stop()
+    assert len(results) == 2, "a trainer hung in the fan-in round"
+    want = 1.0 - 0.1 * (g[0] + g[1])
+    for got in results.values():
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.perf
+def test_comm_bucketed_round_speedup_and_metrics():
+    """Acceptance microbench: 2 pservers x 64 small grads — the
+    bucketed+concurrent round must beat the per-var serial baseline by
+    >= 1.5x with byte-identical final params, and the round metrics
+    must land in a Prometheus dump."""
+    import bench
+    from paddle_tpu.observability import exporters
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    was = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)
+    try:
+        result = None
+        for _ in range(3):  # best-of walls inside; re-roll on a loaded
+            result = bench.run_comm_bench(n_grads=64, dim=16,  # CI host
+                                          rounds=4, pservers=2,
+                                          trials=2)
+            assert result["params_identical"]
+            if result["speedup"] >= 1.5:
+                break
+        assert result["speedup"] >= 1.5, result
+        text = exporters.prometheus_text()
+        for series in ("paddle_tpu_comm_round_seconds",
+                       "paddle_tpu_comm_round_bytes",
+                       "paddle_tpu_comm_bucket_vars"):
+            assert series in text, series
+    finally:
+        obs_metrics.set_enabled(was)

@@ -37,14 +37,16 @@ PADDLE_TPU_VERIFY=error python -m pytest \
 # flake — it fails identically on the pre-PR tree, unrelated to
 # verification)
 
-echo "== [4/4] observability subset with PADDLE_TPU_METRICS=on =="
+echo "== [4/4] observability + comm subset with PADDLE_TPU_METRICS=on =="
 # the instrumented hot paths must behave identically with the metric
-# instruments armed (docs/observability.md)
+# instruments armed (docs/observability.md); test_comm.py also pins the
+# bucketed wire path's backward compatibility both directions
 PADDLE_TPU_METRICS=on python -m pytest \
     tests/test_observability.py \
     tests/test_executor_cache.py \
     tests/test_serving.py \
     tests/test_pserver.py \
+    tests/test_comm.py \
     -q -m 'not slow' -p no:cacheprovider
 
 echo "ci_check: all green"
