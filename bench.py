@@ -9,20 +9,33 @@ ResNet-50 training number, 84.08 img/s (2x Xeon 6148, MKL-DNN, bs=256;
 BASELINE.md — the reference has no GPU ResNet-50 number in-tree).
 
 The JSON also carries the honesty block (VERDICT r1 #1/#2):
-  * tflops / mfu — achieved model FLOP/s vs chip bf16 peak;
-  * hbm_gb_per_step / hbm_util — XLA-counted HBM traffic and achieved
-    bandwidth vs the chip's HBM peak.  ResNet-50 bs256 is MEMORY-bound
-    on TPU (arithmetic intensity ~37 FLOP/byte vs the v5e ridge point of
-    ~240), so hbm_util ~1.0 means the chip is saturated even though mfu
-    sits near the ~0.16 roofline ceiling for this model+batch;
+  * tflops / mfu — achieved model FLOP/s vs chip bf16 peak, with the
+    per-step XLA cost analysis taken from the SINGLE-STEP optimized
+    module (harness.step_cost_analysis), not the whole scan program;
+  * hbm_gb_per_step — peak live HBM of the optimized step module
+    (memory_analysis: args + outputs + temps − donated aliases), a
+    number that must fit the chip; hbm_traffic_gb / hbm_util — the
+    XLA-counted traffic and achieved bandwidth vs the chip's HBM peak.
+    ResNet-50 bs256 is MEMORY-bound on TPU (arithmetic intensity ~37
+    FLOP/byte vs the v5e ridge point of ~240), so hbm_util ~1.0 means
+    the chip is saturated even though mfu sits near the ~0.16 roofline
+    ceiling for this model+batch;
+  * compile_seconds — XLA compile wall time of the measured executable
+    (the persistent compilation cache is pre-warmed across rounds:
+    BENCH_COMPILE_CACHE=0 opts out);
   * convergence — a timed CIFAR-10 ResNet-20 run in the SAME numeric
     config (amp bf16) trained to a fixed accuracy, so the measured mode
     is demonstrably one that learns (reference --job=time + book-test
     discipline).  BENCH_CONVERGENCE=0 skips it.
 
 Knobs: BENCH_BATCH, BENCH_ITERS, BENCH_DTYPE, BENCH_LAYOUT,
-BENCH_AMP=0 (pure-bf16 mode, reported as the secondary number in
-benchmark/README.md), BENCH_CONVERGENCE=0, BENCH_PREFETCH=N (input
+BENCH_REMAT=1 (rematerialized residual blocks), BENCH_MEMOPT=1 (arm
+the memory_optimize flag: feed-buffer donation + dead-var freeing in
+the executor legs), BENCH_STEP_ANALYSIS=0 (skip the single-step
+cost/memory analysis compile), BENCH_COMPILE_CACHE=0 (no persistent
+compile cache pre-warm), BENCH_AMP=0 (pure-bf16 mode, reported as the
+secondary number in benchmark/README.md), BENCH_CONVERGENCE=0,
+BENCH_PREFETCH=N (input
 pipeline microbench: serial vs prefetch-depth-N + lazy-fetch steps/s
 with the host-blocked fraction of each loop; BENCH_PREFETCH_ITERS
 steps), BENCH_COMM=1 (pserver comm microbench: per-var serial wire
@@ -37,6 +50,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "benchmark"))
+
+# compile-time budget: pre-warm JAX's persistent compilation cache
+# across bench rounds — round N+1 deserializes every executable round N
+# compiled (the book matrix alone was paying 15-85 s of XLA compile per
+# model per round).  Must happen BEFORE paddle_tpu imports read the env.
+# BENCH_COMPILE_CACHE=0 opts out; an explicit
+# PADDLE_TPU_COMPILATION_CACHE_DIR always wins.
+if (os.environ.get("BENCH_COMPILE_CACHE", "1").lower()
+        not in ("0", "false", "no", "off")):
+    os.environ.setdefault(
+        "PADDLE_TPU_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "xla_cache"))
 
 import numpy as np
 
@@ -55,9 +81,19 @@ AMP = os.environ.get("BENCH_AMP", "1").lower() in ("1", "true", "yes",
 # the vector lanes where C < 128, see benchmark/README.md)
 LAYOUT = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
 # BENCH_REMAT=1: rematerialize every residual block (jax.checkpoint) —
-# the bytes-for-FLOPs trade for this memory-bound model
-REMAT = os.environ.get("BENCH_REMAT", "0").lower() in ("1", "true",
-                                                       "yes", "on")
+# the bytes-for-FLOPs trade for this memory-bound model (defaults to
+# the framework `remat` flag, env PADDLE_TPU_REMAT)
+REMAT = os.environ.get(
+    "BENCH_REMAT",
+    os.environ.get("PADDLE_TPU_REMAT", "0")).lower() in ("1", "true",
+                                                         "yes", "on")
+# BENCH_MEMOPT=1 arms the memory_optimize flag for the convergence/book
+# legs (feed-buffer donation + dead-var freeing in the executors); the
+# scan-timed headline always runs the donation plan via the harness
+MEMOPT = os.environ.get(
+    "BENCH_MEMOPT",
+    os.environ.get("PADDLE_TPU_MEMORY_OPTIMIZE", "0")).lower() in (
+        "1", "true", "yes", "on")
 # ResNet-50 fwd at 224x224 is ~4.1 GMACs = ~8.2 GFLOPs (2*MACs — the MFU
 # convention); train ~= 3x fwd.  Cross-check: XLA's own cost analysis
 # counts 22.5 GFLOP/img for the whole train step
@@ -390,6 +426,9 @@ def main():
 
     if AMP:
         fluid.amp.enable_bf16()
+    if MEMOPT:
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"memory_optimize": True})
     main_p, startup, avg = build_resnet50_train(BATCH, DTYPE)
 
     r = np.random.RandomState(0)
@@ -405,9 +444,13 @@ def main():
     # (replay-immune scan instrument) + the roofline plausibility gate —
     # an implausible number is published as valid:false and exits 1,
     # never as a silent headline
+    step_analysis = os.environ.get(
+        "BENCH_STEP_ANALYSIS", "1").lower() not in ("0", "false", "no",
+                                                    "off")
     ms, cost, fields = gated_time_program(
         main_p, startup, feeds, avg.name, ITERS,
-        model_flops_per_step=RESNET50_TRAIN_FLOPS_PER_IMG * BATCH)
+        model_flops_per_step=RESNET50_TRAIN_FLOPS_PER_IMG * BATCH,
+        step_analysis=step_analysis)
     img_per_sec = BATCH / ms * 1000
     out = {
         "metric": "resnet50_train_images_per_sec",
@@ -418,6 +461,7 @@ def main():
         "amp": AMP,
         "layout": LAYOUT,
         "remat": REMAT,
+        "memory_optimize": MEMOPT,
         "ms_per_step": round(ms, 2),
     }
     out.update(fields)

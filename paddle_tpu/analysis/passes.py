@@ -710,6 +710,55 @@ def check_distributed(ctx):
 # ---------------------------------------------------------------------------
 
 
+@register_pass("donation-safety", order=75)
+def check_donation_safety(ctx):
+    """Vars hinted `donate=True` (layers.data(donate=True)) hand their
+    device buffer to the jitted step for reuse — which is only legal
+    when the buffer is provably dead once the step returns.  A donated
+    fetch target (the caller reads that buffer after the call) or a
+    read-only persistable (the next step reads it again) is flagged as
+    an error HERE, at build time; the executors enforce the same plan
+    via memory_optimization_transpiler.plan_donation and raise
+    DonationError before tracing (docs/performance.md 'Memory')."""
+    consumed: Set[str] = set()
+    for _, _, op in ctx.iter_ops():
+        consumed.update(op.input_names())
+    for block in ctx.program.blocks:
+        for name, v in block.vars.items():
+            if not getattr(v, "donate", False):
+                continue
+            if isinstance(v, Parameter) or v.persistable:
+                yield ctx.diag(
+                    "error",
+                    f"var {name!r} is hinted donate=True but is "
+                    "persistable state — donating it would hand the "
+                    "next step a deleted buffer",
+                    block,
+                    hint="drop the donate hint; read-write state is "
+                         "already donated by the executor's plan",
+                )
+                continue
+            if ctx.fetch_names and name in ctx.fetch_names:
+                yield ctx.diag(
+                    "error",
+                    f"var {name!r} is hinted donate=True but is a fetch "
+                    "target — the caller reads this buffer after the "
+                    "step returns",
+                    block,
+                    hint="remove it from fetch_list, or drop the "
+                         "donate hint",
+                )
+                continue
+            if name not in consumed:
+                yield ctx.diag(
+                    "warning",
+                    f"var {name!r} is hinted donate=True but no op "
+                    "consumes it — the donation cannot be fulfilled",
+                    block,
+                    hint="feed the var to an op or drop the hint",
+                )
+
+
 @register_pass("inplace-alias", order=80)
 def check_inplace_alias(ctx):
     """An op that binds the SAME var name as input and output mutates the

@@ -158,21 +158,48 @@ def global_scope() -> Scope:
 # ---------------------------------------------------------------------------
 
 
-def _to_device_value(v, device):
-    """Feed value -> device arrays (LoDTensor wrapper preserved)."""
+def _place_feed(v, device):
+    """Feed value -> (device value, fresh): `fresh` is True when the
+    executor just created the device buffer from host data, i.e. no
+    caller-held reference can alias it — the ownership precondition for
+    donating the buffer to the jitted step.  A value that arrives as a
+    jax array may BE the caller's buffer (device_put to the same device
+    is a no-op returning it), so it is never marked fresh."""
     if isinstance(v, LoDTensor):
-        return LoDTensor(jax.device_put(np.asarray(v.data), device), v.lod)
+        return LoDTensor(jax.device_put(np.asarray(v.data), device),
+                         v.lod), True
     if isinstance(v, jnp.ndarray):
         # already a jax array: placing it directly avoids a device->host
         # round-trip and keeps a weak dtype weak (np.asarray would do both)
-        return jax.device_put(v, device)
+        return jax.device_put(v, device), False
     if isinstance(v, (int, float, bool)) and not isinstance(v, np.generic):
         # same weak-typing rule as _commit below: a Python scalar fed to
         # a bf16 program must not arrive as a strong f32/i64 array
-        return jax.device_put(v, device)
+        return jax.device_put(v, device), True
     if isinstance(v, (np.ndarray, jnp.ndarray, np.generic)):
-        return jax.device_put(np.asarray(v), device)
-    return v  # opaque host object
+        return jax.device_put(np.asarray(v), device), True
+    return v, False  # opaque host object
+
+
+def _to_device_value(v, device):
+    """Feed value -> device arrays (LoDTensor wrapper preserved)."""
+    return _place_feed(v, device)[0]
+
+
+# caps for the liveness-artifact caches: a long-lived executor serving
+# many programs (or one whose version keeps bumping — every mutation is
+# a fresh fingerprint) must not accumulate plans, and especially not
+# full program clones, without bound
+_MEMOPT_CACHE_CAP = 16
+_PLAN_CACHE_CAP = 256
+
+
+def _bounded_put(cache: dict, key, value, cap: int):
+    """FIFO-evicting insert: dicts iterate in insertion order, so the
+    oldest entry goes first once `cap` is reached."""
+    while len(cache) >= cap:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
 
 
 def _to_numpy(v):
@@ -319,6 +346,13 @@ class Executor:
         # program GC, and a recycled id could serve the WRONG fingerprint
         self._fp_cache: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()  # program -> (version, fp)
+        # liveness artifacts, cached per (fingerprint, context): the
+        # donation plan feeding donate_argnums, the dead-var free plan
+        # the interpreter/segmented paths apply between ops, and the
+        # memory-optimized program clones (rename pass)
+        self._donation_plans: Dict = {}
+        self._free_plans: Dict = {}
+        self._memopt_cache: Dict = {}
         self._exe_id = str(next(_EXE_IDS))
         self._m_hits = _M_LOOKUPS.labels(exe=self._exe_id, result="hit")
         self._m_misses = _M_LOOKUPS.labels(exe=self._exe_id,
@@ -399,8 +433,17 @@ class Executor:
                   fetch_names=fetch_names)
         block = program.global_block()
 
-        if compiled is None and not self._has_host_ops(block):
-            compiled = True
+        # jit granularity (flag, docs/performance.md): 'block' = default
+        # whole-block executables; 'segment' = the segment cache even for
+        # pure-device programs; 'op' = the eager interpreter whose tiny
+        # per-op kernels are cached by jax ACROSS programs — the coarse
+        # compile-time escape hatch.  An explicit `compiled` arg wins.
+        gran = str(get_flag("jit_granularity") or "block").lower()
+        if compiled is None:
+            if gran == "op":
+                compiled = False
+            elif not self._has_host_ops(block):
+                compiled = True
         step_key = jax.random.fold_in(
             jax.random.key(program.seed or self._seed), self._step
         )
@@ -409,7 +452,9 @@ class Executor:
         if compiled:
             # host ops can't be jit-traced: "compiled" with host ops
             # means compile the maximal device segments between them
-            mode = "segmented" if self._has_host_ops(block) else "compiled"
+            mode = ("segmented"
+                    if self._has_host_ops(block) or gran == "segment"
+                    else "compiled")
         elif compiled is None:
             # host ops present (else compiled was defaulted True above):
             # compile maximal device segments, interpret host ops
@@ -417,6 +462,24 @@ class Executor:
             mode = "segmented"
         else:
             mode = "interpreted"
+        if mode != "compiled" and any(
+                getattr(block.vars.get(n), "donate", False) for n in feed):
+            # the donate=True build-time guarantee holds on EVERY path:
+            # the interpreter/segmented modes cannot fulfill a donation
+            # (no jitted step), but an unsafe hint must still fail here
+            # — not later, when the same program first hits the
+            # compiled path in production
+            self._donation_plan(program, feed.keys(), fetch_names, ())
+        if get_flag("memory_optimize") and mode != "compiled":
+            # liveness rename pass (buffer reuse on the interpreter
+            # paths) applied to a cached CLONE keyed by (program, feed,
+            # fetch): the caller's program is never mutated, and a later
+            # run with a different fetch list gets its own clone with
+            # THOSE names protected — fetch values can never be
+            # silently clobbered by a rename from an earlier call
+            program = self._memopt_program(program, feed.keys(),
+                                           fetch_names)
+            block = program.global_block()
         t0 = time.perf_counter()
         with obs_tracing.span("executor.run", mode=mode):
             if mode == "segmented":
@@ -456,6 +519,59 @@ class Executor:
             fam.remove(exe=self._exe_id)
         for mode in ("interpreted", "segmented", "compiled"):
             _M_RUN_SECONDS.remove(exe=self._exe_id, mode=mode)
+
+    # -- memory optimization (flag `memory_optimize`) ------------------------
+    def _memopt_program(self, program, feed_names, fetch_names):
+        """Memory-optimized clone of `program` for one (feed, fetch)
+        config, cached: the liveness rename pass runs with the live
+        feed/fetch lists auto-skipped, on a deep copy — the user's
+        program stays untouched."""
+        key = (self._fingerprint(program), tuple(sorted(feed_names)),
+               tuple(fetch_names))
+        clone = self._memopt_cache.get(key)
+        if clone is None:
+            from ..memory_optimization_transpiler import memory_optimize
+
+            clone = program.clone()
+            memory_optimize(clone,
+                            skip_vars=list(feed_names)
+                            + list(fetch_names))
+            _bounded_put(self._memopt_cache, key, clone,
+                         cap=_MEMOPT_CACHE_CAP)
+        return clone
+
+    def _free_plan(self, program, fetch_names):
+        """Cached {op index -> dead names} for the interpreter/segmented
+        paths (memory_optimization_transpiler.plan_dead_frees)."""
+        key = (self._fingerprint(program), tuple(fetch_names))
+        plan = self._free_plans.get(key)
+        if plan is None:
+            from ..memory_optimization_transpiler import plan_dead_frees
+
+            plan = plan_dead_frees(program, fetch_names)
+            _bounded_put(self._free_plans, key, plan,
+                         cap=_PLAN_CACHE_CAP)
+        return plan
+
+    def _donation_plan(self, program, feed_names, fetch_names, rw_names):
+        """Cached liveness donation plan for one (program, feeds, fetch,
+        states) config; raises DonationError for unsafe explicit
+        `donate` hints (build time — before any tracing)."""
+        key = (self._fingerprint(program), tuple(sorted(feed_names)),
+               tuple(fetch_names), tuple(sorted(rw_names)))
+        plan = self._donation_plans.get(key)
+        if plan is None:
+            from ..memory_optimization_transpiler import plan_donation
+
+            block = program.global_block()
+            hinted = [n for n in feed_names
+                      if n in block.vars
+                      and getattr(block.vars[n], "donate", False)]
+            plan = plan_donation(program, feed_names, fetch_names,
+                                 state_rw_names=rw_names, requested=hinted)
+            _bounded_put(self._donation_plans, key, plan,
+                         cap=_PLAN_CACHE_CAP)
+        return plan.check()
 
     # -- interpreter ---------------------------------------------------------
     def _has_host_ops(self, block) -> bool:
@@ -502,14 +618,22 @@ class Executor:
     def _run_interpreted(self, program, block, scope, feed, fetch_names, key):
         device = self.place.jax_device()
         local = scope.new_scope()
+        # dead-var freeing (memory_optimize flag): drop the local-scope
+        # reference of every var right after its liveness-proven last
+        # use, so footprint tracks LIVE values, not program size
+        frees = (self._free_plan(program, fetch_names)
+                 if get_flag("memory_optimize") else None)
         try:  # finally: a raising op must not leak the local scope
             env = self._scope_env(program, scope, local)
             with jax.default_device(device):
                 for name, v in feed.items():
                     env.set(name, _to_device_value(v, device))
                 ctx = ExecContext(key, scope=local, executor=self)
-                for op in block.ops:
+                for i, op in enumerate(block.ops):
                     _run_op_instrumented(ctx, op, env)
+                    if frees:
+                        for n in frees.get(i, ()):
+                            local.erase(n)
                 outs = self._fetch(env, fetch_names)
         finally:
             scope.kids.remove(local)
@@ -549,6 +673,11 @@ class Executor:
         is identical across interpreted/compiled/segmented modes."""
         device = self.place.jax_device()
         local = scope.new_scope()
+        # dead-var freeing at segment granularity (memory_optimize flag):
+        # names whose last use falls inside a segment are dropped from
+        # the local scope once that segment completes
+        frees = (self._free_plan(program, fetch_names)
+                 if get_flag("memory_optimize") else None)
         try:  # finally: a raising op must not leak the local scope
             env = self._scope_env(program, scope, local)
             fp = self._fingerprint(program)
@@ -557,14 +686,20 @@ class Executor:
                     env.set(name, _to_device_value(v, device))
                 ctx = ExecContext(key, scope=local, executor=self)
                 once = set()  # one recompile count per run, not per seg
+                op_idx = 0
                 for seg_idx, (is_host, ops) in enumerate(
                         self._segments(block)):
                     if is_host:
                         for op in ops:
                             _run_op_instrumented(ctx, op, env)
-                        continue
-                    self._run_segment_compiled(fp, seg_idx, ops, env, key,
-                                               device, once)
+                    else:
+                        self._run_segment_compiled(fp, seg_idx, ops, env,
+                                                   key, device, once)
+                    if frees:
+                        for i in range(op_idx, op_idx + len(ops)):
+                            for n in frees.get(i, ()):
+                                local.erase(n)
+                    op_idx += len(ops)
                 outs = self._fetch(env, fetch_names)
         finally:
             scope.kids.remove(local)
@@ -586,6 +721,7 @@ class Executor:
             fp, "seg", seg_idx,
             tuple((n, _aval_key(v)) for n, v in sorted(in_vals.items())),
             get_flag("amp_bf16"),  # amp changes traced compute dtypes
+            get_flag("conv_layout"),  # changes the traced conv layout
             get_flag("flash_min_seq_k"),  # changes the traced attn path
             get_flag("flash_pack_heads"),  # changes the traced kernel
             get_flag("flash_block_q"), get_flag("flash_block_k"),
@@ -654,14 +790,33 @@ class Executor:
 
     def _run_compiled(self, program, block, scope, feed, fetch_names, key):
         device = self.place.jax_device()
-        feed_vals = {
-            n: _to_device_value(v, device) for n, v in feed.items()
-        }
+        feed_vals, fresh = {}, set()
+        for n, v in feed.items():
+            feed_vals[n], is_fresh = _place_feed(v, device)
+            if is_fresh:
+                fresh.add(n)
         state_in_names, state_out_names = self._analyze_states(
             program, block, feed_vals.keys()
         )
         ro_names = [n for n in state_in_names if n not in state_out_names]
         rw_names = [n for n in state_in_names if n in state_out_names]
+
+        # liveness donation plan (memory_optimization_transpiler): which
+        # buffers die inside this step.  Read-write states are always
+        # donated (the in-place param update); feed buffers are donated
+        # under the memory_optimize flag or an explicit per-var `donate`
+        # hint — but only when the executor itself created the device
+        # buffer (`fresh`), so a caller-held array is never invalidated.
+        # Unsafe explicit hints raise DonationError here, at build time.
+        plan = self._donation_plan(program, feed_vals.keys(), fetch_names,
+                                   rw_names)
+        donate_all_feeds = get_flag("memory_optimize")
+        hinted = {n for n in plan.feeds
+                  if n in block.vars
+                  and getattr(block.vars[n], "donate", False)}
+        don_names = tuple(sorted(
+            n for n in (plan.feeds if donate_all_feeds else hinted)
+            if n in fresh))
 
         def get_state(n):
             if not scope.has_var(n) or scope.find_var(n) is None:
@@ -681,7 +836,9 @@ class Executor:
             tuple((n, _aval_key(v)) for n, v in rw.items()),
             tuple(fetch_names),
             str(device),
+            don_names,  # donation is baked into the executable
             get_flag("amp_bf16"),  # amp changes traced compute dtypes
+            get_flag("conv_layout"),  # changes the traced conv layout
             get_flag("flash_min_seq_k"),  # changes the traced attn path
             get_flag("flash_pack_heads"),  # changes the traced kernel
             get_flag("flash_block_q"), get_flag("flash_block_k"),
@@ -694,15 +851,18 @@ class Executor:
                 block, fetch_names, state_out_names, repl
             )
             self._cache[cache_key] = fn
+        don_feeds = {n: feed_vals[n] for n in don_names}
+        keep_feeds = {n: v for n, v in feed_vals.items()
+                      if n not in don_feeds}
         from paddle_tpu import profiler
 
         t0 = time.perf_counter() if miss else None
         if profiler.is_enabled():
             with profiler.record_event("xla_block"):
-                fetches, state_out = fn(feed_vals, ro, rw, key)
+                fetches, state_out = fn(don_feeds, keep_feeds, ro, rw, key)
                 jax.block_until_ready((fetches, state_out))
         else:
-            fetches, state_out = fn(feed_vals, ro, rw, key)
+            fetches, state_out = fn(don_feeds, keep_feeds, ro, rw, key)
         if miss:
             self._m_compile_s.inc(time.perf_counter() - t0)
             self._m_entries.set(len(self._cache))
@@ -712,8 +872,8 @@ class Executor:
 
     def _build_compiled_fn(self, block, fetch_names, state_out_names,
                            repl=None):
-        def fn(feeds, ro, rw, rng_key):
-            env = DictEnv({**ro, **rw, **feeds})
+        def fn(don_feeds, keep_feeds, ro, rw, rng_key):
+            env = DictEnv({**ro, **rw, **keep_feeds, **don_feeds})
             ctx = ExecContext(rng_key, executor=self, compiled=True)
             for op in block.ops:
                 run_op(ctx, op, env)
@@ -725,15 +885,18 @@ class Executor:
             }
             return fetches, state_out
 
-        # donate read-write state buffers: in-place param updates on device
+        # donation plan (core/executor._run_compiled): arg 0 carries the
+        # liveness-dead feed buffers, arg 3 the read-write states whose
+        # old values die with the in-place update — XLA reuses both HBM
+        # regions for intermediates/outputs
         if repl is not None:
             # a parallel_do op constrains values to a multi-device mesh:
             # land every input replicated on that device set so the
             # partitioner may shard the annotated subgraph (single-device
             # committed args would conflict with the mesh)
-            return jax.jit(fn, donate_argnums=(2,),
-                           in_shardings=(repl, repl, repl, repl))
-        return jax.jit(fn, donate_argnums=(2,))
+            return jax.jit(fn, donate_argnums=(0, 3),
+                           in_shardings=(repl, repl, repl, repl, repl))
+        return jax.jit(fn, donate_argnums=(0, 3))
 
 
 def program_to_fn(program: Program, feed_names, fetch_names, block_idx=0):
@@ -761,6 +924,14 @@ def program_to_fn(program: Program, feed_names, fetch_names, block_idx=0):
 
     fn.state_in_names = state_in
     fn.state_out_names = state_out
+    # liveness donation plan for callers that jit this fn themselves
+    # (benchmark/harness.py, parallel.ParallelExecutor): which feed
+    # buffers die inside the step, and therefore may ride donate_argnums
+    from ..memory_optimization_transpiler import plan_donation
+
+    rw = [n for n in state_in if n in state_out]
+    fn.donation_plan = plan_donation(program, feed_names, fetch_names,
+                                     state_rw_names=rw).check()
     return fn
 
 

@@ -148,6 +148,47 @@ define_flag("comm_bucket_bytes", 4 << 20,
             "fallback against a server that predates the batch "
             "verbs).  An oversized var still ships, alone in its "
             "bucket")
+define_flag("memory_optimize", False,
+            "whole-program memory optimization "
+            "(memory_optimization_transpiler + docs/performance.md "
+            "'Memory'): the Executor derives a liveness-backed donation "
+            "plan and donates every feed buffer whose last use is "
+            "inside the jitted step (read-write state donation is "
+            "always on), frees dead local-scope vars between ops/"
+            "segments on the interpreter paths, and applies the "
+            "liveness rename pass (buffer reuse) to interpreted/"
+            "segmented programs, auto-skipping the current feed and "
+            "fetch lists.  The rename runs on a cached clone — the "
+            "caller's Program is never mutated — and re-keys per-op "
+            "PRNG streams of renamed temporaries: same distribution, "
+            "different draws than the unrenamed program")
+define_flag("remat", False,
+            "default rematerialization for model builders that accept "
+            "remat=None (models.resnet, models.transformer): wrap each "
+            "residual/attention block in layers.recompute "
+            "(jax.checkpoint) so block-internal activations re-run in "
+            "backward instead of living in HBM — the bytes-for-FLOPs "
+            "trade of Chen et al. (sublinear memory cost).  Read at "
+            "BUILD time (program construction), not trace time")
+define_flag("conv_layout", "",
+            "opt-in conv layout override, read at TRACE time: 'NHWC' "
+            "runs every NCHW-declared conv2d channels-last inside the "
+            "lowering (transpose in, NHWC conv, transpose out — XLA "
+            "cancels adjacent pairs between consecutive convs), the "
+            "TPU's native vector-lane layout.  '' (default) keeps each "
+            "op's declared data_format.  Executor cache keys include "
+            "it like amp_bf16; combine with amp_bf16 for the "
+            "bf16-native NHWC path")
+define_flag("jit_granularity", "block",
+            "how much program one executable covers: 'block' (default) "
+            "traces whole block 0 into one XLA program; 'segment' "
+            "compiles maximal device segments (the mode host ops "
+            "already force) even for pure-device programs; 'op' runs "
+            "the eager interpreter — each jax op compiles tiny "
+            "kernels cached ACROSS programs, the coarse-compile "
+            "escape hatch when whole-program XLA compile time "
+            "dominates short runs (docs/performance.md).  An explicit "
+            "Executor.run(compiled=...) argument overrides it")
 define_flag("flash_pack_heads", True,
             "fold head PAIRS into the 128-lane dim inside the flash "
             "kernel when head_dim == 64 (and the head count is even): "

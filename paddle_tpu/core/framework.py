@@ -131,6 +131,7 @@ class Variable:
         stop_gradient: bool = False,
         type: str = VarType.LOD_TENSOR,
         initializer=None,
+        donate: bool = False,
     ):
         self.block = block
         self.name = name
@@ -141,6 +142,11 @@ class Variable:
         self.stop_gradient = stop_gradient
         self.type = type
         self.initializer = initializer
+        # donation hint: the executor may hand this feed's device buffer
+        # to XLA as a donated input (memory_optimization_transpiler
+        # .plan_donation validates the hint at build time; the
+        # donation-safety analysis pass lints it)
+        self.donate = bool(donate)
         # op that produced this var most recently (set by append_op)
         self.op: Optional["Operator"] = None
 
@@ -193,6 +199,7 @@ class Variable:
             "type": self.type,
             "is_parameter": isinstance(self, Parameter),
             "trainable": getattr(self, "trainable", None),
+            "donate": self.donate,
         }
 
     def __repr__(self):
@@ -539,6 +546,7 @@ class Program:
                     persistable=vd.get("persistable", False),
                     stop_gradient=vd.get("stop_gradient", False),
                     type=vd.get("type", VarType.LOD_TENSOR),
+                    donate=vd.get("donate", False),
                 )
                 if vd.get("is_parameter"):
                     kw.pop("persistable")

@@ -13,9 +13,16 @@ __all__ = ["data", "ListenAndServ", "Send", "Recv"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
-         main_program=None, stop_gradient=True, type=None):
+         main_program=None, stop_gradient=True, type=None, donate=False):
     """Declare a feed variable.  `append_batch_size=True` prepends -1,
-    matching reference layers/io.py:data."""
+    matching reference layers/io.py:data.
+
+    `donate=True` marks the feed's device buffer as donatable to the
+    jitted step (its HBM is reused for intermediates).  The hint is
+    validated at build time: donating a buffer the caller still needs —
+    e.g. a fetch target — raises `DonationError` before any tracing
+    (memory_optimization_transpiler.plan_donation; the donation-safety
+    analysis pass lints the same invariant)."""
     prog = main_program or default_main_program()
     shape = list(shape)
     if append_batch_size:
@@ -25,7 +32,7 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         kw["type"] = type
     v = prog.global_block().create_var(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
-        stop_gradient=stop_gradient, **kw)
+        stop_gradient=stop_gradient, donate=donate, **kw)
     # mirror the var desc into the startup program for symmetry
     default_startup_program()
     return v

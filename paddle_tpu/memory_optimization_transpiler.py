@@ -1,27 +1,48 @@
-"""Memory-optimization transpiler: liveness-based variable reuse.
+"""Memory-optimization transpiler: the whole-program memory layer.
 
 Mirror of the reference's
 /root/reference/python/paddle/v2/fluid/memory_optimization_transpiler.py
-(ControlFlowGraph :33, dataflow analysis :90): walk the program, compute
-per-op live sets, and rename each newly-defined temporary onto a dead
-variable of identical shape+dtype, so consecutive ops reuse buffers
-instead of growing the scope.
+(ControlFlowGraph :33, dataflow analysis :90), grown from a standalone
+rename pass into the planning layer both executors consume:
 
-TPU-native framing: for XLA-compiled blocks buffer reuse already happens
-inside the compiler, so the win here is the op-by-op CPU interpreter path
-(debugging, host-side programs) and the scope footprint between runs —
-a renamed-over var is overwritten in the interpreter env, dropping the
-old buffer's last reference.  Semantics are unchanged either way; this is
-the rebuild's analogue of the reference's "memory_optimize then train"
-book tests (tests/book_memory_optimization/).
+  * `memory_optimize` — the classic liveness-based RENAME pass: walk the
+    program, compute per-op live sets, and rename each newly-defined
+    temporary onto a dead variable of identical shape+dtype, so
+    consecutive ops reuse buffers instead of growing the scope (the
+    interpreter-path win; XLA does this internally for compiled blocks).
+  * `plan_donation` — the liveness-backed DONATION plan for the jitted
+    step: every feed/state buffer whose last use is inside the step is
+    safe to hand to XLA as a donated input (its HBM is reused for
+    intermediates / the updated state), and every unsafe request —
+    a fetched var, a read-only state — is rejected AT BUILD TIME with a
+    `DonationError` instead of crashing or corrupting at runtime.
+    Consumed by `core.executor.Executor._run_compiled` and
+    `parallel.executor.ParallelExecutor` (which previously hardcoded a
+    single donated slot), and linted by the `donation-safety` analysis
+    pass (docs/analysis.md).
+  * `plan_dead_frees` — per-op-index lists of names whose last use has
+    passed, so the interpreter/segmented executor drops scope references
+    mid-run and the footprint stops growing with program size.
+
+Rematerialization-for-memory (the `remat` flag + `layers.recompute`)
+follows Chen et al., *Training Deep Nets with Sublinear Memory Cost*;
+see docs/performance.md ("Memory").
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 from .core.framework import Parameter, Program
 
-__all__ = ["ControlFlowGraph", "memory_optimize"]
+__all__ = ["ControlFlowGraph", "memory_optimize", "DonationPlan",
+           "DonationError", "plan_donation", "plan_dead_frees"]
+
+
+class DonationError(ValueError):
+    """A requested buffer donation is provably unsafe (the buffer is
+    needed after the jitted step).  Raised at plan/build time — before
+    any tracing or dispatch — so the failure names the variable and the
+    reason instead of surfacing as a deleted-buffer crash mid-train."""
 
 
 class ControlFlowGraph:
@@ -52,10 +73,20 @@ class ControlFlowGraph:
             self.live_in[i] = self.uses[i] | (self.live_out[i]
                                               - self.defs[i])
 
+    def last_touch(self) -> Dict[str, int]:
+        """name -> index of the op that last reads OR writes it; past
+        that index the name's buffer is finished."""
+        last: Dict[str, int] = {}
+        for i in range(len(self.ops)):
+            for name in self.uses[i] | self.defs[i]:
+                last[name] = i
+        return last
+
 
 def _sub_block_names(program: Program) -> Set[str]:
     """All names referenced anywhere in non-global blocks: sub-blocks
-    resolve names against the parent scope, so renaming them is unsafe."""
+    resolve names against the parent scope, so renaming/freeing them
+    out from under a sub-block is unsafe."""
     names: Set[str] = set()
     for block in program.blocks[1:]:
         names.update(block.vars.keys())
@@ -67,38 +98,179 @@ def _sub_block_names(program: Program) -> Set[str]:
     return names
 
 
+def _normalize_names(vars_or_names) -> List[str]:
+    """Uniform skip/fetch list handling: accepts a bare name, a bare
+    Variable, or any mix of both inside an iterable."""
+    if vars_or_names is None:
+        return []
+    if isinstance(vars_or_names, str) or not hasattr(vars_or_names,
+                                                     "__iter__"):
+        vars_or_names = [vars_or_names]  # bare name/Variable
+    return [v if isinstance(v, str) else v.name for v in vars_or_names]
+
+
+# ---------------------------------------------------------------------------
+# donation planning
+# ---------------------------------------------------------------------------
+
+
+class DonationPlan:
+    """Result of `plan_donation`: which buffers of one jitted step may be
+    handed to XLA with `donate_argnums` semantics.
+
+    `feeds`  — feed names whose last use is inside the step (not fetched,
+               actually consumed): their device buffers are dead once the
+               executable returns, so XLA may reuse the HBM.
+    `states` — read-write persistable names: the step returns the NEW
+               value, so the OLD buffer is dead (the in-place parameter
+               update the reference gets via Param->ParamOut aliasing).
+    `rejected` — {name: reason} for every REQUESTED donation that is
+               provably unsafe; `check()` raises DonationError on any.
+    """
+
+    def __init__(self, feeds: Iterable[str], states: Iterable[str],
+                 rejected: Optional[Dict[str, str]] = None):
+        self.feeds = frozenset(feeds)
+        self.states = frozenset(states)
+        self.rejected = dict(rejected or {})
+
+    def check(self):
+        """Raise DonationError if any explicitly requested donation was
+        rejected (build-time failure, never a runtime crash)."""
+        if self.rejected:
+            detail = "; ".join(f"{n!r}: {r}"
+                               for n, r in sorted(self.rejected.items()))
+            raise DonationError(
+                f"unsafe buffer donation(s) rejected at build time — "
+                f"{detail}.  Remove the donate hint, or stop using the "
+                "buffer after the step (drop it from fetch_list)")
+        return self
+
+    def __repr__(self):
+        return (f"DonationPlan(feeds={sorted(self.feeds)}, "
+                f"states={sorted(self.states)}, "
+                f"rejected={self.rejected})")
+
+
+def plan_donation(program: Program,
+                  feed_names: Iterable[str],
+                  fetch_names: Iterable[str] = (),
+                  state_rw_names: Iterable[str] = (),
+                  requested: Iterable[str] = ()) -> DonationPlan:
+    """Derive the per-program donation plan from liveness.
+
+    A buffer is donatable when its last use is inside the jitted step:
+      * a feed var that some op consumes and that is NOT a fetch target
+        (a fetched feed must survive the call — its buffer is the
+        return value the caller reads);
+      * a read-write state (`state_rw_names`, from
+        `Executor._analyze_states`): the executable returns the updated
+        value, so the pre-step buffer dies with the call.
+
+    `requested` names (explicit `donate=True` hints on variables) are
+    validated strictly: a request for a fetched var, a read-only
+    persistable, a Parameter that is never rewritten, or a var the
+    program never consumes lands in `plan.rejected` — call
+    `plan.check()` to turn that into a build-time DonationError.
+    """
+    feed_names = set(_normalize_names(feed_names))
+    fetch_names = set(_normalize_names(fetch_names))
+    state_rw = set(_normalize_names(state_rw_names))
+    requested = _normalize_names(requested)
+
+    block = program.global_block()
+    consumed: Set[str] = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            for ns in op.inputs.values():
+                consumed.update(ns)
+
+    feeds = {n for n in feed_names
+             if n in consumed and n not in fetch_names}
+    states = set(state_rw)  # old buffer dead once the new value returns
+
+    rejected: Dict[str, str] = {}
+    for n in requested:
+        if n in feeds or n in states:
+            continue
+        if n in fetch_names:
+            rejected[n] = ("fetched after the step — the caller reads "
+                           "this buffer once the executable returns")
+            continue
+        v = block.vars.get(n)
+        if v is not None and (isinstance(v, Parameter)
+                              or getattr(v, "persistable", False)):
+            rejected[n] = ("read-only persistable state — the next step "
+                           "reads the same buffer again")
+            continue
+        if n not in consumed:
+            rejected[n] = ("never consumed by the program — the "
+                           "donation could not be fulfilled")
+            continue
+        rejected[n] = "not provably dead inside the step"
+    return DonationPlan(feeds, states, rejected)
+
+
+# ---------------------------------------------------------------------------
+# dead-variable freeing
+# ---------------------------------------------------------------------------
+
+
+def plan_dead_frees(program: Program,
+                    fetch_names: Iterable[str] = ()) -> Dict[int, List[str]]:
+    """{op index -> [names]} safe to drop from the local scope right
+    after that op runs: the liveness pass proves nothing later reads
+    them.  Protected: fetch targets (read after the last op),
+    persistables/Parameters (scope-carried state), and any name
+    referenced from a sub-block (resolved dynamically against the
+    parent scope).  Consumed by the interpreter and segmented executor
+    paths so scope footprint tracks LIVE values, not program size."""
+    block = program.global_block()
+    fetch = set(_normalize_names(fetch_names))
+    protected = fetch | _sub_block_names(program)
+    for v in program.list_vars():
+        if v.persistable or isinstance(v, Parameter):
+            protected.add(v.name)
+
+    cfg = ControlFlowGraph(block.ops)
+    frees: Dict[int, List[str]] = {}
+    for name, idx in cfg.last_touch().items():
+        if name and name not in protected:
+            frees.setdefault(idx, []).append(name)
+    return frees
+
+
+# ---------------------------------------------------------------------------
+# liveness-based rename (buffer reuse for the interpreter path)
+# ---------------------------------------------------------------------------
+
+
 def memory_optimize(program: Program,
                     skip_vars: Optional[Sequence] = None,
                     level: int = 0) -> int:
     """Rewrite `program` in place so dead temporaries are reused; returns
     the number of variables eliminated.
 
-    skip_vars: names (or Variables) never to optimize — pass everything
-    you intend to fetch after the final op (same contract as the
-    reference: fetch targets must survive to the end of the run).
+    skip_vars: names or Variables (any mix) never to optimize — pass
+    everything you intend to fetch after the final op (same contract as
+    the reference: fetch targets must survive to the end of the run).
+    When the executor invokes this pass itself (`memory_optimize` flag),
+    it passes the current feed and fetch lists automatically.
     level=0 requires exact shape+dtype match for reuse (reference
     memory_optimization_transpiler.py level semantics).
     """
     del level  # only exact-match (level 0) reuse is implemented
     block = program.global_block()
-    if isinstance(skip_vars, str) or not hasattr(skip_vars or [],
-                                                 "__iter__"):
-        skip_vars = [skip_vars]  # a bare name/Variable, not a collection
-    skip: Set[str] = set()
-    for v in skip_vars or []:
-        skip.add(v if isinstance(v, str) else v.name)
+    skip: Set[str] = set(_normalize_names(skip_vars))
     skip |= _sub_block_names(program)
 
     cfg = ControlFlowGraph(block.ops)
-    n = len(cfg.ops)
 
     # a name's buffer is finished once past its last def AND last use
-    last_touch: Dict[str, int] = {}
+    last_touch = cfg.last_touch()
     defined: Set[str] = set()
-    for i in range(n):
-        for name in cfg.uses[i] | cfg.defs[i]:
-            last_touch[name] = i
-        defined |= cfg.defs[i]
+    for d in cfg.defs:
+        defined |= d
 
     def eligible(name: str) -> bool:
         if name in skip or name not in defined or not block.has_var(name):

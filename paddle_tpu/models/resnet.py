@@ -9,8 +9,18 @@ residual blocks; XLA fuses BN+ReLU into the conv epilogues on TPU.
 from __future__ import annotations
 
 from .. import layers
+from ..core.flags import get_flag
 
 __all__ = ["resnet_imagenet", "resnet_cifar10"]
+
+
+def _default_remat(remat):
+    """remat=None defers to the `remat` flag (PADDLE_TPU_REMAT): the
+    build-time knob that wraps every residual block in layers.recompute
+    (jax.checkpoint) — activations re-run in backward instead of living
+    in HBM (Chen et al., sublinear memory cost; docs/performance.md
+    'Memory')."""
+    return bool(get_flag("remat")) if remat is None else bool(remat)
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
@@ -80,13 +90,14 @@ def _layer_warp(block_func, input, ch_in, ch_out, count, stride,
 
 
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
-                    data_format="NCHW", remat=False):
+                    data_format="NCHW", remat=None):
     """ResNet-50/101/152 (bottleneck) for 224x224 input; data_format
     "NHWC" runs channels-last — the TPU-native conv layout.  `remat=True`
     wraps every residual block in layers.recompute (jax.checkpoint):
     block-internal activations are recomputed in backward — the HBM
     lever for this memory-bound model (benchmark/README.md bytes
     analysis; BENCH_REMAT=1 measures it)."""
+    remat = _default_remat(remat)
     cfg = {
         50: ([3, 4, 6, 3], bottleneck),
         101: ([3, 4, 23, 3], bottleneck),
@@ -113,13 +124,19 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
     return layers.fc(input=pool2, size=class_dim, act="softmax")
 
 
-def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
-    """CIFAR ResNet (basicblock), depth = 6n+2 (reference book model)."""
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False,
+                   remat=None):
+    """CIFAR ResNet (basicblock), depth = 6n+2 (reference book model).
+    `remat` as in resnet_imagenet (None = the `remat` flag)."""
     assert (depth - 2) % 6 == 0
+    remat = _default_remat(remat)
     n = (depth - 2) // 6
     conv1 = conv_bn_layer(input, 16, 3, 1, 1, is_test=is_test)
-    res1 = _layer_warp(basicblock, conv1, 16, 16, n, 1, is_test)
-    res2 = _layer_warp(basicblock, res1, 16, 32, n, 2, is_test)
-    res3 = _layer_warp(basicblock, res2, 32, 64, n, 2, is_test)
+    res1 = _layer_warp(basicblock, conv1, 16, 16, n, 1, is_test,
+                       remat=remat)
+    res2 = _layer_warp(basicblock, res1, 16, 32, n, 2, is_test,
+                       remat=remat)
+    res3 = _layer_warp(basicblock, res2, 32, 64, n, 2, is_test,
+                       remat=remat)
     pool = layers.pool2d(input=res3, pool_type="avg", global_pooling=True)
     return layers.fc(input=pool, size=class_dim, act="softmax")

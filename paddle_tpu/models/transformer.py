@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 
 from .. import layers, nets
+from ..core.flags import get_flag
 from ..core.framework import pipeline_stage
 from ..initializer import NormalInitializer
 
@@ -128,14 +129,17 @@ def _decoder_block(x, enc_out, d_model, n_heads, d_inner, dropout_rate,
 
 def transformer_encoder(src_ids, vocab_size, d_model=256, n_heads=4,
                         n_layers=2, d_inner=None, max_len=2048,
-                        dropout_rate=0.0, is_test=False, remat=False):
+                        dropout_rate=0.0, is_test=False, remat=None):
     """Bidirectional encoder over [b, s] token ids -> [b, s, d_model].
 
     `remat=True` wraps each block in layers.recompute (jax.checkpoint):
     the block's internal activations are re-run in backward instead of
     living in HBM — the standard bytes-for-FLOPs trade on a
-    memory-bound training step."""
+    memory-bound training step.  remat=None defers to the `remat` flag
+    (PADDLE_TPU_REMAT, build-time)."""
     d_inner = d_inner or 4 * d_model
+    if remat is None:
+        remat = bool(get_flag("remat"))
     x = _embed(src_ids, vocab_size, d_model, max_len, dropout_rate,
                is_test)
     for _ in range(n_layers):
@@ -151,7 +155,7 @@ def transformer_encoder(src_ids, vocab_size, d_model=256, n_heads=4,
 
 def transformer_decoder(tgt_ids, enc_out, vocab_size, d_model=256,
                         n_heads=4, n_layers=2, d_inner=None, max_len=2048,
-                        dropout_rate=0.0, is_test=False, remat=False,
+                        dropout_rate=0.0, is_test=False, remat=None,
                         pipeline_stages=None):
     """Causal decoder ([b, t] ids, optional [b, s, d] memory) -> [b, t, d].
 
@@ -165,6 +169,10 @@ def transformer_decoder(tgt_ids, enc_out, vocab_size, d_model=256,
     the final layer_norm lands in the post section.
     """
     d_inner = d_inner or 4 * d_model
+    if remat is None:
+        # the `remat` flag never overrides a pipeline build (the GPipe
+        # schedule already recomputes per-microbatch)
+        remat = bool(get_flag("remat")) and not pipeline_stages
     if pipeline_stages:
         if n_layers % pipeline_stages:
             raise ValueError(
@@ -210,7 +218,7 @@ def transformer_lm(ids, vocab_size, d_model=256, n_heads=4, n_layers=2,
 def transformer_translate(src_ids, tgt_ids, src_vocab, tgt_vocab,
                           d_model=256, n_heads=4, n_layers=2, d_inner=None,
                           max_len=2048, dropout_rate=0.0, is_test=False,
-                          return_logits=False, remat=False):
+                          return_logits=False, remat=None):
     """Encoder-decoder translation model -> [b, t, tgt_vocab] softmax
     (or raw logits with `return_logits=True` — training should feed
     those to softmax_with_cross_entropy so the [b*t, vocab] probability

@@ -53,12 +53,15 @@ def _amp_enabled() -> bool:
 
 
 def _trace_flags() -> tuple:
-    """Snapshot of every flag read at TRACE time by op lowerings; a jit
-    built under one snapshot must not serve another."""
+    """Snapshot of every flag read at TRACE time by op lowerings (plus
+    memory_optimize, which decides feed donation — part of the built
+    executable); a jit built under one snapshot must not serve
+    another."""
     from ..core.flags import get_flag
     return (_amp_enabled(), get_flag("flash_min_seq_k"),
             get_flag("flash_pack_heads"), get_flag("flash_block_q"),
-            get_flag("flash_block_k"))
+            get_flag("flash_block_k"), get_flag("conv_layout"),
+            get_flag("memory_optimize"))
 
 __all__ = ["ParallelExecutor", "DistributeTranspiler",
            "SimpleDistributeTranspiler"]
@@ -95,6 +98,19 @@ class ParallelExecutor(ShardedCheckpointMixin):
         preflight(program, feed_names=self.feed_names,
                   fetch_names=self.fetch_names)
         self._fn = program_to_fn(program, self.feed_names, self.fetch_names)
+        # explicit `donate=True` var hints fail HERE (build time) when
+        # unsafe — e.g. a donated feed that is also fetched — not as a
+        # deleted-buffer crash mid-train
+        blk = program.global_block()
+        hinted = [n for n in self.feed_names
+                  if getattr(blk.vars.get(n), "donate", False)]
+        if hinted:
+            from ..memory_optimization_transpiler import plan_donation
+
+            rw = [n for n in self._fn.state_in_names
+                  if n in self._fn.state_out_names]
+            plan_donation(program, self.feed_names, self.fetch_names,
+                          state_rw_names=rw, requested=hinted).check()
         self._seed = seed
         self._step = 0
         param_shardings = dict(param_shardings or {})
@@ -136,10 +152,24 @@ class ParallelExecutor(ShardedCheckpointMixin):
         self._trace_flags_state = _trace_flags()
 
     def _make_jit_step(self):
+        # donation plan (memory_optimization_transpiler via
+        # program_to_fn): states are donated always — `run` rebinds
+        # self._states to the returned dict, so the old buffers die with
+        # the step (ZeRO-style in-place update).  Feed buffers (always
+        # freshly device_put from host in `run`) join under the
+        # memory_optimize flag when the plan covers every feed — jit
+        # donation is per-argument, and a fetched feed must survive.
+        from ..core.flags import get_flag
+
+        donate = [1]
+        plan = self._fn.donation_plan
+        if get_flag("memory_optimize") and \
+                set(self.feed_names) <= plan.feeds:
+            donate.insert(0, 0)
         return jax.jit(
             self._step_fn,
             out_shardings=(None, self._out_state_shardings()),
-            donate_argnums=(1,),
+            donate_argnums=tuple(donate),
         )
 
     def _refresh_trace_flags(self):

@@ -12,17 +12,17 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 export PADDLE_TPU_DATASET="${PADDLE_TPU_DATASET:-synthetic}"
 
-echo "== [1/4] repo lint (tools/lint.py) =="
+echo "== [1/5] repo lint (tools/lint.py) =="
 python tools/lint.py
 
-echo "== [2/4] static verification of example programs =="
+echo "== [2/5] static verification of example programs =="
 python -m paddle_tpu.cli verify \
     examples/transformer_lm.py \
     examples/pipeline_transformer_lm.py \
     examples/serve_image_classifier.py \
     examples/dist_ckpt_worker.py
 
-echo "== [3/4] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
+echo "== [3/5] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
 PADDLE_TPU_VERIFY=error python -m pytest \
     tests/test_analysis.py \
     tests/test_registry.py \
@@ -37,7 +37,7 @@ PADDLE_TPU_VERIFY=error python -m pytest \
 # flake — it fails identically on the pre-PR tree, unrelated to
 # verification)
 
-echo "== [4/4] observability + comm subset with PADDLE_TPU_METRICS=on =="
+echo "== [4/5] observability + comm subset with PADDLE_TPU_METRICS=on =="
 # the instrumented hot paths must behave identically with the metric
 # instruments armed (docs/observability.md); test_comm.py also pins the
 # bucketed wire path's backward compatibility both directions
@@ -48,5 +48,18 @@ PADDLE_TPU_METRICS=on python -m pytest \
     tests/test_pserver.py \
     tests/test_comm.py \
     -q -m 'not slow' -p no:cacheprovider
+
+echo "== [5/5] memory layer: fast book subset + memory plan with the optimizer armed =="
+# the whole-program memory layer (donation plan, dead-var freeing,
+# rename pass — docs/performance.md 'Memory') must leave training
+# semantics untouched with the verifier also armed: the book models
+# still converge and every optimized program verifies clean
+PADDLE_TPU_MEMORY_OPTIMIZE=on PADDLE_TPU_VERIFY=error python -m pytest \
+    tests/book/test_fit_a_line.py \
+    tests/book/test_recognize_digits.py \
+    tests/book/test_recommender_system.py \
+    tests/test_memory_optimize.py \
+    tests/test_memory_plan.py \
+    -q -p no:cacheprovider
 
 echo "ci_check: all green"
