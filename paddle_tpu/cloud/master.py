@@ -118,6 +118,26 @@ class Master:
     # same surface as MasterClient so readers work against either
     info = counts
 
+    def reclaim_expired(self) -> dict:
+        """Run the lazy task-timeout check NOW and return the
+        post-reclaim counts.
+
+        The native master reclaims expired leases inside get_task /
+        counts (service.go checkTimeoutFunc is a ticker there; here the
+        check is amortized onto trainer roundtrips).  That is correct
+        but LAZY: a task leased to a SIGKILLed trainer re-dispatches
+        only when some surviving trainer next polls.  The elastic
+        ClusterController pokes this on every trainer-lease expiry so
+        orphaned chunks requeue as soon as ``timeout_s`` allows.
+
+        Reclamation is exactly-once per expiry: the timeout sweep moves
+        the task out of `pending` under the master lock, so a second
+        sweep (or the vanished trainer's late FIN/FAIL ack) finds
+        nothing — the late ack is rejected as stale and does NOT bump
+        the task's `failure_max` accounting a second time
+        (tests/test_elastic.py pins this)."""
+        return self.counts()
+
     def serve(self, port: int = 0) -> int:
         """Start the TCP server; returns the bound port."""
         self.port = self._l.pt_master_serve(self._h, port)
@@ -263,6 +283,10 @@ class MasterClient:
                 map(int, parts[1:]),
             )
         )
+
+    # INFO runs the server's lazy timeout sweep, so poking a REMOTE
+    # master is the same roundtrip (Master.reclaim_expired docs)
+    reclaim_expired = info
 
     def close(self):
         self._reset()

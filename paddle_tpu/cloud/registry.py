@@ -15,12 +15,15 @@ slot (e.g. after a heartbeat gap longer than the TTL).
 """
 from __future__ import annotations
 
+import atexit
 import ctypes
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from paddle_tpu import native
+from paddle_tpu.core.resilience import RetryPolicy
 
 __all__ = ["Registry", "RegistryClient", "Lease"]
 
@@ -79,14 +82,20 @@ class Registry:
         return idx, lease.value
 
     def heartbeat(self, kind: str, index: int, lease: int) -> bool:
+        if not self._h:  # closed registry: definitive GONE, not a crash
+            return False
         return bool(self._lib.pt_registry_heartbeat(
             self._h, kind.encode(), index, lease))
 
     def deregister(self, kind: str, index: int, lease: int) -> bool:
+        if not self._h:  # releasing a lease after close() must be safe
+            return False
         return bool(self._lib.pt_registry_deregister(
             self._h, kind.encode(), index, lease))
 
     def list(self, kind: str) -> Dict[int, str]:
+        if not self._h:
+            return {}
         # pt_registry_list returns the required length; retry bigger on
         # truncation rather than silently dropping endpoints
         size = 1 << 16
@@ -134,16 +143,48 @@ class Registry:
 
 class RegistryClient:
     """TCP client; one short-lived connection per call (the protocol is
-    line-oriented and every verb is a single round trip)."""
+    line-oriented and every verb is a single round trip).
 
-    def __init__(self, addr: str, timeout_s: float = 5.0):
+    Transient transport failures (registry restarting, socket hiccup
+    mid-heartbeat) retry through a RetryPolicy instead of surfacing as a
+    raw OSError with no backoff; knobs are env-tunable via
+    ``PADDLE_TPU_REGISTRY_RETRY_*`` (core/resilience.py).  The default
+    budget is deliberately short — a heartbeat that backs off past the
+    TTL is as lost as one that failed — and a RetryError still IS an
+    OSError, so Lease._beat's keep-retrying loop semantics hold."""
+
+    def __init__(self, addr: str, timeout_s: float = 5.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         host, port = addr.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout_s
+        self.policy = retry_policy or RetryPolicy.from_env(
+            "REGISTRY_RETRY", max_attempts=3, base_delay=0.05,
+            max_delay=0.5, deadline=5.0)
 
     def _roundtrip(self, line: str, multi: bool = False) -> List[str]:
-        with socket.create_connection(self._addr,
-                                      timeout=self._timeout) as s:
+        return self.policy.call(
+            lambda: self._roundtrip_once(line, multi),
+            what=(f"registry at {self._addr[0]}:{self._addr[1]}: "
+                  f"{line.split()[0]} failed"))
+
+    def _connect_retrying(self, what: str) -> socket.socket:
+        """A connected socket, retrying ONLY the connect phase through
+        the policy.  For non-idempotent verbs (REG): once a request
+        line may have reached the registry, a lost reply must surface
+        instead of causing a re-send — a duplicate REG mints a ghost
+        slot whose lease nobody heartbeats, and its TTL expiry later
+        reads as a spurious member death."""
+        return self.policy.call(
+            lambda: socket.create_connection(self._addr,
+                                             timeout=self._timeout),
+            what=(f"registry at {self._addr[0]}:{self._addr[1]}: "
+                  f"{what} failed"))
+
+    def _roundtrip_once(self, line: str, multi: bool = False,
+                        sock: Optional[socket.socket] = None) -> List[str]:
+        with (sock or socket.create_connection(
+                self._addr, timeout=self._timeout)) as s:
             s.sendall(line.encode() + b"\n")
             f = s.makefile("r")
             first = f.readline().strip()
@@ -169,8 +210,11 @@ class RegistryClient:
 
     def register(self, kind: str, addr: str,
                  ttl_s: float) -> Tuple[int, int]:
-        resp = self._roundtrip(
-            f"REG {kind} {int(ttl_s * 1000)} {addr}")[0].split()
+        # NOT via _roundtrip: REG is the one non-idempotent verb, so
+        # only its connect retries (_connect_retrying docstring)
+        resp = self._roundtrip_once(
+            f"REG {kind} {int(ttl_s * 1000)} {addr}",
+            sock=self._connect_retrying("REG connect"))[0].split()
         if resp[0] != "OK":
             raise RuntimeError(
                 f"registry: no free {kind!r} slot below the desired count")
@@ -192,12 +236,38 @@ class RegistryClient:
         return out
 
     def wait_ready(self, kind: str, n: int, timeout_s: float) -> bool:
-        # server blocks up to timeout_s; allow socket slack on top
+        # server blocks up to the REMAINING window; allow socket slack
+        # on top.  Transport failures retry like every other verb, but
+        # each retry asks the server only for what is left of the
+        # caller's timeout_s — a hiccup mid-wait cannot stretch the
+        # call to ~2x the requested bound
         host, port = self._addr
-        with socket.create_connection(
-                (host, port), timeout=timeout_s + self._timeout) as s:
-            s.sendall(f"WAIT {kind} {n} {int(timeout_s * 1000)}\n".encode())
-            return s.makefile("r").readline().strip() == "OK"
+        deadline = time.monotonic() + timeout_s
+        state = self.policy.begin()
+        while True:
+            left = max(0.0, deadline - time.monotonic())
+            sent = False
+            try:
+                with socket.create_connection(
+                        (host, port),
+                        timeout=left + self._timeout) as s:
+                    s.sendall(
+                        f"WAIT {kind} {n} "
+                        f"{int(left * 1000)}\n".encode())
+                    sent = True
+                    return s.makefile("r").readline().strip() == "OK"
+            except OSError as e:
+                if sent and time.monotonic() < deadline:
+                    # the request reached the server, so the failure
+                    # came AFTER time legitimately spent blocked in the
+                    # server-side wait — that time must not be charged
+                    # against the policy's (short) failure deadline, or
+                    # one hiccup late in a long WAIT aborts instead of
+                    # retrying the remaining window
+                    state = self.policy.begin()
+                state.record(e, what=(f"registry at {host}:{port}: "
+                                      "WAIT failed"))
+                state.sleep()
 
 
 class Lease:
@@ -214,10 +284,16 @@ class Lease:
         self.ttl_s = ttl_s
         self.index, self._lease = registry.register(kind, addr, ttl_s)
         self.lost = False
+        self.released = False
         self._on_lost = on_lost
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
+        # a cleanly-exiting member frees its slot IMMEDIATELY instead of
+        # making the cluster wait out the TTL (and the controller treat
+        # a normal exit as a failure); release() is idempotent, so an
+        # explicit release beats the hook to it and unregisters it
+        atexit.register(self.release)
 
     def _beat(self):
         while not self._stop.wait(self.ttl_s / 3.0):
@@ -232,11 +308,24 @@ class Lease:
                 return
 
     def release(self):
+        """Stop heartbeating and free the slot.  Idempotent, and safe
+        after the registry is gone (closed handle, dead TCP peer,
+        interpreter teardown) — a release can never raise."""
+        if self.released:
+            return
+        self.released = True
+        try:
+            atexit.unregister(self.release)
+        except Exception:  # interpreter teardown ordering
+            pass
         self._stop.set()
-        self._thread.join(timeout=self.ttl_s)
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=self.ttl_s)
         try:
             self._reg.deregister(self.kind, self.index, self._lease)
-        except OSError:
+        except Exception:
+            # OSError (registry unreachable / RetryError) or native
+            # teardown artifacts: the TTL reclaims the slot anyway
             pass
 
 

@@ -10,7 +10,9 @@ the same two primitives, shared by every networked/durable subsystem:
   * `RetryPolicy` — exponential backoff with jitter, an attempt cap and
     an overall deadline.  Each knob is overridable per subsystem via
     ``PADDLE_TPU_<PREFIX>_<KNOB>`` environment variables (prefixes:
-    ``MASTER_RETRY``, ``PSERVER_RETRY``, ``DOWNLOAD_RETRY``; the bare
+    ``MASTER_RETRY``, ``PSERVER_RETRY``, ``DOWNLOAD_RETRY``,
+    ``REGISTRY_RETRY`` — RegistryClient heartbeat/resolve roundtrips —
+    and ``CLUSTER_RETRY`` — ClusterClient view roundtrips; the bare
     ``RETRY`` prefix is the cross-subsystem fallback).
   * `FaultInjector` — process-local chaos hooks compiled into the hot
     paths (no-ops when no rules are armed).  Call sites `fire(site)` to
@@ -23,7 +25,8 @@ the same two primitives, shared by every networked/durable subsystem:
 Injection sites currently wired (see docs/resilience.md):
   master.connect, master.send, pserver.connect, pserver.request,
   pserver.send, dataset.download, serving.dispatch, trainer.iteration,
-  checkpoint.save
+  checkpoint.save, cluster.rebalance (start of a view change),
+  cluster.migrate (per shard-migration source group)
 """
 from __future__ import annotations
 

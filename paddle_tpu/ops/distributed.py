@@ -18,6 +18,18 @@ def reset_clients():
     reset_comm_pool()
 
 
+def _data_scope(ctx):
+    """The scope whose param copies back trainer-held shard recovery
+    (comm.ensure_param_provider) — the executor's run scope, falling
+    back to the global scope like listen_and_serv does."""
+    scope = getattr(ctx, "scope", None)
+    if scope is not None:
+        return scope
+    from ..core.executor import global_scope
+
+    return global_scope()
+
+
 @register_op("send", inputs=("X",), outputs=("Out",),
              attrs={"endpoints": [], "epmap": [], "out_epmap": [],
                     "bucket_bytes": -1},
@@ -30,8 +42,14 @@ def send(ctx, ins, attrs):
     (SEND_BATCH frames, cap = `bucket_bytes` attr or the
     comm_bucket_bytes flag) and each endpoint's send→barrier→pull
     chain runs on its own pooled connection, so pservers are served
-    concurrently instead of one serial round per endpoint."""
-    from ..parallel.comm import comm_pool
+    concurrently instead of one serial round per endpoint.
+
+    Under an elastic cluster subscription (comm.set_cluster /
+    PADDLE_TPU_CONTROLLER) the transpile-time epmap becomes a fallback:
+    each round maps every param through the controller's current view
+    placement, and a round that dies mid-flight retries against the
+    next stable view (comm.elastic_round)."""
+    from ..parallel.comm import elastic_round
 
     xs = many(ins, "X")
     in_names = ctx.op.input("X")
@@ -40,10 +58,22 @@ def send(ctx, ins, attrs):
     out_epmap = (attrs.get("out_epmap") or
                  [attrs["endpoints"][0]] * len(out_names))
     bucket = int(attrs.get("bucket_bytes", -1))
-    outs = comm_pool().send_round(
-        [(ep, n, data_of(v)) for n, v, ep in zip(in_names, xs, epmap)],
-        list(zip(out_epmap, out_names)),
-        bucket_bytes=None if bucket < 0 else bucket)
+    # cluster views place PARAMS; the fused op aligns X grads with
+    # their Out params positionally (DistributeTranspiler), so grad i's
+    # placement key is out_names[i] — with a grad-only tail (or a
+    # legacy non-fused op) fall back to stripping the @GRAD suffix
+    def param_key(i):
+        if i < len(out_names):
+            return out_names[i]
+        n = in_names[i]
+        return n[:-len("@GRAD")] if n.endswith("@GRAD") else n
+
+    outs = elastic_round(
+        [(param_key(i), n, data_of(v), ep)
+         for i, (n, v, ep) in enumerate(zip(in_names, xs, epmap))],
+        [(n, n, ep) for n, ep in zip(out_names, out_epmap)],
+        bucket_bytes=None if bucket < 0 else bucket,
+        scope=_data_scope(ctx))
     return {"Out": outs}
 
 
@@ -53,12 +83,13 @@ def send(ctx, ins, attrs):
              not_differentiable=True, host=True)
 def recv(ctx, ins, attrs):
     """Standalone param fetch (recv_op.cc:28-53), batched into
-    GET_BATCH frames."""
-    from ..parallel.comm import comm_pool
+    GET_BATCH frames; under an elastic cluster subscription each name
+    resolves through the current view placement."""
+    from ..parallel.comm import elastic_round
 
     out_names = ctx.op.output("Out")
     ep = attrs["endpoint"]
-    outs = comm_pool().send_round([], [(ep, n) for n in out_names])
+    outs = elastic_round([], [(n, n, ep) for n in out_names])
     return {"Out": outs}
 
 

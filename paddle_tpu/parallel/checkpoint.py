@@ -21,6 +21,29 @@ import numpy as np
 import jax
 
 STATES_FILENAME = "sharded_states.npz"
+PSERVER_SHARD_FILENAME = "pserver_shard.npz"
+
+
+def latest_pserver_shard(snapshot_dir):
+    """Newest md5-valid pserver shard snapshot under `snapshot_dir`:
+    ``({name: host array}, round, meta)`` or ``(None, 0, None)``.
+
+    Shared by VariableServer.restore_snapshot (a replacement pserver
+    resuming its slot) and the elastic ClusterController (sourcing a
+    DEAD member's shards during a rebalance,
+    go/pserver/service.go:120-203 semantics)."""
+    from .. import io as _io
+
+    cp_dir, meta = _io.latest_checkpoint(
+        snapshot_dir,
+        require=lambda d: os.path.exists(
+            os.path.join(d, PSERVER_SHARD_FILENAME)))
+    if cp_dir is None:
+        return None, 0, None
+    with np.load(os.path.join(cp_dir, PSERVER_SHARD_FILENAME)) as z:
+        data = {n: z[n] for n in z.files}
+    rnd = int(meta.get("trainer_args", {}).get("round", 0))
+    return data, rnd, meta
 
 
 class ShardedCheckpointMixin:

@@ -23,9 +23,21 @@ Wire compatibility is the client's job: a `VariableClient` whose server
 answers ERR to a batch verb falls back to per-var frames permanently
 for that endpoint (see pserver.py), so one `CommPool` can serve mixed
 old/new pserver fleets.
+
+**Elastic clusters** (cloud/cluster.py, docs/resilience.md "Elastic
+clusters"): when a cluster subscription is armed (`set_cluster` or the
+``PADDLE_TPU_CONTROLLER`` env var), `elastic_round` re-derives each
+round's endpoint map from the controller's current epoch-numbered view
+instead of the transpile-time epmap, and a round that dies mid-flight
+(SIGKILLed pserver, shard migrated away between view fetch and GET)
+waits for the next stable view and retries against the new placement —
+no process restart.  The transpiled epmap stays as the static fallback
+for vars the view does not place.
 """
 from __future__ import annotations
 
+import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -35,7 +47,10 @@ from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from .pserver import VariableClient
 
-__all__ = ["CommPool", "comm_pool", "reset_comm_pool"]
+__all__ = ["CommPool", "comm_pool", "reset_comm_pool", "set_cluster",
+           "get_cluster", "reset_cluster", "elastic_round"]
+
+_LOG = logging.getLogger("paddle_tpu.comm")
 
 # 64 B .. 1 GiB, x4 steps — grad rounds span tiny RNN cells to
 # full embedding tables
@@ -50,6 +65,29 @@ _M_ROUND_BYTES = obs_metrics.histogram(
     "serialized payload bytes moved per round, by direction (frame "
     "heads excluded so the directions are comparable)",
     ("direction",), buckets=_BYTE_BUCKETS)
+_M_ROUND_RETRIES = obs_metrics.counter(
+    "paddle_tpu_comm_round_retries_total",
+    "elastic rounds retried against a fresh cluster view after a "
+    "mid-round failure (dead pserver / migrated shard)")
+
+
+def _default_client(endpoint: str) -> VariableClient:
+    """Pool client factory.  Under an elastic cluster subscription the
+    retry budget is deliberately SHORT (env-tunable via
+    PADDLE_TPU_ELASTIC_RETRY_*): a dead pserver is not coming back on
+    this endpoint — the recovery path is failing the round fast and
+    replaying it against the controller's next view, not sitting in a
+    multi-minute reconnect loop."""
+    if get_cluster() is None:
+        return VariableClient(endpoint)
+    from ..core.resilience import RetryPolicy
+
+    return VariableClient(
+        endpoint, connect_timeout=2.0, request_timeout=15.0,
+        barrier_timeout=15.0,
+        retry_policy=RetryPolicy.from_env(
+            "ELASTIC_RETRY", max_attempts=2, base_delay=0.05,
+            max_delay=0.25, deadline=2.0))
 
 
 class CommPool:
@@ -62,7 +100,7 @@ class CommPool:
     run paid one full round trip chain per pserver."""
 
     def __init__(self, client_factory=None):
-        self._factory = client_factory or VariableClient
+        self._factory = client_factory or _default_client
         self._clients: Dict[str, VariableClient] = {}
         self._workers: Dict[str, ThreadPoolExecutor] = {}
         self._lock = threading.Lock()
@@ -182,6 +220,25 @@ class CommPool:
             sum(r[2] for r in results.values()))
         return out
 
+    def forget(self, endpoint: str):
+        """Drop the pooled client/worker for one endpoint so the next
+        round reconnects fresh — the elastic retry path calls this for
+        every endpoint a failed round touched (a dead pserver's socket
+        must not be reused, and a survivor's batch-capability probe is
+        cheap to redo)."""
+        with self._lock:
+            c = self._clients.pop(endpoint, None)
+            w = self._workers.pop(endpoint, None)
+        # the failed round drained every submitted future before
+        # raising, so the worker is idle here
+        if w is not None:
+            w.shutdown(wait=False)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+
     def close(self):
         # order matters: mark closed (new rounds and NEW connections
         # fail fast; existing clients keep serving), drain the workers
@@ -222,3 +279,166 @@ def reset_comm_pool():
         pool, _POOL = _POOL, None
     if pool is not None:
         pool.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic cluster subscription (cloud/cluster.py views)
+# ---------------------------------------------------------------------------
+
+_CLUSTER = None
+_CLUSTER_TRIED_ENV = False
+
+
+def set_cluster(cluster):
+    """Arm the process-wide cluster subscription: `cluster` is a
+    cloud.cluster.ClusterClient, a ClusterController (in-process
+    clusters/tests), or a controller address string.  The send/recv ops
+    then route every round through `elastic_round`."""
+    global _CLUSTER, _CLUSTER_TRIED_ENV
+    from ..cloud.cluster import ClusterClient, ClusterController
+
+    if cluster is None or isinstance(cluster, ClusterClient):
+        pass
+    elif isinstance(cluster, (str, ClusterController)):
+        cluster = ClusterClient(cluster)
+    else:
+        raise TypeError(f"set_cluster: expected ClusterClient, "
+                        f"ClusterController or address, got {cluster!r}")
+    with _POOL_LOCK:
+        _CLUSTER = cluster
+        _CLUSTER_TRIED_ENV = True
+    return cluster
+
+
+def get_cluster():
+    """The armed cluster subscription, building one from the
+    ``PADDLE_TPU_CONTROLLER`` env var on first call; None when the
+    process is not part of an elastic cluster."""
+    global _CLUSTER, _CLUSTER_TRIED_ENV
+    with _POOL_LOCK:
+        if _CLUSTER is not None or _CLUSTER_TRIED_ENV:
+            return _CLUSTER
+    # build OUTSIDE the lock (imports + construction), publish under
+    # it: TRIED_ENV flips only together with the client so a
+    # concurrent first caller can never observe "tried, but None" and
+    # silently fall back to the static epmap for its round
+    client = None
+    addr = os.environ.get("PADDLE_TPU_CONTROLLER", "").strip()
+    if addr:
+        from ..cloud.cluster import ClusterClient
+
+        client = ClusterClient(addr)
+    with _POOL_LOCK:
+        if _CLUSTER is None and not _CLUSTER_TRIED_ENV:
+            _CLUSTER_TRIED_ENV = True
+            _CLUSTER = client
+        return _CLUSTER
+
+
+def reset_cluster():
+    """Drop the cluster subscription (tests, teardown).  The env var is
+    re-read on the next get_cluster()."""
+    global _CLUSTER, _CLUSTER_TRIED_ENV
+    with _POOL_LOCK:
+        c, _CLUSTER = _CLUSTER, None
+        _CLUSTER_TRIED_ENV = False
+    if c is not None:
+        try:
+            c.close()
+        except Exception:
+            pass
+
+
+def _elastic_wait_s() -> float:
+    try:
+        return float(os.environ.get("PADDLE_TPU_ELASTIC_WAIT_S", "60"))
+    except ValueError:
+        return 60.0
+
+
+def ensure_param_provider(scope):
+    """Arm trainer-held shard recovery on the cluster subscription: the
+    data-path scope's parameter copies (refreshed by every round's
+    pull) become a recovery source when a pserver dies snapshotless.
+    First scope wins; later calls with the same scope are no-ops."""
+    import numpy as np
+
+    cluster = get_cluster()
+    if cluster is None or getattr(cluster, "_provider", None) is not None:
+        return
+
+    def provider(name):
+        v = scope.find_var(name) if scope.has_var(name) else None
+        if v is None:
+            return None
+        try:
+            return np.asarray(v)
+        except Exception:
+            return v  # LoDTensor/SelectedRows ship as-is
+
+    cluster.set_param_provider(provider)
+
+
+def elastic_round(sends, gets, bucket_bytes: Optional[int] = None,
+                  scope=None) -> List[object]:
+    """One send/recv round that survives membership changes.
+
+    ``sends``: [(placement_key, wire_name, value, fallback_ep)] — the
+    placement key is the PARAM name (cluster views place params; grads
+    ride to their param's owner), the wire name is what the pserver
+    stores (the grad name).  ``gets``: [(placement_key, wire_name,
+    fallback_ep)].  Without a cluster subscription this is exactly
+    CommPool.send_round over the fallback endpoints.
+
+    With one, each attempt maps keys through the CURRENT stable view's
+    placement and a failed attempt (dead pserver: retries exhausted
+    below; stale placement: the server's ERR for an unknown var) forgets
+    the touched connections, waits for a FRESH stable view (the
+    controller publishes one once the dead member's TTL lease expires
+    and shards have migrated), and replays the whole round against the
+    new placement.  Replaying a round that half-applied is safe:
+    re-sent grads overwrite this trainer's per-trainer slot, and a
+    round the commit released early is simply lost — at-least-once
+    sync SGD (docs/resilience.md)."""
+    from .pserver import BarrierTimeoutError
+
+    pool = comm_pool()
+    cluster = get_cluster()
+    if cluster is None:
+        return pool.send_round(
+            [(ep, n, v) for _, n, v, ep in sends],
+            [(ep, n) for _, n, ep in gets], bucket_bytes)
+    if scope is not None:
+        ensure_param_provider(scope)
+    wait_s = _elastic_wait_s()
+    last_exc = None
+    for attempt in range(8):
+        view = cluster.ready_view(timeout_s=wait_s)
+        place = view.placement
+        send_items = [(place.get(k, ep), n, v) for k, n, v, ep in sends]
+        get_items = [(place.get(k, ep), n) for k, n, ep in gets]
+        try:
+            return pool.send_round(send_items, get_items, bucket_bytes)
+        except (OSError, ConnectionError, RuntimeError,
+                BarrierTimeoutError) as e:
+            last_exc = e
+            touched = {ep for ep, _, _ in send_items} | \
+                      {ep for ep, _ in get_items}
+            for ep in touched:
+                pool.forget(ep)
+            _M_ROUND_RETRIES.inc()
+            _LOG.warning(
+                "elastic round failed under view %d (%s); waiting for "
+                "a fresh cluster view", view.epoch, e)
+            # wait briefly for a NEWER view (the usual cause: a member
+            # died and the controller is rebalancing).  If none comes,
+            # the failure was transient — a barrier timed out on
+            # round-skew, a peer was mid-replay — so replay against
+            # the CURRENT view; the attempt cap bounds the total spin.
+            nxt = cluster.wait_view(view.epoch + 1,
+                                    timeout_s=min(wait_s, 5.0))
+            if nxt is None:
+                _LOG.warning(
+                    "elastic round: no newer view than %d; replaying "
+                    "against the current placement", view.epoch)
+    raise last_exc

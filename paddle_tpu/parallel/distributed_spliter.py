@@ -7,8 +7,16 @@ one pserver endpoint per variable.
 from __future__ import annotations
 
 import hashlib
+from collections import namedtuple
 
-__all__ = ["hash_name", "round_robin", "balanced_split"]
+__all__ = ["hash_name", "round_robin", "balanced_split", "VarDesc",
+           "placement_map"]
+
+# Minimal variable stand-in for placement decisions made OUTSIDE a
+# Program (the elastic cluster controller re-runs balanced_split on
+# membership changes and only carries name/shape/dtype over the wire).
+# Any object with these attributes works with every split policy here.
+VarDesc = namedtuple("VarDesc", ("name", "shape", "dtype"))
 
 
 def _stable_hash(name: str) -> int:
@@ -74,3 +82,13 @@ def balanced_split(varlist, pserver_endpoints):
         assign[i] = j
         load[j] += sizes[i]
     return [pserver_endpoints[j] for j in assign]
+
+
+def placement_map(varlist, pserver_endpoints, method=None):
+    """{var name -> endpoint} under `method` (default balanced_split).
+    The elastic runtime's canonical form: every process that re-runs
+    this with the same var descs + endpoint list derives the SAME
+    placement, so a cluster view only needs to carry the inputs."""
+    method = method or balanced_split
+    return {getattr(v, "name", str(v)): ep
+            for v, ep in zip(varlist, method(varlist, pserver_endpoints))}

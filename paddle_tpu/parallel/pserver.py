@@ -75,7 +75,14 @@ _M_BATCH_FALLBACK = obs_metrics.counter(
 
 _KNOWN_VERBS = frozenset(
     {"HELLO", "SEND", "SEND_BATCH", "BARRIER", "GET", "GET_BATCH",
-     "STOP", "OK", "ERR", "VAR", "VARS"})
+     "STOP", "OK", "ERR", "VAR", "VARS",
+     # elastic cluster runtime (docs/resilience.md "Elastic clusters"):
+     # PUT_BATCH installs values under their CANONICAL names (shard
+     # migration / trainer-held recovery), DROP erases migrated-away
+     # vars, HAVE probes which names a member holds (bootstrap-copy
+     # consolidation), FENCE/COMMIT are the controller's two-phase
+     # view change
+     "PUT_BATCH", "DROP", "HAVE", "FENCE", "COMMIT"})
 
 # frame-length sanity: a header larger than 1 MiB or a payload larger
 # than 2 GiB is protocol desync / corruption, not a real request —
@@ -206,6 +213,22 @@ def _prepare_vars(items):
         head, parts = serialize_var_parts(v)
         prepared.append((n, head, parts, sum(_blen(p) for p in parts)))
     return prepared
+
+
+def _pack_buckets(prepared, cap):
+    """DDP-style packing shared by SEND_BATCH and PUT_BATCH: arrival
+    order, close a bucket when the next var would push it past the cap
+    (an oversized var ships alone)."""
+    buckets, cur, cur_b = [], [], 0
+    for it in prepared:
+        if cur and cur_b + it[3] > cap:
+            buckets.append(cur)
+            cur, cur_b = [], 0
+        cur.append(it)
+        cur_b += it[3]
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def serialize_batch_parts(items) -> list:
@@ -394,11 +417,23 @@ class VariableServer:
 
     def __init__(self, optimize_program, scope, executor, fan_in: int = 1,
                  sync: bool = True, snapshot_dir: Optional[str] = None,
-                 snapshot_every: int = 0, enable_batch: bool = True):
+                 snapshot_every: int = 0, enable_batch: bool = True,
+                 elastic: bool = False):
         self.program = optimize_program
         self.scope = scope
         self.exe = executor
         self.fan_in = fan_in
+        # elastic=True: this server participates in membership-driven
+        # rebalancing (cloud/cluster.py).  It holds the FULL optimize
+        # program but at each sync round runs only the per-grad slices
+        # of grads that actually arrived — ownership is decided by what
+        # trainers send per the current cluster view, so parameters can
+        # migrate in/out at runtime without rebuilding the program.  The
+        # controller drives the FENCE/COMMIT two-phase view change and
+        # PUT_BATCH/DROP shard migration verbs.
+        self.elastic = elastic
+        self._fenced = False
+        self._view_epoch = 0
         # enable_batch=False turns off the fused SEND_BATCH/GET_BATCH
         # verbs, making this server answer exactly like one predating
         # them (ERR "unknown verb") — the wire-compat tests pin the
@@ -434,10 +469,13 @@ class VariableServer:
         self.port = None
         if snapshot_dir:
             self.restore_snapshot()
-        if not sync and self.program is not None:
+        if (not sync or elastic) and self.program is not None:
             # validate the optimize program HERE, where the user can see
             # the error — a raise inside a handler thread would surface to
-            # trainers only as a dropped connection
+            # trainers only as a dropped connection.  Elastic sync mode
+            # needs the same per-grad slices: a round must update only
+            # the params whose grads arrived (this server's current
+            # shard), never the whole program.
             self._build_async_slices()
 
     # -- lifecycle ----------------------------------------------------------
@@ -477,6 +515,15 @@ class VariableServer:
             lease.release()
         try:
             if self._sock is not None:
+                # shutdown BEFORE close: close() alone may not abort a
+                # blocked accept() on every kernel, leaving a grace
+                # window where a stopped server accepts (and serves!)
+                # one more connection — fatal for crash simulations and
+                # wrong for real shutdown
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 self._sock.close()
         except OSError:
             pass
@@ -489,6 +536,13 @@ class VariableServer:
             try:
                 conn, addr = self._sock.accept()
             except OSError:
+                return
+            if self._stopping:
+                # accept raced stop(): a dead server must not answer
+                try:
+                    conn.close()
+                except OSError:
+                    pass
                 return
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
@@ -579,6 +633,66 @@ class VariableServer:
                             else:
                                 _send_frame_parts(conn, "VARS", "",
                                                   parts)
+                        elif verb == "PUT_BATCH":
+                            # shard migration / recovery install: values
+                            # land under their CANONICAL names (vs
+                            # SEND's per-trainer grad rename) — the
+                            # controller and trainer-held recovery both
+                            # write params, not grads.  Allowed while
+                            # fenced: migration RUNS during the fence.
+                            # NOT gated on enable_batch: this is an
+                            # elastic verb shipping with FENCE/COMMIT/
+                            # DROP, not a PR 5 compat verb — the client
+                            # has no per-var fallback for it.
+                            pairs = deserialize_batch(payload)
+                            with self._lock:
+                                for n, v in pairs:
+                                    self.scope.set_var(n, v)
+                            _send_frame(conn, "OK")
+                        elif verb == "DROP":
+                            names = json.loads(bytes(payload))
+                            # the param, its canonical grad, and stale
+                            # per-trainer slots of EITHER must all go —
+                            # a migrated-away param's leftover grads
+                            # must not feed a later optimize round.
+                            # ONE scope pass total (not per name): a
+                            # per-trainer slot is `<base>.trainer_<i>`,
+                            # so stripping that suffix maps every scope
+                            # name onto the doomed set
+                            doomed = set()
+                            for n in names:
+                                doomed.add(n)
+                                doomed.add(n + "@GRAD")
+                            with self._lock:
+                                for sn in list(
+                                        self.scope.local_names()):
+                                    base = sn
+                                    if ".trainer_" in sn:
+                                        base = sn.rsplit(
+                                            ".trainer_", 1)[0]
+                                    if base in doomed:
+                                        self.scope.erase(sn)
+                            _send_frame(conn, "OK")
+                        elif verb == "HAVE":
+                            # bootstrap-copy probe: which of these
+                            # names does this member hold?  Used by the
+                            # controller's initial-placement
+                            # consolidation (fenced, read-only)
+                            names = json.loads(bytes(payload))
+                            with self._lock:
+                                held = [n for n in names
+                                        if self.scope.has_var(n)]
+                            _send_frame(conn, "OK", "",
+                                        json.dumps(held).encode())
+                        elif verb == "FENCE":
+                            self._apply_fence(int(name))
+                            _send_frame(conn, "OK")
+                        elif verb == "COMMIT":
+                            attrs = (json.loads(bytes(payload))
+                                     if payload else {})
+                            self._apply_commit(int(name),
+                                               attrs.get("fan_in"))
+                            _send_frame(conn, "OK")
                         elif verb == "BARRIER":
                             if self.sync:
                                 self._barrier()
@@ -654,18 +768,14 @@ class VariableServer:
     def restore_snapshot(self):
         """Load the latest valid shard snapshot (if any) into the scope.
         Returns the snapshot meta or None."""
-        from .. import io as _io
+        from .checkpoint import latest_pserver_shard
 
-        cp_dir, meta = _io.latest_checkpoint(
-            self.snapshot_dir,
-            require=lambda d: os.path.exists(
-                os.path.join(d, "pserver_shard.npz")))
-        if cp_dir is None:
+        data, rnd, meta = latest_pserver_shard(self.snapshot_dir)
+        if data is None:
             return None
-        with np.load(os.path.join(cp_dir, "pserver_shard.npz")) as z:
-            for n in z.files:
-                self.scope.set_var(n, jnp.asarray(z[n]))
-        self._round = int(meta.get("trainer_args", {}).get("round", 0))
+        for n, v in data.items():
+            self.scope.set_var(n, jnp.asarray(v))
+        self._round = rnd
         return meta
 
     def _maybe_snapshot_data(self):
@@ -683,6 +793,13 @@ class VariableServer:
     def _barrier(self):
         snap = None
         with self._lock:
+            # view-change fence: no optimize step may straddle a
+            # placement change, so barriers arriving mid-rebalance hold
+            # until the controller COMMITs the new view (reads and the
+            # migration verbs stay live — the fence only quiesces the
+            # round machinery)
+            while self._fenced and not self._stopping:
+                self._lock.wait(timeout=0.1)
             self._barriers += 1
             if self._barriers >= self.fan_in:
                 self._run_optimize()
@@ -696,6 +813,39 @@ class VariableServer:
                     self._lock.wait(timeout=0.1)
         if snap is not None:
             self._write_snapshot(snap)
+
+    # -- two-phase view change (cloud/cluster.py ClusterController) ---------
+    def _apply_fence(self, epoch: int):
+        """Phase 1: quiesce the round machinery.  Acquiring the server
+        lock waits out any optimize in flight; once set, new BARRIERs
+        block until COMMIT, so shard migration runs against frozen
+        state and no optimize mixes old and new placements."""
+        with self._lock:
+            self._fenced = True
+            self._view_epoch = max(self._view_epoch, epoch)
+
+    def _apply_commit(self, epoch: int, fan_in=None):
+        """Phase 2: adopt the new view.  Updates fan_in to the live
+        trainer count, clears per-trainer grad slots (a half-arrived
+        round under the OLD placement must not leak into the new
+        epoch), and releases every waiter — trainers blocked mid-round
+        (e.g. behind a SIGKILLed peer's missing barrier) get their
+        BARRIER answered and simply lose that round's update, which
+        at-least-once sync SGD tolerates."""
+        with self._lock:
+            self._view_epoch = max(self._view_epoch, epoch)
+            if fan_in:
+                self.fan_in = int(fan_in)
+            for n in list(self.scope.local_names()):
+                if ".trainer_" in n:
+                    self.scope.erase(n)
+            if self._barriers:
+                # release mid-round waiters without an optimize: their
+                # grads were just cleared as pre-view state
+                self._round += 1
+            self._barriers = 0
+            self._fenced = False
+            self._lock.notify_all()
 
     def _slice_program(self, keep):
         from ..core.framework import Program
@@ -835,7 +985,24 @@ class VariableServer:
                 self.scope.set_var(base, np.sum(vals, axis=0)
                                    if len(vals) > 1 else vals[0])
         if self.program is not None:
-            self.exe.run(self.program, scope=self.scope)
+            if self.elastic:
+                # run only the slices of grads that ARRIVED this round:
+                # this server's shard is whatever the current view
+                # placed on it, and params migrated away (DROPped) must
+                # not be touched by stale program ops
+                ran = False
+                for base in sorted(names):
+                    prog = self._async_progs.get(base)
+                    if prog is not None:
+                        self.exe.run(prog, scope=self.scope)
+                        ran = True
+                if ran and self._async_epilogue is not None:
+                    # shared schedule state (Adam beta pows, global
+                    # step) advances once per optimize round, exactly
+                    # like the non-elastic full-program run
+                    self.exe.run(self._async_epilogue, scope=self.scope)
+            else:
+                self.exe.run(self.program, scope=self.scope)
         # per-iteration sparse-row clearing (listen_and_serv_op.cc:171):
         # a round's rows must not be re-applied next round if a slower
         # trainer's SEND hasn't replaced the slot yet
@@ -919,6 +1086,7 @@ class VariableClient:
         # reference's gRPC client Wait())
         self.request_timeout = request_timeout
         self.barrier_timeout = barrier_timeout
+        self.connect_timeout = connect_timeout
         self._policy = retry_policy or RetryPolicy.from_env(
             "PSERVER_RETRY", max_attempts=5, base_delay=0.2,
             max_delay=2.0, deadline=30.0)
@@ -1005,7 +1173,11 @@ class VariableClient:
             sent = False
             try:
                 if self.sock is None:
-                    self._connect()
+                    # reconnects cap the boot patience at 30s; clients
+                    # built for elastic clusters pass a much smaller
+                    # connect_timeout so a dead endpoint fails the
+                    # round fast instead of spinning on refusals
+                    self._connect(min(self.connect_timeout, 30.0))
                 fault_injector().fire("pserver.request")
                 self.sock.settimeout(timeout)
                 try:
@@ -1096,17 +1268,7 @@ class VariableClient:
                 self.send_var(n, v)
             return
         prepared = _prepare_vars(items)
-        # DDP-style packing: arrival order, close a bucket when the next
-        # var would push it past the cap (an oversized var ships alone)
-        buckets, cur, cur_b = [], [], 0
-        for it in prepared:
-            if cur and cur_b + it[3] > cap:
-                buckets.append(cur)
-                cur, cur_b = [], 0
-            cur.append(it)
-            cur_b += it[3]
-        if cur:
-            buckets.append(cur)
+        buckets = _pack_buckets(prepared, cap)
         for bi, bucket in enumerate(buckets):
             if not self._send_bucket(bucket, cap):
                 # legacy server: this and every later bucket per-var
@@ -1191,6 +1353,59 @@ class VariableClient:
             out.extend(v for _, v in pairs)
             i += len(chunk)
         return out
+
+    # -- elastic cluster verbs (cloud/cluster.py view changes) --------------
+    def put_vars(self, items, bucket_bytes: Optional[int] = None) -> int:
+        """Install values under their CANONICAL names (shard migration /
+        trainer-held recovery — NOT grads: SEND's per-trainer rename is
+        deliberately bypassed).  Buckets like send_vars; returns payload
+        bytes shipped.  Elastic servers always speak PUT_BATCH (the verb
+        ships with FENCE/COMMIT), so there is no legacy fallback."""
+        prepared = _prepare_vars(list(items))
+        cap = _bucket_cap(bucket_bytes)
+        if cap <= 0:
+            cap = 1 << 62  # bucketing off: one bucket, still PUT_BATCH
+        buckets = _pack_buckets(prepared, cap)
+        total = 0
+        for bucket in buckets:
+            rverb, _, _ = self._request(
+                "PUT_BATCH", "", payload_parts=_batch_payload_parts(bucket))
+            if rverb != "OK":
+                raise RuntimeError(f"pserver error on PUT_BATCH: {rverb}")
+            total += sum(it[3] for it in bucket)
+        return total
+
+    def drop_vars(self, names):
+        """Erase vars (and their per-trainer grad slots) migrated away
+        from this server by a rebalance."""
+        rverb, _, _ = self._request("DROP", "",
+                                    json.dumps(list(names)).encode())
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error on DROP: {rverb}")
+
+    def have_vars(self, names):
+        """The subset of `names` this server currently holds — the
+        controller's bootstrap-copy probe before initial placement."""
+        rverb, _, rpayload = self._request(
+            "HAVE", "", json.dumps(list(names)).encode())
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error on HAVE: {rverb}")
+        return set(json.loads(bytes(rpayload)))
+
+    def fence(self, epoch: int):
+        """Two-phase view change, phase 1: quiesce rounds (idempotent —
+        re-fencing an already-fenced server just renews the epoch)."""
+        rverb, _, _ = self._request("FENCE", str(int(epoch)))
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error on FENCE: {rverb}")
+
+    def commit(self, epoch: int, fan_in: Optional[int] = None):
+        """Two-phase view change, phase 2: adopt the view (new fan_in,
+        cleared pre-view grad slots, fence released)."""
+        payload = json.dumps({"fan_in": fan_in}).encode()
+        rverb, _, _ = self._request("COMMIT", str(int(epoch)), payload)
+        if rverb != "OK":
+            raise RuntimeError(f"pserver error on COMMIT: {rverb}")
 
     def send_batch_barrier(self, timeout: Optional[float] = None):
         """Sync-round barrier.  `timeout` (or the instance-level

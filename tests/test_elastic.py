@@ -1,0 +1,1335 @@
+"""Elastic cluster runtime chaos suite (cloud/cluster.py, the
+FENCE/COMMIT/PUT_BATCH/DROP verbs in parallel/pserver.py, and
+comm.elastic_round).
+
+Fast tier: view protocol, membership-driven rebalancing (join/leave,
+snapshot and trainer-held shard recovery), the two-phase view-change
+fence, FaultInjector-driven view-change/migration chaos, master task
+reclamation, and the registry/lease satellites.
+
+Chaos+slow tier: real SIGKILL scenarios — kill a pserver mid-training,
+kill a trainer holding a master task lease, join a pserver mid-run, and
+the 2-pserver x 2-trainer acceptance run that kills one of EACH and
+still converges to the undisturbed run's quality.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.cloud.cluster import (
+    ClusterClient,
+    ClusterController,
+    ClusterView,
+)
+from paddle_tpu.cloud.master import Master, MasterClient, task_record_reader
+from paddle_tpu.cloud.registry import Lease, Registry, RegistryClient
+from paddle_tpu.core.resilience import RetryPolicy, fault_injector
+from paddle_tpu.parallel import comm
+from paddle_tpu.parallel.distributed_spliter import (
+    VarDesc,
+    balanced_split,
+    placement_map,
+)
+from paddle_tpu.parallel.pserver import VariableClient, VariableServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _elastic_teardown():
+    yield
+    comm.reset_cluster()
+    comm.reset_comm_pool()
+
+
+def _sgd_server(params, fan_in=1, lr=0.1, snapshot_dir=None,
+                snapshot_every=0, init=None):
+    """Elastic VariableServer over an sgd-per-param optimize program.
+    `params`: {name: init ndarray} (grads are `<name>@GRAD`)."""
+    scope = fluid.Scope()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        blk.create_var(name="lr", shape=[1], dtype="float32",
+                       persistable=True)
+        for n, v in params.items():
+            blk.create_var(name=n, shape=list(v.shape), dtype="float32",
+                           persistable=True)
+            blk.create_var(name=n + "@GRAD", shape=list(v.shape),
+                           dtype="float32", persistable=True)
+            blk.append_op("sgd",
+                          {"Param": [n], "Grad": [n + "@GRAD"],
+                           "LearningRate": ["lr"]},
+                          {"ParamOut": [n]}, {})
+    scope.set_var("lr", np.asarray([lr], np.float32))
+    for n, v in (init or params).items():
+        scope.set_var(n, v.copy())
+    srv = VariableServer(prog, scope, fluid.Executor(fluid.CPUPlace()),
+                         fan_in=fan_in, sync=True, elastic=True,
+                         snapshot_dir=snapshot_dir,
+                         snapshot_every=snapshot_every)
+    port = srv.serve(0)
+    return srv, f"127.0.0.1:{port}"
+
+
+def _controller(params, **kw):
+    """Controller with its own registry, var descs pre-defined."""
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("push_timeout_s", 0.5)
+    ctl = ClusterController(**kw)
+    ctl.serve(0)
+    ctl.start()
+    ctl.define([VarDesc(n, tuple(v.shape), "float32")
+                for n, v in sorted(params.items())])
+    return ctl
+
+
+def _lease(ctl, kind, ep, ttl_s=0.4):
+    return Lease(RegistryClient(ctl.registry_addr), kind, ep, ttl_s=ttl_s)
+
+
+def _wait(pred, timeout_s=15.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+PARAMS4 = {f"w{i}": np.full(8, float(i + 1), np.float32)
+           for i in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# views + placement
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_view_json_roundtrip():
+    v = ClusterView(epoch=7, status="rebalancing",
+                    pservers={0: "a:1", 2: "c:3"}, trainers={1: "t:9"},
+                    placement={"w": "a:1"}, fan_in=2, needed=["w"],
+                    registry="r:5")
+    w = ClusterView.from_json(v.to_json())
+    assert (w.epoch, w.status, w.pservers, w.trainers, w.placement,
+            w.fan_in, w.needed, w.registry) == (
+        7, "rebalancing", {0: "a:1", 2: "c:3"}, {1: "t:9"},
+        {"w": "a:1"}, 2, ["w"], "r:5")
+    assert w.endpoints == ["a:1", "c:3"]  # slot order, not dict order
+
+
+def test_placement_map_is_deterministic_and_total():
+    descs = [VarDesc(f"v{i}", (i + 1, 4), "float32") for i in range(9)]
+    eps = ["h:1", "h:2", "h:3"]
+    p1 = placement_map(descs, eps)
+    p2 = placement_map(list(descs), list(eps))
+    assert p1 == p2  # same inputs -> same placement in every process
+    assert set(p1) == {d.name for d in descs}
+    assert set(p1.values()) <= set(eps)
+    assert p1 == dict(zip([d.name for d in descs],
+                          balanced_split(descs, eps)))
+
+
+# ---------------------------------------------------------------------------
+# membership-driven rebalancing (in-process, fast)
+# ---------------------------------------------------------------------------
+
+
+def test_bootstrap_copies_consolidate_onto_placed_owners():
+    """Initial placement runs over registry-index order, which need not
+    match the transpile-time layout that seeded the bootstrap copies: a
+    var whose ONLY copy sits on a non-owner must be moved to its placed
+    owner during the first view change (HAVE probe + PUT_BATCH), or
+    every round's GET hits a server that never held it."""
+    params = {f"w{i}": np.full(8, float(i + 1), np.float32)
+              for i in range(4)}
+    srv1, ep1 = _sgd_server(params)   # holds ALL bootstrap copies
+    srv2, ep2 = _sgd_server(params)   # holds NONE (blank member)
+    for n in params:
+        if srv2.scope.has_var(n):
+            srv2.scope.erase(n)
+    ctl = _controller(params, min_pservers=2)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        l2 = _lease(ctl, "pserver", ep2)
+        v = ctl.wait_view(1, timeout_s=15)
+        assert v is not None and len(v.pservers) == 2
+        # the split really uses both members, so some placed owner
+        # started without its var
+        assert set(v.placement.values()) == {ep1, ep2}
+        for name, ep in v.placement.items():
+            c = VariableClient(ep, client_id="probe")
+            try:
+                got = np.asarray(c.get_vars([name])[0])
+            finally:
+                c.close()
+            np.testing.assert_array_equal(got, params[name])
+        l1.release()
+        l2.release()
+    finally:
+        srv1.stop()
+        srv2.stop()
+        ctl.close()
+
+
+def test_lost_previously_placed_shard_recovers_on_next_change():
+    """A var the last stable view says lives on A but that A no longer
+    holds (state drift from an interrupted earlier transition) is
+    caught by the probe on the next view change and recovered —
+    zero-filled when no snapshot or trainer copy exists — instead of
+    being silently dropped from its last copy or failing every GET
+    until an unrelated membership change."""
+    params = {f"w{i}": np.full(8, float(i + 1), np.float32)
+              for i in range(4)}
+    srv1, ep1 = _sgd_server(params)
+    ctl = _controller(params, min_pservers=1)
+    srv2 = None
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and v1.endpoints == [ep1]
+        # drift: the owner of record loses one shard behind the
+        # controller's back
+        srv1.scope.erase("w0")
+        srv2, ep2 = _sgd_server(params)
+        for n in params:  # blank joiner: migration is the only source
+            if srv2.scope.has_var(n):
+                srv2.scope.erase(n)
+        l2 = _lease(ctl, "pserver", ep2)
+        v2 = ctl.wait_view(v1.epoch + 1, timeout_s=15)
+        assert v2 is not None and len(v2.pservers) == 2
+        for name, ep in v2.placement.items():
+            c = VariableClient(ep, client_id="probe2")
+            try:
+                got = np.asarray(c.get_vars([name])[0])
+            finally:
+                c.close()
+            expect = (np.zeros(8, np.float32) if name == "w0"
+                      else params[name])
+            np.testing.assert_array_equal(got, expect)
+        l1.release()
+        l2.release()
+    finally:
+        srv1.stop()
+        if srv2 is not None:
+            srv2.stop()
+        ctl.close()
+
+
+def test_join_rebalances_and_migrates_shards():
+    """A pserver joining mid-run triggers a fence->migrate->commit view
+    change: placement re-splits over both endpoints and the migrated
+    shards carry their TRAINED values (not the joiner's init)."""
+    from paddle_tpu.observability import exporters
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    was = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)
+    srv1, ep1 = _sgd_server(PARAMS4)
+    ctl = _controller(PARAMS4, min_pservers=1)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and v1.endpoints == [ep1]
+
+        cc = ClusterClient(ctl.addr)
+        comm.set_cluster(cc)
+        sends = [(n, n + "@GRAD", np.full(8, 0.5, np.float32),
+                  v1.placement[n]) for n in PARAMS4]
+        gets = [(n, n, v1.placement[n]) for n in PARAMS4]
+        outs = comm.elastic_round(sends, gets)
+        for n, o in zip(PARAMS4, outs):
+            np.testing.assert_allclose(np.asarray(o),
+                                       PARAMS4[n] - 0.05, rtol=1e-6)
+
+        # join: a second pserver registers with BLANK values — only
+        # migration can give it the trained ones
+        srv2, ep2 = _sgd_server(
+            PARAMS4, init={n: np.zeros(8, np.float32) for n in PARAMS4})
+        l2 = _lease(ctl, "pserver", ep2)
+        v2 = ctl.wait_view(v1.epoch + 1, timeout_s=10)
+        assert v2 is not None
+        assert sorted(v2.endpoints) == sorted([ep1, ep2])
+        assert set(v2.placement.values()) == {ep1, ep2}  # really split
+
+        outs = comm.elastic_round([], [(n, n, v2.placement[n])
+                                       for n in PARAMS4])
+        for n, o in zip(PARAMS4, outs):
+            np.testing.assert_allclose(np.asarray(o),
+                                       PARAMS4[n] - 0.05, rtol=1e-6)
+
+        text = exporters.prometheus_text()
+        for series in ("paddle_tpu_cluster_view_epoch",
+                       "paddle_tpu_cluster_membership_changes_total",
+                       "paddle_tpu_cluster_rebalances_total",
+                       "paddle_tpu_cluster_rebalance_seconds",
+                       "paddle_tpu_cluster_shard_migration_bytes_total"):
+            assert series in text, series
+        l1.release()
+        l2.release()
+        srv2.stop()
+    finally:
+        obs_metrics.set_enabled(was)
+        srv1.stop()
+        ctl.close()
+
+
+def test_dead_pserver_shards_restore_from_snapshot(tmp_path):
+    """A pserver that dies WITHOUT releasing its lease (SIGKILL
+    semantics: heartbeats just stop) is evicted by TTL expiry and its
+    shards come back from its latest snapshot."""
+    snap = {0: str(tmp_path / "ps0"), 1: str(tmp_path / "ps1")}
+    srv1, ep1 = _sgd_server(PARAMS4, snapshot_dir=snap[0],
+                            snapshot_every=1)
+    srv2, ep2 = _sgd_server(PARAMS4, snapshot_dir=snap[1],
+                            snapshot_every=1)
+    ctl = _controller(PARAMS4, min_pservers=2, snapshot_dirs=snap)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        l2 = _lease(ctl, "pserver", ep2)
+        assert l1.index == 0 and l2.index == 1  # snapshot_dirs keys
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and len(v1.pservers) == 2
+
+        cc = ClusterClient(ctl.addr)
+        comm.set_cluster(cc)
+        sends = [(n, n + "@GRAD", np.full(8, 0.5, np.float32),
+                  v1.placement[n]) for n in PARAMS4]
+        gets = [(n, n, v1.placement[n]) for n in PARAMS4]
+        comm.elastic_round(sends, gets)  # round 1 -> snapshots written
+
+        dead = {n for n, e in v1.placement.items() if e == ep2}
+        assert dead  # the balanced split used both servers
+        srv2.stop()       # crash: sockets die...
+        l2._stop.set()    # ...and heartbeats stop; NO deregister
+        v2 = ctl.wait_view(v1.epoch + 1, timeout_s=15)
+        assert v2 is not None and v2.endpoints == [ep1]
+
+        outs = comm.elastic_round([], [(n, n, v2.placement[n])
+                                       for n in PARAMS4])
+        for n, o in zip(PARAMS4, outs):
+            np.testing.assert_allclose(np.asarray(o),
+                                       PARAMS4[n] - 0.05, rtol=1e-6)
+        l1.release()
+    finally:
+        srv1.stop()
+        ctl.close()
+
+
+def test_total_pserver_loss_then_replacement_restores(tmp_path):
+    """ALL pservers dying stalls the cluster in a non-stable view, but
+    the controller keeps the last stable view for migration sourcing —
+    a replacement that joins later gets the dead member's shards from
+    its snapshot instead of the controller forgetting who owned what."""
+    snap = {0: str(tmp_path / "ps0")}
+    srv1, ep1 = _sgd_server(PARAMS4, snapshot_dir=snap[0],
+                            snapshot_every=1)
+    ctl = _controller(PARAMS4, min_pservers=1, snapshot_dirs=snap)
+    srv2 = None
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        assert l1.index == 0  # snapshot_dirs key
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and v1.endpoints == [ep1]
+
+        cc = ClusterClient(ctl.addr)
+        comm.set_cluster(cc)
+        sends = [(n, n + "@GRAD", np.full(8, 0.5, np.float32),
+                  v1.placement[n]) for n in PARAMS4]
+        comm.elastic_round(sends, [])  # round 1 -> snapshot written
+
+        srv1.stop()
+        l1._stop.set()  # SIGKILL semantics: lease expires by TTL
+        _wait(lambda: (ctl.view().status == "rebalancing"
+                       and not ctl.view().pservers),
+              timeout_s=15, what="all-dead stall view")
+        assert ctl.view().placement  # last known placement rides along
+
+        # replacement joins BLANK — only snapshot recovery can fill it
+        srv2, ep2 = _sgd_server(
+            PARAMS4, init={n: np.zeros(8, np.float32) for n in PARAMS4})
+        l2 = _lease(ctl, "pserver", ep2)
+        _wait(lambda: (ctl.view().status == "stable"
+                       and ctl.view().endpoints == [ep2]),
+              timeout_s=15, what="post-replacement stable view")
+        v2 = ctl.view()
+        outs = comm.elastic_round([], [(n, n, v2.placement[n])
+                                       for n in PARAMS4])
+        for n, o in zip(PARAMS4, outs):
+            np.testing.assert_allclose(np.asarray(o),
+                                       PARAMS4[n] - 0.05, rtol=1e-6)
+        l2.release()
+    finally:
+        srv1.stop()
+        if srv2 is not None:
+            srv2.stop()
+        ctl.close()
+
+
+def test_trainer_only_change_commits_without_fence():
+    """Trainer join/leave with an unchanged pserver set adopts the new
+    fan-in through a single COMMIT per pserver: no fence, no shard
+    migration, placement byte-identical."""
+    srv, ep = _sgd_server(PARAMS4)
+    ctl = _controller(PARAMS4, min_pservers=1)
+    try:
+        lp = _lease(ctl, "pserver", ep)
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and v1.endpoints == [ep]
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "full fence/migrate path taken for trainer-only churn")
+
+        ctl._migrate = boom
+        lt1 = _lease(ctl, "trainer", "t:1")
+        v2 = ctl.wait_view(v1.epoch + 1, timeout_s=10)
+        assert v2 is not None and v2.fan_in == 1
+        assert v2.placement == v1.placement
+        _wait(lambda: srv.fan_in == 1, what="fan_in commit")
+
+        lt2 = _lease(ctl, "trainer", "t:2")
+        v3 = ctl.wait_view(v2.epoch + 1, timeout_s=10)
+        assert v3 is not None and v3.fan_in == 2
+        _wait(lambda: srv.fan_in == 2, what="fan_in grows on join")
+
+        lt1.release()  # clean leave: slot freed immediately
+        v4 = ctl.wait_view(v3.epoch + 1, timeout_s=10)
+        assert v4 is not None and v4.fan_in == 1
+        _wait(lambda: srv.fan_in == 1, what="fan_in shrinks on leave")
+        lt2.release()
+    finally:
+        srv.stop()
+        ctl.close()
+
+
+def test_snapshotless_death_recovers_from_trainer_copy():
+    """No snapshot anywhere: the controller publishes the transition
+    view with the lost names in `needed` and a subscribed trainer's
+    param provider pushes its local copies to the new owners."""
+    srv1, ep1 = _sgd_server(PARAMS4)
+    srv2, ep2 = _sgd_server(PARAMS4)
+    ctl = _controller(PARAMS4, min_pservers=2, push_timeout_s=5.0)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        l2 = _lease(ctl, "pserver", ep2)
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and len(v1.pservers) == 2
+
+        cc = ClusterClient(ctl.addr)
+        comm.set_cluster(cc)
+        sends = [(n, n + "@GRAD", np.full(8, 0.5, np.float32),
+                  v1.placement[n]) for n in PARAMS4]
+        gets = [(n, n, v1.placement[n]) for n in PARAMS4]
+        comm.elastic_round(sends, gets)
+
+        # the trainer's local copies (as a data-path scope would hold)
+        held = {n: PARAMS4[n] - 0.05 for n in PARAMS4}
+        cc.set_param_provider(lambda name: held.get(name))
+
+        srv2.stop()
+        l2._stop.set()  # SIGKILL semantics: lease expires by TTL
+
+        # participate in the rebalance: ready_view polls, sees the
+        # "rebalancing" view, pushes the needed shards, and returns the
+        # committed stable view
+        def stable_single():
+            v = cc.ready_view(timeout_s=20)
+            return v.epoch > v1.epoch and v.endpoints == [ep1]
+
+        _wait(stable_single, timeout_s=20, what="post-crash stable view")
+        v2 = cc.ready_view(timeout_s=10)
+        outs = comm.elastic_round([], [(n, n, v2.placement[n])
+                                       for n in PARAMS4])
+        for n, o in zip(PARAMS4, outs):
+            np.testing.assert_allclose(np.asarray(o), held[n], rtol=1e-6)
+        l1.release()
+    finally:
+        srv1.stop()
+        ctl.close()
+
+
+def test_elastic_round_retries_against_fresh_view():
+    """A round that dies mid-flight (dead endpoint) waits for the next
+    stable view and replays against the new placement — the caller
+    never sees the failure."""
+    from paddle_tpu.observability import exporters
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    was = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)
+    srv1, ep1 = _sgd_server(PARAMS4)
+    srv2, ep2 = _sgd_server(PARAMS4)
+    ctl = _controller(PARAMS4, min_pservers=2, push_timeout_s=10.0)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        l2 = _lease(ctl, "pserver", ep2)
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None and len(v1.pservers) == 2
+        cc = ClusterClient(ctl.addr)
+        comm.set_cluster(cc)
+        cc.set_param_provider(lambda name: PARAMS4.get(name))
+
+        # crash ep2 BEFORE the round: the first attempt fails against
+        # the stale placement, the retry lands on the survivor
+        srv2.stop()
+        l2._stop.set()
+        sends = [(n, n + "@GRAD", np.full(8, 0.5, np.float32),
+                  v1.placement[n]) for n in PARAMS4]
+        gets = [(n, n, v1.placement[n]) for n in PARAMS4]
+        outs = comm.elastic_round(sends, gets)
+        for n, o in zip(PARAMS4, outs):
+            # at-least-once delivery: the dead shard recovers from the
+            # trainer-held copy and applies the replayed grad exactly
+            # once; the SURVIVOR's shard applies it once or twice
+            # depending on whether the first attempt's barrier beat the
+            # view-change fence (a fenced round is cleared at COMMIT)
+            got = np.asarray(o)
+            if v1.placement[n] == ep1:
+                ok = any(np.allclose(got, PARAMS4[n] - k * 0.05,
+                                     rtol=1e-6) for k in (1, 2))
+                assert ok, (n, got[0])
+            else:
+                np.testing.assert_allclose(got, PARAMS4[n] - 0.05,
+                                           rtol=1e-6)
+        assert cc.ready_view(timeout_s=5).endpoints == [ep1]
+        assert ("paddle_tpu_comm_round_retries_total"
+                in exporters.prometheus_text())
+        l1.release()
+    finally:
+        obs_metrics.set_enabled(was)
+        srv1.stop()
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# two-phase view-change fence (pserver verbs)
+# ---------------------------------------------------------------------------
+
+
+def test_fence_blocks_rounds_until_commit():
+    """Between FENCE and COMMIT no optimize may run: a barrier arriving
+    mid-transition holds, and COMMIT releases it WITHOUT applying the
+    pre-view grads (the round is lost — at-least-once sync SGD)."""
+    params = {"w": np.full(4, 2.0, np.float32)}
+    srv, ep = _sgd_server(params)
+    c = VariableClient(ep, client_id="t0")
+    try:
+        c.fence(epoch=1)
+        state = {"done": False}
+
+        def round_():
+            c2 = VariableClient(ep, client_id="t0")
+            c2.send_vars([("w@GRAD", np.ones(4, np.float32))])
+            c2.send_batch_barrier()
+            state["done"] = True
+            c2.close()
+
+        t = threading.Thread(target=round_, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        assert not state["done"]  # fenced: the barrier is held
+        c.commit(epoch=1, fan_in=1)
+        t.join(timeout=10)
+        assert state["done"]
+        # the fenced round's grads were cleared at COMMIT: w unchanged
+        np.testing.assert_allclose(np.asarray(c.get_vars(["w"])[0]),
+                                   params["w"], rtol=1e-6)
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_commit_shrinks_fan_in_and_releases_waiters():
+    """fan_in=2 with one trainer dead: the survivor's barrier blocks on
+    the missing peer until COMMIT adopts fan_in=1 — then it returns
+    (losing the round) and the NEXT round optimizes alone."""
+    params = {"w": np.full(4, 2.0, np.float32)}
+    srv, ep = _sgd_server(params, fan_in=2)
+    c = VariableClient(ep, client_id="survivor")
+    try:
+        state = {"done": False}
+
+        def round_():
+            c.send_vars([("w@GRAD", np.ones(4, np.float32))])
+            c.send_batch_barrier()
+            state["done"] = True
+
+        t = threading.Thread(target=round_, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        assert not state["done"]  # waiting for the dead peer
+        ctl_c = VariableClient(ep, client_id="ctl")
+        ctl_c.fence(epoch=2)
+        ctl_c.commit(epoch=2, fan_in=1)
+        ctl_c.close()
+        t.join(timeout=10)
+        assert state["done"]
+        np.testing.assert_allclose(np.asarray(c.get_vars(["w"])[0]),
+                                   params["w"], rtol=1e-6)  # round lost
+        # next round runs at the NEW fan-in: one barrier optimizes
+        c.send_vars([("w@GRAD", np.ones(4, np.float32))])
+        c.send_batch_barrier()
+        np.testing.assert_allclose(np.asarray(c.get_vars(["w"])[0]),
+                                   params["w"] - 0.1, rtol=1e-6)
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_put_and_drop_verbs():
+    """PUT_BATCH installs canonical values (no per-trainer rename);
+    DROP erases the var and its stale per-trainer grad slots."""
+    params = {"w": np.full(4, 2.0, np.float32)}
+    srv, ep = _sgd_server(params)
+    c = VariableClient(ep, client_id="t0")
+    try:
+        moved = c.put_vars([("fresh", np.arange(4, dtype=np.float32))])
+        assert moved > 0
+        np.testing.assert_array_equal(
+            np.asarray(c.get_vars(["fresh"])[0]),
+            np.arange(4, dtype=np.float32))
+        c.send_vars([("w@GRAD", np.ones(4, np.float32))])  # makes a slot
+        c.drop_vars(["w"])
+        assert not srv.scope.has_var("w")
+        assert not any(n.startswith("w@GRAD.trainer_")
+                       for n in srv.scope.local_names())
+        with pytest.raises(RuntimeError):
+            c.get_vars(["w"])
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_fused_send_op_routes_through_view_placement():
+    """The send op's transpile-time epmap becomes a FALLBACK under a
+    cluster subscription: every param routes through the current view,
+    so a program transpiled against yesterday's cluster still lands its
+    grads on today's owners."""
+    params = {"wa": np.full(4, 2.0, np.float32),
+              "wb": np.full(4, 4.0, np.float32)}
+    srv1, ep1 = _sgd_server(params)
+    srv2, ep2 = _sgd_server(params)
+    ctl = _controller(params, min_pservers=2)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        l2 = _lease(ctl, "pserver", ep2)
+        v = ctl.wait_view(1, timeout_s=10)
+        assert v is not None and set(v.placement.values()) == {ep1, ep2}
+        comm.set_cluster(ClusterClient(ctl.addr))
+
+        # deliberately WRONG static epmap: everything points at the
+        # endpoint the view does NOT use for that var
+        other = {ep1: ep2, ep2: ep1}
+        stale = [other[v.placement["wa"]], other[v.placement["wb"]]]
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ga = fluid.layers.data(name="wa@GRAD", shape=[4],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            gb = fluid.layers.data(name="wb@GRAD", shape=[4],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            blk = main.global_block()
+            wa = blk.create_var(name="wa", shape=[4], dtype="float32")
+            wb = blk.create_var(name="wb", shape=[4], dtype="float32")
+            fluid.layers.Send([ep1, ep2], [ga, gb], [wa, wb],
+                              epmap=stale, out_epmap=stale)
+        exe = fluid.Executor(fluid.CPUPlace())
+        oa, ob = exe.run(
+            main,
+            feed={"wa@GRAD": np.ones(4, np.float32),
+                  "wb@GRAD": np.full(4, 2.0, np.float32)},
+            fetch_list=[wa, wb], scope=fluid.Scope())
+        # correct results are only possible if the view overrode the
+        # stale epmap — each server only HOLDS its placed shard
+        np.testing.assert_allclose(np.asarray(oa), 2.0 - 0.1 * 1.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(ob), 4.0 - 0.1 * 2.0,
+                                   rtol=1e-6)
+        l1.release()
+        l2.release()
+    finally:
+        srv1.stop()
+        srv2.stop()
+        ctl.close()
+
+
+def test_trainer_train_cluster_joins_and_releases():
+    """Trainer.train(cluster=...) arms the subscription, registers a
+    trainer lease for the loop's duration (so the controller sees the
+    member and adapts fan-in), publishes the send-op param descs, and
+    frees the slot on clean exit."""
+    params = {"w": np.full(4, 2.0, np.float32)}
+    srv, ep = _sgd_server(params)
+    ctl = _controller(params, min_pservers=1, track_trainers=True)
+    try:
+        l = _lease(ctl, "pserver", ep)
+        assert ctl.wait_view(1, timeout_s=10) is not None
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+        from paddle_tpu.trainer import Trainer
+
+        t = Trainer(loss, optimizer=fluid.SGD(0.1), feed_list=[x, y],
+                    main_program=main, startup_program=startup)
+        rng = np.random.RandomState(0)
+        batch = [(rng.rand(4).astype(np.float32),
+                  rng.rand(1).astype(np.float32)) for _ in range(4)]
+        seen = []
+
+        def handler(event):
+            from paddle_tpu.trainer import EndIteration
+
+            if isinstance(event, EndIteration):
+                # the lease registers before the loop starts, so the
+                # registry must show the member on the FIRST iteration
+                seen.append(dict(ctl._reg.list("trainer")))
+
+        t.train(1, lambda: iter([batch]), event_handler=handler,
+                cluster=ctl.addr)
+        # the no-send-op program publishes no descs, but the lease was
+        # live while training ran...
+        assert seen and all(seen), (
+            f"trainer lease never visible during training: {seen}")
+        # ...and released on exit (freed NOW, not at TTL expiry)
+        _wait(lambda: ctl._reg.list("trainer") == {}, timeout_s=10,
+              what="trainer slot release")
+        # the process-global subscription is restored too: a later
+        # train()/executor run must not route rounds through a
+        # controller that may be gone by then
+        assert comm.get_cluster() is None
+        l.release()
+    finally:
+        srv.stop()
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector-driven view-change chaos (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_view_change_survives_injected_rebalance_fault():
+    """An injected failure at the start of a view change kills that
+    tick, not the control plane: the watcher retries and converges."""
+    fault_injector().inject("cluster.rebalance", "error", nth=1,
+                            exc=RuntimeError("injected rebalance crash"))
+    srv1, ep1 = _sgd_server(PARAMS4)
+    ctl = _controller(PARAMS4, min_pservers=1)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        v = ctl.wait_view(1, timeout_s=15)
+        assert v is not None and v.endpoints == [ep1]
+        l1.release()
+    finally:
+        fault_injector().clear()
+        srv1.stop()
+        ctl.close()
+
+
+def test_shard_migration_survives_injected_migrate_fault():
+    """A failure mid-migration aborts the transition; the retried view
+    change re-reads from the still-live old owners, so no value is
+    lost or doubled."""
+    srv1, ep1 = _sgd_server(PARAMS4)
+    ctl = _controller(PARAMS4, min_pservers=1)
+    try:
+        l1 = _lease(ctl, "pserver", ep1)
+        v1 = ctl.wait_view(1, timeout_s=10)
+        assert v1 is not None
+
+        fault_injector().inject("cluster.migrate", "error", nth=1,
+                                exc=RuntimeError("injected migrate crash"))
+        srv2, ep2 = _sgd_server(
+            PARAMS4, init={n: np.zeros(8, np.float32) for n in PARAMS4})
+        l2 = _lease(ctl, "pserver", ep2)
+        v2 = ctl.wait_view(v1.epoch + 1, timeout_s=15)
+        assert v2 is not None and len(v2.pservers) == 2
+
+        cc = ClusterClient(ctl.addr)
+        comm.set_cluster(cc)
+        outs = comm.elastic_round([], [(n, n, v2.placement[n])
+                                       for n in PARAMS4])
+        for n, o in zip(PARAMS4, outs):
+            np.testing.assert_allclose(np.asarray(o), PARAMS4[n],
+                                       rtol=1e-6)
+        l1.release()
+        l2.release()
+        srv2.stop()
+    finally:
+        fault_injector().clear()
+        srv1.stop()
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# master task reclamation (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestMasterReclaim:
+    def test_expired_lease_reclaims_exactly_once(self):
+        m = Master(failure_max=3, timeout_s=0.2)
+        m.set_dataset([f"c{i}" for i in range(2)], 1)
+        tid, _ = m.get_task()
+        assert m.counts()["pending"] == 1
+        time.sleep(0.3)
+        after = m.reclaim_expired()
+        assert after["pending"] == 0
+        assert after["todo"] + after["done"] == 2  # requeued, not lost
+        # exactly once: a second sweep finds nothing, and the vanished
+        # trainer's LATE ack is rejected as stale instead of
+        # double-counting the failure
+        again = m.reclaim_expired()
+        assert again == after
+        assert m.task_failed(tid) is False
+        assert m.task_finished(tid) is False
+        assert m.counts()["discarded"] == 0
+
+    def test_failure_max_accounting_discards_after_budget(self):
+        m = Master(failure_max=2, timeout_s=0.1)
+        m.set_dataset(["poison"], 1)
+        # each expiry is ONE failure; the task survives failure_max
+        # failures and is discarded on the next one (service.go
+        # processFailedTask: NumFailure > failureMax)
+        for i in range(3):
+            got = m.get_task()
+            assert got is not None, f"task gone after {i} expiries"
+            time.sleep(0.15)
+            counts = m.reclaim_expired()
+            assert counts["pending"] == 0
+        assert counts["discarded"] == 1
+        assert counts["todo"] == 0
+
+
+# ---------------------------------------------------------------------------
+# registry/lease satellites
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryResilience:
+    def test_roundtrip_retries_with_backoff_then_reports(self):
+        c = RegistryClient(
+            "127.0.0.1:1", timeout_s=0.2,
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.01,
+                                     max_delay=0.02, deadline=2.0))
+        with pytest.raises(OSError) as ei:
+            c.list("pserver")
+        assert "2 attempts" in str(ei.value)
+
+    def test_retry_knobs_read_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_REGISTRY_RETRY_MAX_ATTEMPTS", "7")
+        c = RegistryClient("127.0.0.1:1")
+        assert c.policy.max_attempts == 7
+
+    def test_transient_outage_retries_until_registry_appears(self):
+        """The registry being briefly unreachable (restart, boot race)
+        is a retried backoff, not a raw OSError up the stack."""
+        import socket as socket_mod
+
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        c = RegistryClient(
+            f"127.0.0.1:{port}",
+            retry_policy=RetryPolicy(max_attempts=30, base_delay=0.05,
+                                     max_delay=0.1, deadline=15.0))
+        born = {}
+
+        def later():
+            time.sleep(0.4)
+            reg = Registry()
+            reg.serve(port)
+            born["reg"] = reg
+
+        th = threading.Thread(target=later)
+        th.start()
+        try:
+            idx, lease = c.register("pserver", "a:1", ttl_s=5.0)
+            th.join()
+            assert born["reg"].list("pserver") == {idx: "a:1"}
+            assert c.heartbeat("pserver", idx, lease) is True
+        finally:
+            th.join()
+            if "reg" in born:
+                born["reg"].close()
+
+    def test_lease_release_is_idempotent_and_safe_after_close(self):
+        reg = Registry()
+        port = reg.serve(0)
+        c = RegistryClient(
+            f"127.0.0.1:{port}",
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.01,
+                                     max_delay=0.02, deadline=1.0))
+        l = Lease(c, "trainer", "t:1", ttl_s=5.0)
+        assert reg.list("trainer") == {0: "t:1"}
+        l.release()
+        assert reg.list("trainer") == {}  # freed NOW, not at TTL
+        l.release()  # idempotent
+        reg.close()
+        l.release()  # and safe with the registry gone
+        assert l.released
+
+    def test_closed_registry_handle_is_definitive_not_a_crash(self):
+        reg = Registry()
+        reg.serve(0)
+        idx, lease = reg.register("pserver", "a:1", ttl_s=5.0)
+        reg.close()
+        assert reg.heartbeat("pserver", idx, lease) is False
+        assert reg.deregister("pserver", idx, lease) is False
+        assert reg.list("pserver") == {}
+
+    def test_clean_interpreter_exit_frees_slot(self, tmp_path):
+        """The atexit hook releases an unreleased lease on clean exit,
+        so the slot frees immediately instead of waiting out a long
+        TTL."""
+        reg = Registry()
+        port = reg.serve(0)
+        child = tmp_path / "clean_exit.py"
+        child.write_text(
+            "import sys\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from paddle_tpu.cloud.registry import Lease, RegistryClient\n"
+            f"lease = Lease(RegistryClient('127.0.0.1:{port}'),\n"
+            "              'trainer', 't:77', ttl_s=300.0)\n"
+            "print('REGISTERED', flush=True)\n")
+        r = subprocess.run([sys.executable, str(child)],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "REGISTERED" in r.stdout
+        # TTL is 300s: only the atexit release can have freed it
+        _wait(lambda: reg.list("trainer") == {}, timeout_s=5,
+              what="atexit lease release")
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL chaos scenarios (subprocess clusters)
+# ---------------------------------------------------------------------------
+
+_PSERVER_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.cloud.registry import Lease, RegistryClient
+from paddle_tpu.parallel.pserver import VariableServer
+
+reg_addr, snap_dir = sys.argv[1], sys.argv[2]
+blocks = json.loads(sys.argv[3])  # {{name: dim}}
+lr = float(sys.argv[4])
+scope = fluid.Scope()
+prog = fluid.Program()
+with fluid.program_guard(prog, fluid.Program()):
+    blk = prog.global_block()
+    blk.create_var(name="lr", shape=[1], dtype="float32",
+                   persistable=True)
+    for n, d in sorted(blocks.items()):
+        blk.create_var(name=n, shape=[d], dtype="float32",
+                       persistable=True)
+        blk.create_var(name=n + "@GRAD", shape=[d], dtype="float32",
+                       persistable=True)
+        blk.append_op("sgd", {{"Param": [n], "Grad": [n + "@GRAD"],
+                              "LearningRate": ["lr"]}},
+                      {{"ParamOut": [n]}}, {{}})
+scope.set_var("lr", np.asarray([lr], np.float32))
+for n, d in blocks.items():
+    scope.set_var(n, np.zeros(d, np.float32))
+srv = VariableServer(prog, scope, fluid.Executor(fluid.CPUPlace()),
+                     fan_in=1, sync=True, elastic=True,
+                     snapshot_dir=snap_dir or None, snapshot_every=1)
+port = srv.serve(0)
+lease = Lease(RegistryClient(reg_addr), "pserver",
+              "127.0.0.1:%d" % port, ttl_s=1.0)
+print("READY", port, flush=True)
+while True:
+    time.sleep(0.2)
+"""
+
+_TRAINER_CHILD = r"""
+import json, os, signal, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from paddle_tpu.cloud.cluster import ClusterClient
+from paddle_tpu.parallel import comm
+from paddle_tpu.parallel.distributed_spliter import VarDesc
+
+ctl_addr = sys.argv[1]
+idx, n_trainers = int(sys.argv[2]), int(sys.argv[3])
+steps, kill_at = int(sys.argv[4]), int(sys.argv[5])
+out_path, progress_path = sys.argv[6], sys.argv[7]
+blocks = json.loads(sys.argv[8])  # {{name: dim}}
+
+cc = ClusterClient(ctl_addr)
+comm.set_cluster(cc)
+names = sorted(blocks)
+dims = [blocks[n] for n in names]
+cc.define([VarDesc(n, (d,), "float32") for n, d in zip(names, dims)])
+lease = cc.join("trainer", addr="trainer-%d" % idx, ttl_s=1.0)
+
+deadline = time.monotonic() + 60
+while True:  # start only at full strength so round 1 uses fan_in=N
+    view = cc.ready_view(timeout_s=60)
+    if len(view.trainers) >= n_trainers:
+        break
+    if time.monotonic() > deadline:
+        raise SystemExit("membership never completed: %r" % (view,))
+    time.sleep(0.1)
+
+D = sum(dims)
+rng = np.random.RandomState(7)  # SAME data in every run and trainer
+X_all = rng.randn(64, D).astype(np.float32)
+w_true = rng.randn(D).astype(np.float32)
+y_all = X_all @ w_true
+X, y = X_all[idx::n_trainers], y_all[idx::n_trainers]
+
+# trainer-held recovery source: our latest pulled params
+held = {{}}
+cc.set_param_provider(lambda name: held.get(name))
+
+view = cc.ready_view(timeout_s=60)
+vals = comm.elastic_round(
+    [], [(n, n, view.placement.get(n, "")) for n in names])
+w = np.concatenate([np.asarray(v).ravel() for v in vals])
+for step in range(1, steps + 1):
+    if kill_at and step == kill_at:
+        os.kill(os.getpid(), signal.SIGKILL)  # a real crash, no cleanup
+    err = X @ w - y
+    g = (2.0 / len(X)) * (X.T @ err)
+    view = cc.ready_view(timeout_s=120)
+    sends, gets, off = [], [], 0
+    for n, d in zip(names, dims):
+        sends.append((n, n + "@GRAD",
+                      np.ascontiguousarray(g[off:off + d], np.float32),
+                      view.placement.get(n, "")))
+        gets.append((n, n, view.placement.get(n, "")))
+        off += d
+    outs = comm.elastic_round(sends, gets)
+    w = np.concatenate([np.asarray(v).ravel() for v in outs])
+    off = 0
+    for n, d in zip(names, dims):
+        held[n] = np.ascontiguousarray(w[off:off + d], np.float32)
+        off += d
+    with open(progress_path, "w") as f:
+        f.write(str(step))
+    time.sleep(0.02)  # keep kills genuinely mid-training
+
+loss_full = float(np.mean((X_all @ w - y_all) ** 2))
+with open(out_path, "w") as f:
+    json.dump({{"loss": loss_full, "w": [float(t) for t in w]}}, f)
+lease.release()
+print("DONE", loss_full, flush=True)
+"""
+
+_READER_CHILD = r"""
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+from paddle_tpu.cloud.master import MasterClient, task_record_reader
+
+addr, out_path, kill_first = sys.argv[1], sys.argv[2], sys.argv[3]
+c = MasterClient(addr)
+if kill_first == "1":
+    got = c.get_task()   # lease a task and die holding it
+    assert got is not None
+    print("GOT", got[0], flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+records = list(task_record_reader(c, lambda chunk: [chunk],
+                                  poll_interval=0.05)())
+with open(out_path, "w") as f:
+    json.dump(records, f)
+print("DONE", flush=True)
+"""
+
+_BLOCKS = {f"b{i}": 2 for i in range(4)}  # 4 param blocks, D=8
+
+
+def _spawn(script_text, args, tmp_path, name):
+    script = tmp_path / f"{name}.py"
+    if not script.exists():
+        script.write_text(script_text.format(repo=REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_DATASET="synthetic")
+    return subprocess.Popen(
+        [sys.executable, str(script)] + [str(a) for a in args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+
+
+def _wait_ready(proc, what, timeout_s=120):
+    line = ""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith(("READY", "GOT")):
+            return line.split()
+        if proc.poll() is not None:
+            break
+    raise AssertionError(
+        f"{what} never came up (rc={proc.poll()}): "
+        f"{line!r}\n{proc.stderr.read() if proc.stderr else ''}")
+
+
+def _progress(path):
+    try:
+        return int(open(path).read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _reap(*procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=30)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestSigkillScenarios:
+    def test_sigkill_pserver_mid_training(self, tmp_path):
+        """2 pserver children, parent-side trainer: SIGKILL one pserver
+        mid-run.  The trainer's rounds retry against the rebalanced
+        view (shards restored from the dead member's snapshot) and
+        training converges without any process restart."""
+        snap = {0: str(tmp_path / "ps0"), 1: str(tmp_path / "ps1")}
+        ctl = _controller({n: np.zeros(d, np.float32)
+                           for n, d in _BLOCKS.items()},
+                          min_pservers=2, snapshot_dirs=snap,
+                          push_timeout_s=2.0)
+        ps = [_spawn(_PSERVER_CHILD,
+                     [ctl.registry_addr, snap[i], json.dumps(_BLOCKS),
+                      0.05], tmp_path, "pserver_child")
+              for i in range(2)]
+        try:
+            for i, p in enumerate(ps):
+                _wait_ready(p, f"pserver {i}")
+            v1 = ctl.wait_view(1, timeout_s=30)
+            assert v1 is not None and len(v1.pservers) == 2
+
+            cc = ClusterClient(ctl.addr)
+            comm.set_cluster(cc)
+            names = sorted(_BLOCKS)
+            dims = [_BLOCKS[n] for n in names]
+            D = sum(dims)
+            rng = np.random.RandomState(7)
+            X = rng.randn(64, D).astype(np.float32)
+            w_true = rng.randn(D).astype(np.float32)
+            y = X @ w_true
+            w = np.zeros(D, np.float32)
+            for step in range(80):
+                if step == 10:
+                    ps[1].kill()  # SIGKILL, lease expires by TTL
+                err = X @ w - y
+                g = (2.0 / len(X)) * (X.T @ err)
+                view = cc.ready_view(timeout_s=60)
+                sends, gets, off = [], [], 0
+                for n, d in zip(names, dims):
+                    sends.append((n, n + "@GRAD",
+                                  np.ascontiguousarray(g[off:off + d],
+                                                       np.float32),
+                                  view.placement.get(n, "")))
+                    gets.append((n, n, view.placement.get(n, "")))
+                    off += d
+                outs = comm.elastic_round(sends, gets)
+                w = np.concatenate([np.asarray(o).ravel() for o in outs])
+            final = cc.ready_view(timeout_s=10)
+            assert final.endpoints != v1.endpoints  # really rebalanced
+            loss = float(np.mean((X @ w - y) ** 2))
+            assert loss < 0.05, f"did not converge through the kill: {loss}"
+        finally:
+            _reap(*ps)
+            ctl.close()
+
+    def test_sigkill_trainer_master_reclaims_task(self, tmp_path):
+        """A trainer SIGKILLed while holding a task lease: after
+        timeout_s the master requeues it exactly once and a surviving
+        reader finishes the pass with no chunk lost or duplicated."""
+        m = Master(failure_max=3, timeout_s=1.0)
+        chunks = [f"chunk-{i}" for i in range(8)]
+        m.set_dataset(chunks, 1)
+        port = m.serve(0)
+        addr = f"127.0.0.1:{port}"
+        out = tmp_path / "survivor.json"
+
+        victim = _spawn(_READER_CHILD, [addr, tmp_path / "v.json", 1],
+                        tmp_path, "reader_child")
+        try:
+            _wait_ready(victim, "victim reader")  # GOT <tid>, then dead
+            victim.wait(timeout=30)
+            assert victim.returncode == -9
+            assert m.counts()["pending"] == 1  # dies holding the lease
+
+            survivor = _spawn(_READER_CHILD, [addr, out, 0],
+                              tmp_path, "reader_child")
+            rc = survivor.wait(timeout=120)
+            assert rc == 0, survivor.stderr.read()
+            got = json.loads(out.read_text())
+            assert sorted(got) == sorted(chunks)  # all EXACTLY once
+            counts = m.reclaim_expired()
+            assert counts["pending"] == 0 and counts["todo"] == 0
+            assert counts["done"] == len(chunks)
+            assert counts["discarded"] == 0
+        finally:
+            _reap(victim)
+            m.stop()
+
+    def test_pserver_join_mid_run(self, tmp_path):
+        """Capacity added live: a second pserver joins mid-training,
+        the view re-splits placement over both, shards migrate, and
+        training continues seamlessly."""
+        ctl = _controller({n: np.zeros(d, np.float32)
+                           for n, d in _BLOCKS.items()},
+                          min_pservers=1, push_timeout_s=2.0)
+        p0 = _spawn(_PSERVER_CHILD,
+                    [ctl.registry_addr, "", json.dumps(_BLOCKS), 0.05],
+                    tmp_path, "pserver_child")
+        procs = [p0]
+        try:
+            _wait_ready(p0, "pserver 0")
+            v1 = ctl.wait_view(1, timeout_s=30)
+            assert v1 is not None and len(v1.pservers) == 1
+
+            cc = ClusterClient(ctl.addr)
+            comm.set_cluster(cc)
+            names = sorted(_BLOCKS)
+            dims = [_BLOCKS[n] for n in names]
+            D = sum(dims)
+            rng = np.random.RandomState(7)
+            X = rng.randn(64, D).astype(np.float32)
+            w_true = rng.randn(D).astype(np.float32)
+            y = X @ w_true
+            w = np.zeros(D, np.float32)
+            joined_epoch = None
+            for step in range(80):
+                if step == 10:
+                    p1 = _spawn(_PSERVER_CHILD,
+                                [ctl.registry_addr, "",
+                                 json.dumps(_BLOCKS), 0.05],
+                                tmp_path, "pserver_child")
+                    procs.append(p1)
+                    _wait_ready(p1, "joining pserver")
+                err = X @ w - y
+                g = (2.0 / len(X)) * (X.T @ err)
+                view = cc.ready_view(timeout_s=60)
+                if len(view.pservers) == 2 and joined_epoch is None:
+                    joined_epoch = view.epoch
+                sends, gets, off = [], [], 0
+                for n, d in zip(names, dims):
+                    sends.append((n, n + "@GRAD",
+                                  np.ascontiguousarray(g[off:off + d],
+                                                       np.float32),
+                                  view.placement.get(n, "")))
+                    gets.append((n, n, view.placement.get(n, "")))
+                    off += d
+                outs = comm.elastic_round(sends, gets)
+                w = np.concatenate([np.asarray(o).ravel() for o in outs])
+            final = cc.ready_view(timeout_s=10)
+            assert len(final.pservers) == 2, "join never landed"
+            assert joined_epoch is not None
+            assert len(set(final.placement.values())) == 2  # re-split
+            loss = float(np.mean((X @ w - y) ** 2))
+            assert loss < 0.05, f"did not converge through the join: {loss}"
+        finally:
+            _reap(*procs)
+            ctl.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_acceptance_kill_one_pserver_and_one_trainer(tmp_path):
+    """ISSUE 7 acceptance: a 2-pserver x 2-trainer cluster loses one
+    pserver AND one trainer to SIGKILL mid-training; the surviving
+    processes finish without restart and match the undisturbed run's
+    quality, with the view/rebalance telemetry in a Prometheus dump."""
+    from paddle_tpu.observability import exporters
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    names = sorted(_BLOCKS)
+    dims = [_BLOCKS[n] for n in names]
+    D = sum(dims)
+    rng = np.random.RandomState(7)  # mirrors _TRAINER_CHILD
+    X_all = rng.randn(64, D).astype(np.float32)
+    w_true = rng.randn(D).astype(np.float32)
+    y_all = X_all @ w_true
+
+    def run_cluster(tag, kill):
+        snap = {i: str(tmp_path / f"{tag}-ps{i}") for i in range(2)}
+        ctl = _controller({n: np.zeros(d, np.float32)
+                           for n, d in _BLOCKS.items()},
+                          min_pservers=2, snapshot_dirs=snap,
+                          push_timeout_s=2.0)
+        ps, tr = [], []
+        try:
+            for i in range(2):
+                p = _spawn(_PSERVER_CHILD,
+                           [ctl.registry_addr, snap[i],
+                            json.dumps(_BLOCKS), 0.05],
+                           tmp_path, "pserver_child")
+                ps.append(p)
+                _wait_ready(p, f"{tag} pserver {i}")
+            assert ctl.wait_view(1, timeout_s=30) is not None
+
+            outs = [tmp_path / f"{tag}-t{i}.json" for i in range(2)]
+            progress = [tmp_path / f"{tag}-t{i}.progress"
+                        for i in range(2)]
+            steps = 120
+            for i in range(2):
+                # trainer 1 SIGKILLs itself at step 30 in the kill run
+                kill_at = 30 if (kill and i == 1) else 0
+                tr.append(_spawn(
+                    _TRAINER_CHILD,
+                    [ctl.addr, i, 2, steps, kill_at, outs[i],
+                     progress[i], json.dumps(_BLOCKS)],
+                    tmp_path, "trainer_child"))
+            if kill:
+                # SIGKILL a pserver once training is genuinely underway
+                _wait(lambda: _progress(progress[0]) >= 10,
+                      timeout_s=120, what="training to reach step 10")
+                ps[1].kill()
+            rc = tr[0].wait(timeout=300)
+            assert rc == 0, f"{tag} trainer 0 died: {tr[0].stderr.read()}"
+            if kill:
+                tr[1].wait(timeout=60)
+                assert tr[1].returncode == -9  # genuinely SIGKILLed
+            else:
+                assert tr[1].wait(timeout=300) == 0
+            result = json.loads(outs[0].read_text())
+            w = np.asarray(result["w"], np.float32)
+            return float(np.mean((X_all @ w - y_all) ** 2))
+        finally:
+            _reap(*(ps + tr))
+            ctl.close()
+
+    was = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)
+    try:
+        undisturbed = run_cluster("calm", kill=False)
+        disturbed = run_cluster("chaos", kill=True)
+        # survivors converge to the undisturbed run's quality: the lost
+        # rounds cost iterations, not correctness
+        assert disturbed < max(undisturbed + 0.05, 0.05), (
+            f"chaos run lost quality: {disturbed} vs {undisturbed}")
+        text = exporters.prometheus_text()
+        assert "paddle_tpu_cluster_view_epoch" in text
+        assert "paddle_tpu_cluster_rebalances_total" in text
+        assert "paddle_tpu_cluster_membership_changes_total" in text
+    finally:
+        obs_metrics.set_enabled(was)

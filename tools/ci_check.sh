@@ -12,17 +12,17 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 export PADDLE_TPU_DATASET="${PADDLE_TPU_DATASET:-synthetic}"
 
-echo "== [1/5] repo lint (tools/lint.py) =="
+echo "== [1/6] repo lint (tools/lint.py) =="
 python tools/lint.py
 
-echo "== [2/5] static verification of example programs =="
+echo "== [2/6] static verification of example programs =="
 python -m paddle_tpu.cli verify \
     examples/transformer_lm.py \
     examples/pipeline_transformer_lm.py \
     examples/serve_image_classifier.py \
     examples/dist_ckpt_worker.py
 
-echo "== [3/5] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
+echo "== [3/6] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
 PADDLE_TPU_VERIFY=error python -m pytest \
     tests/test_analysis.py \
     tests/test_registry.py \
@@ -37,7 +37,7 @@ PADDLE_TPU_VERIFY=error python -m pytest \
 # flake — it fails identically on the pre-PR tree, unrelated to
 # verification)
 
-echo "== [4/5] observability + comm subset with PADDLE_TPU_METRICS=on =="
+echo "== [4/6] observability + comm subset with PADDLE_TPU_METRICS=on =="
 # the instrumented hot paths must behave identically with the metric
 # instruments armed (docs/observability.md); test_comm.py also pins the
 # bucketed wire path's backward compatibility both directions
@@ -49,7 +49,7 @@ PADDLE_TPU_METRICS=on python -m pytest \
     tests/test_comm.py \
     -q -m 'not slow' -p no:cacheprovider
 
-echo "== [5/5] memory layer: fast book subset + memory plan with the optimizer armed =="
+echo "== [5/6] memory layer: fast book subset + memory plan with the optimizer armed =="
 # the whole-program memory layer (donation plan, dead-var freeing,
 # rename pass — docs/performance.md 'Memory') must leave training
 # semantics untouched with the verifier also armed: the book models
@@ -61,5 +61,44 @@ PADDLE_TPU_MEMORY_OPTIMIZE=on PADDLE_TPU_VERIFY=error python -m pytest \
     tests/test_memory_optimize.py \
     tests/test_memory_plan.py \
     -q -p no:cacheprovider
+
+
+echo "== [6/6] elastic cluster: fast subset under chaos + metrics =="
+# the elastic runtime (docs/resilience.md "Elastic clusters") must hold
+# with the fault injector armed and the metric instruments on: the
+# injected first-rebalance failure is retried by the controller's watch
+# loop, and every view change/migration still lands its telemetry
+PADDLE_TPU_FAULTS="cluster.rebalance:error:1" PADDLE_TPU_METRICS=on \
+    python -m pytest \
+    tests/test_elastic.py \
+    -q -m 'not slow' -p no:cacheprovider
+# the rebalance counters must be visible in a Prometheus dump
+PADDLE_TPU_METRICS=on python - <<'EOF'
+import numpy as np
+from paddle_tpu.cloud.cluster import ClusterController
+from paddle_tpu.cloud.registry import Lease, RegistryClient
+from paddle_tpu.observability import exporters
+from paddle_tpu.parallel.distributed_spliter import VarDesc
+from tests.test_elastic import _sgd_server
+
+params = {"w": np.ones(8, np.float32)}
+srv, ep = _sgd_server(params)
+ctl = ClusterController(min_pservers=1, poll_s=0.05)
+ctl.serve(0)
+ctl.start()
+ctl.define([VarDesc("w", (8,), "float32")])
+lease = Lease(RegistryClient(ctl.registry_addr), "pserver", ep, ttl_s=2.0)
+assert ctl.wait_view(1, timeout_s=15) is not None, "no stable view"
+text = exporters.prometheus_text()
+for series in ("paddle_tpu_cluster_view_epoch",
+               "paddle_tpu_cluster_rebalances_total",
+               "paddle_tpu_cluster_membership_changes_total",
+               "paddle_tpu_cluster_rebalance_seconds"):
+    assert series in text, f"missing {series} in Prometheus dump"
+lease.release()
+srv.stop()
+ctl.close()
+print("elastic telemetry visible in Prometheus dump")
+EOF
 
 echo "ci_check: all green"
