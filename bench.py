@@ -40,7 +40,11 @@ pipeline microbench: serial vs prefetch-depth-N + lazy-fetch steps/s
 with the host-blocked fraction of each loop; BENCH_PREFETCH_ITERS
 steps), BENCH_COMM=1 (pserver comm microbench: per-var serial wire
 path vs bucketed+concurrent CommPool over 2 in-process pservers x 64
-small grads, with a byte-identical final-params check).
+small grads, with a byte-identical final-params check), BENCH_SERVING=1
+(generation serving microbench: continuous batching vs drain-then-refill
+static batch under the open-loop mixed-length load generator —
+benchmark/run_serving.py — with tokens/s, p50/p99, shed rate, KV-pool
+utilization, and a Prometheus dump at BENCH_SERVING_PROM if set).
 """
 import json
 import os
@@ -471,6 +475,11 @@ def main():
     if os.environ.get("BENCH_COMM", "0").lower() in ("1", "true", "yes",
                                                      "on"):
         out["comm"] = run_comm_bench()
+    if os.environ.get("BENCH_SERVING", "0").lower() in ("1", "true",
+                                                        "yes", "on"):
+        from run_serving import run_serving_bench
+        out["serving"] = run_serving_bench(
+            prom_out=os.environ.get("BENCH_SERVING_PROM", ""))
     if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
             "0", "false", "no", "off"):
         conv = run_convergence()

@@ -1,0 +1,233 @@
+"""Open-loop mixed-length generation serving load: continuous batching
+vs the drain-then-refill static batch.
+
+The generator is OPEN-LOOP: request arrival times come from the rate
+schedule alone (never from completions), which is what exposes a
+serving architecture's real saturation behavior — a closed loop slows
+its own arrivals down exactly when the server struggles and hides the
+collapse.  The request mix is deliberately mixed-length (mostly short
+answers plus a tail of long ones): under drain-then-refill scheduling
+every batch runs at the speed of its LONGEST member, which is exactly
+the pathology continuous batching removes (finished sequences leave
+immediately and queued requests take their slots between ticks).
+
+Both modes run the SAME compiled decode step, model, KV pool, and
+request set — the only difference is GenerationServer's
+static_batch flag — so the measured ratio is pure scheduling.
+
+Reports per mode: sustained tokens/s, p50/p99 request latency, shed
+rate, and peak/mean KV-pool utilization; with --prom_out (or under
+bench.py BENCH_SERVING=1) the run writes the full Prometheus dump of
+the `paddle_tpu_serving_*` series.
+
+Usage: python benchmark/run_serving.py [--requests 48] [--rate 0]
+       [--slots 4] [--kv-blocks 56] [--block-size 8] [--d-model 128]
+       [--layers 2] [--heads 4] [--prom_out serving_prom.txt]
+(--rate 0 = saturation: the whole request set arrives up front.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+VOCAB = 211
+
+
+def _build_decoder(d_model, n_layers, n_heads, block_size, max_blocks):
+    import paddle_tpu as fluid
+    import paddle_tpu.core.framework as fw
+    from paddle_tpu.models.transformer import build_lm_paged_decoder
+
+    fw.reset_unique_names()
+    startup, dec = build_lm_paged_decoder(
+        VOCAB, block_size, max_blocks, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers)
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    states = {n: np.asarray(scope.find_var(n)) for n in dec.state_names}
+    return dec, states
+
+
+def make_requests(n, max_len, rng, long_every=4):
+    """Mixed-length open-loop mix: 1 long pole per `long_every`
+    requests, the rest short — the shape that separates the two
+    schedulers (a drain-then-refill batch always waits for its pole)."""
+    reqs = []
+    for i in range(n):
+        prompt = list(rng.randint(0, VOCAB, rng.randint(2, 9)))
+        if i % long_every == long_every - 1:
+            max_new = max_len - len(prompt) - 8   # long pole
+        else:
+            max_new = int(rng.randint(4, 9))      # short answer
+        reqs.append((prompt, max_new))
+    return reqs
+
+
+def run_load(dec, states, reqs, *, static_batch, slots, kv_blocks,
+             rate_rps=0.0, deadline_ms=None, place=None):
+    """Drive one request set through one scheduler mode; returns the
+    measured row (tokens/s, latency percentiles, shed rate, KV util)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import GenerationServer, ServerSaturated
+
+    server = GenerationServer(
+        dec, states, slots=slots, kv_blocks=kv_blocks,
+        static_batch=static_batch, place=place or fluid.CPUPlace())
+    n = len(reqs)
+    lat = [None] * n
+    toks = [0] * n
+    shed = [False] * n
+    waiters = []
+    util_samples = []
+    stop_sampling = threading.Event()
+
+    def sample_util():
+        while not stop_sampling.wait(0.02):
+            util_samples.append(server.stats()["kv_pool_utilization"])
+
+    sampler = threading.Thread(target=sample_util, daemon=True)
+    sampler.start()
+
+    def wait_for(i, t0, stream):
+        try:
+            out = stream.result(timeout=300)
+            lat[i] = time.perf_counter() - t0
+            toks[i] = len(out)
+        except Exception:
+            shed[i] = True
+
+    t_start = time.perf_counter()
+    for i, (prompt, max_new) in enumerate(reqs):
+        if rate_rps > 0:
+            target = t_start + i / rate_rps
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        t0 = time.perf_counter()
+        try:
+            stream = server.submit(prompt, max_new, seed=i,
+                                   deadline_ms=deadline_ms)
+        except ServerSaturated:
+            shed[i] = True
+            continue
+        w = threading.Thread(target=wait_for, args=(i, t0, stream),
+                             daemon=True)
+        w.start()
+        waiters.append(w)
+    for w in waiters:
+        w.join(timeout=300)
+    wall = time.perf_counter() - t_start
+    stop_sampling.set()
+    sampler.join(timeout=1)
+    stats = server.stats()
+    server.close()
+
+    done_lat = [l for l in lat if l is not None]
+    total_tokens = sum(toks)
+    return {
+        "mode": "static_batch" if static_batch else "continuous",
+        "requests": n,
+        "completed": len(done_lat),
+        "tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(total_tokens / wall, 1) if wall else 0.0,
+        "latency_p50_s": round(float(np.percentile(done_lat, 50)), 4)
+        if done_lat else None,
+        "latency_p99_s": round(float(np.percentile(done_lat, 99)), 4)
+        if done_lat else None,
+        "shed_rate": round(sum(shed) / n, 4),
+        "kv_util_peak": round(max(util_samples), 3) if util_samples
+        else None,
+        "kv_util_mean": round(float(np.mean(util_samples)), 3)
+        if util_samples else None,
+        "decode_ticks": stats["ticks"],
+    }
+
+
+def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
+                      block_size=8, max_blocks=12, d_model=128,
+                      n_layers=2, n_heads=4, deadline_ms=None,
+                      prom_out="", trials=2):
+    """BENCH_SERVING entry point (bench.py): both scheduler modes over
+    the same mixed-length open-loop request set; best-of-`trials` per
+    mode; optional Prometheus dump of the serving series."""
+    from paddle_tpu.observability import exporters
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    # armed only for the duration of this bench: later bench.py
+    # sections (convergence, book matrix) must run exactly as the
+    # user's PADDLE_TPU_METRICS setting asks
+    metrics_were_on = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)
+    try:
+        dec, states = _build_decoder(d_model, n_layers, n_heads,
+                                     block_size, max_blocks)
+        reqs = make_requests(requests, block_size * max_blocks,
+                             np.random.RandomState(0))
+        rows = {}
+        for static in (True, False):
+            best = None
+            for _ in range(trials):
+                row = run_load(dec, states, reqs, static_batch=static,
+                               slots=slots, kv_blocks=kv_blocks,
+                               rate_rps=rate_rps,
+                               deadline_ms=deadline_ms)
+                if best is None or row["tokens_per_sec"] > best[
+                        "tokens_per_sec"]:
+                    best = row
+            rows[best["mode"]] = best
+        out = {
+            "bench": "serving",
+            "slots": slots, "kv_blocks": kv_blocks,
+            "block_size": block_size, "d_model": d_model,
+            "layers": n_layers, "rate_rps": rate_rps,
+            "static_batch": rows["static_batch"],
+            "continuous": rows["continuous"],
+            "continuous_speedup": round(
+                rows["continuous"]["tokens_per_sec"]
+                / max(rows["static_batch"]["tokens_per_sec"], 1e-9), 2),
+        }
+        if prom_out:
+            out["prometheus_dump"] = exporters.write_prometheus(prom_out)
+        return out
+    finally:
+        obs_metrics.set_enabled(metrics_were_on)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrival rate, req/s (0=all up "
+                    "front: saturation)")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--kv-blocks", type=int, default=56)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--max-blocks", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--trials", type=int, default=2)
+    ap.add_argument("--prom_out", default="",
+                    help="write the Prometheus text dump here")
+    a = ap.parse_args()
+    out = run_serving_bench(
+        requests=a.requests, rate_rps=a.rate, slots=a.slots,
+        kv_blocks=a.kv_blocks, block_size=a.block_size,
+        max_blocks=a.max_blocks, d_model=a.d_model, n_layers=a.layers,
+        n_heads=a.heads, deadline_ms=a.deadline_ms, trials=a.trials,
+        prom_out=a.prom_out)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -305,6 +305,65 @@ def cmd_trace(argv):
 
 
 # ---------------------------------------------------------------------------
+# `serve` subcommand: one generation replica (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def cmd_serve(argv):
+    """`python -m paddle_tpu.cli serve MODEL_DIR [--port P]` — front a
+    continuous-batching GenerationServer with the TCP replica protocol
+    (serving/replica.py).  MODEL_DIR is a directory written by
+    serving.save_generation_model (generation.json spec + params npz).
+    With --registry (or PADDLE_TPU_REGISTRY), the replica registers
+    under a TTL lease so a cloud.router.ReplicaRouter front door
+    discovers, health-checks, and hot-swaps it."""
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import ReplicaServer, server_from_model_dir
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli serve",
+        description="serve a saved generation model as one replica")
+    ap.add_argument("model_dir", help="save_generation_model output dir")
+    ap.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = ephemeral, printed on start)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="decode slots (0 = model spec / default 8)")
+    ap.add_argument("--kv_blocks", type=int, default=0,
+                    help="KV pool blocks (0 = model spec / default 64)")
+    ap.add_argument("--block_size", type=int, default=0,
+                    help="KV block size in positions (0 = spec / 16)")
+    ap.add_argument("--registry",
+                    default=os.environ.get("PADDLE_TPU_REGISTRY", ""),
+                    help="TTL-lease registry HOST:PORT to register "
+                    "with (kind 'generation')")
+    ap.add_argument("--ttl", type=float, default=2.0,
+                    help="registry lease TTL seconds")
+    ap.add_argument("--use_tpu", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    server = server_from_model_dir(
+        args.model_dir, slots=args.slots or None,
+        kv_blocks=args.kv_blocks or None,
+        block_size=args.block_size or None,
+        place=_place(args.use_tpu))
+    rep = ReplicaServer(server, port=args.port, host=args.host,
+                        registry_addr=args.registry or None,
+                        ttl_s=args.ttl)
+    suffix = (f", registered in {args.registry}" if args.registry
+              else "")
+    print(f"serving {args.model_dir} on {rep.addr}{suffix}", flush=True)
+    try:
+        rep.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rep.close()
+        server.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # `verify` subcommand: static analysis of saved / buildable programs
 # ---------------------------------------------------------------------------
 
@@ -417,14 +476,14 @@ def cmd_verify(argv):
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     subcommands = {"verify": cmd_verify, "metrics": cmd_metrics,
-                   "trace": cmd_trace}
+                   "trace": cmd_trace, "serve": cmd_serve}
     if argv and argv[0] in subcommands:
         sys.exit(subcommands[argv[0]](argv[1:]))
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli",
         description="legacy `paddle train` workflow over Program/Executor"
         " (plus subcommands: `python -m paddle_tpu.cli "
-        "verify|metrics|trace --help`)")
+        "verify|metrics|trace|serve --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

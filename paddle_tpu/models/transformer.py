@@ -32,6 +32,7 @@ __all__ = [
     "transformer_translate",
     "build_lm_generator",
     "build_lm_kv_decoder",
+    "build_lm_paged_decoder",
     "build_translate_generator",
     "build_lm_beam_search",
 ]
@@ -316,6 +317,51 @@ def build_lm_generator(vocab_size, max_len, d_model=256, n_heads=4,
     return startup, generate
 
 
+def _lm_param_structure(vocab_size, max_len, d_model, n_heads, n_layers,
+                        d_inner):
+    """Build the LM Program once and extract its parameter names
+    STRUCTURALLY (op walk, creation order) so a hand-rolled incremental
+    decoder computes over the SAME trained values as the Program path.
+
+    Returns (startup, param_names, tok_emb, pos_tab, lns, weights,
+    biases); shared by build_lm_kv_decoder (dense cache) and
+    build_lm_paged_decoder (block-table cache)."""
+    from ..core.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids_in = layers.data(name="gen_ids", shape=[max_len],
+                             dtype="int64")
+        transformer_lm(ids_in, vocab_size, d_model=d_model,
+                       n_heads=n_heads, n_layers=n_layers,
+                       d_inner=d_inner, max_len=max_len, is_test=True)
+
+    blk = main.global_block()
+    params = {v.name for v in blk.all_parameters()}
+    tok_emb = pos_tab = None
+    lns, weights, biases = [], [], []
+    for op in blk.ops:
+        if op.type == "lookup_table":
+            tok_emb = op.inputs["W"][0]
+        elif op.type == "slice" and op.inputs["Input"][0] in params:
+            pos_tab = op.inputs["Input"][0]
+        elif op.type == "layer_norm":
+            lns.append((op.inputs["Scale"][0], op.inputs["Bias"][0]))
+        elif op.type == "mul":
+            weights.append(op.inputs["Y"][0])
+        elif op.type == "elementwise_add":
+            y = op.inputs.get("Y", [None])[0]
+            if y in params and len(biases) < len(weights):
+                biases.append(y)
+    assert tok_emb and pos_tab, "unexpected LM program structure"
+    assert len(weights) == 6 * n_layers + 1, (len(weights), n_layers)
+    assert len(lns) == 2 * n_layers + 1
+    assert len(biases) == len(weights)
+    shapes = {v.name: tuple(int(d) for d in v.shape)
+              for v in blk.all_parameters()}
+    return startup, shapes, tok_emb, pos_tab, lns, weights, biases
+
+
 def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
                         n_layers=2, d_inner=None):
     """Incremental (KV-cache) generation for the decoder-only LM.
@@ -338,41 +384,12 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
     import jax
     import jax.numpy as jnp
 
-    from ..core.framework import Program, program_guard
-
     d_inner = d_inner or 4 * d_model
     d_head = d_model // n_heads
 
-    main, startup = Program(), Program()
-    with program_guard(main, startup):
-        ids_in = layers.data(name="gen_ids", shape=[max_len],
-                             dtype="int64")
-        transformer_lm(ids_in, vocab_size, d_model=d_model,
-                       n_heads=n_heads, n_layers=n_layers,
-                       d_inner=d_inner, max_len=max_len, is_test=True)
-
-    # -- structural parameter extraction (creation order) -------------------
-    blk = main.global_block()
-    params = {v.name for v in blk.all_parameters()}
-    tok_emb = pos_tab = None
-    lns, weights, biases = [], [], []
-    for op in blk.ops:
-        if op.type == "lookup_table":
-            tok_emb = op.inputs["W"][0]
-        elif op.type == "slice" and op.inputs["Input"][0] in params:
-            pos_tab = op.inputs["Input"][0]
-        elif op.type == "layer_norm":
-            lns.append((op.inputs["Scale"][0], op.inputs["Bias"][0]))
-        elif op.type == "mul":
-            weights.append(op.inputs["Y"][0])
-        elif op.type == "elementwise_add":
-            y = op.inputs.get("Y", [None])[0]
-            if y in params and len(biases) < len(weights):
-                biases.append(y)
-    assert tok_emb and pos_tab, "unexpected LM program structure"
-    assert len(weights) == 6 * n_layers + 1, (len(weights), n_layers)
-    assert len(lns) == 2 * n_layers + 1
-    assert len(biases) == len(weights)
+    startup, shapes, tok_emb, pos_tab, lns, weights, biases = (
+        _lm_param_structure(vocab_size, max_len, d_model, n_heads,
+                            n_layers, d_inner))
 
     import functools
 
@@ -465,8 +482,162 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
         return _run(ids0, caches0, g_in, jax.random.key(seed), p,
                     int(num_steps), float(temperature))
 
-    generate.state_names = sorted(params)
+    generate.state_names = sorted(shapes)
+    generate.state_shapes = shapes
     return startup, generate
+
+
+def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
+                           d_model=256, n_heads=4, n_layers=2,
+                           d_inner=None):
+    """Paged-attention decode step for the decoder-only LM.
+
+    `build_lm_kv_decoder` owns a dense per-sequence cache
+    ([B, max_len, d]) whose lifetime is one generate() call — fine for
+    a closed batch, wrong for serving: a batch slot holds max_len worth
+    of HBM for its whole life and a new request cannot join a running
+    loop.  This builder produces the vLLM-style alternative: K/V live
+    in fixed-size BLOCKS inside one shared pool
+    ([n_layers, num_blocks, block_size, d_model]) and each sequence
+    owns an ordered block table mapping its logical positions onto pool
+    blocks.  Attention gathers through the table, so the kernel sees
+    exactly the values a dense cache would hold — per-slot math is
+    independent of which physical blocks a sequence happens to own and
+    of what other slots compute, which is what makes continuously-
+    batched decode bit-identical to running the same prompt solo
+    (tests/test_generation_serving.py pins this).
+
+    Unlike the closed-batch builders this returns a SINGLE decode step
+    (one token per active slot per call), because the serving scheduler
+    (serving/generation.py GenerationServer) must get control back
+    between steps to admit/evict sequences; the whole step is one jit
+    with the pool buffers donated, so a tick is one dispatch and the
+    pool updates in place on device.
+
+    Returns (startup_program, decoder):
+      decoder.step(states, pool_k, pool_v, tables, positions, tokens,
+                   seeds, temps, active)
+          -> (next_tokens [S] int32, pool_k, pool_v)
+        tables    [S, max_blocks_per_seq] int32 pool-block ids (unused
+                  tail entries must point at a valid block, e.g. the
+                  pool's reserved null block — they are masked out)
+        positions [S] int32 logical cursor: `tokens[s]` is the token AT
+                  this position; the step writes its K/V there and
+                  returns the model's prediction for position+1
+        seeds     [S] uint32 per-sequence sampling seed (the PRNG is
+                  fold_in(key(seed), position): stateless, so a retried
+                  / re-scheduled sequence resamples identically)
+        temps     [S] float32, 0 = greedy argmax
+        active    [S] bool; inactive slots write into the null block
+                  and their outputs are meaningless
+      decoder.init_pool(num_blocks) -> (pool_k, pool_v) zero blocks
+      decoder.state_names — parameter names, same trained values as the
+      Program path (shared structural extraction with the dense
+      decoder).
+    """
+    import functools
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    d_inner = d_inner or 4 * d_model
+    d_head = d_model // n_heads
+    nb, bs = int(max_blocks_per_seq), int(block_size)
+    max_len = nb * bs
+
+    startup, shapes, tok_emb, pos_tab, lns, weights, biases = (
+        _lm_param_structure(vocab_size, max_len, d_model, n_heads,
+                            n_layers, d_inner))
+
+    scale = 1.0 / math.sqrt(d_head)
+    # buffer donation makes the pool update in place (no copy of the
+    # whole cache per token); CPU has no donation support and would
+    # warn once per compile, so only donate where it lands
+    donate = (1, 2) if jax.default_backend() != "cpu" else ()
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def step(g, pool_k, pool_v, tables, positions, tokens, seeds, temps,
+             active):
+        s_n = tokens.shape[0]
+        lane = jnp.arange(s_n)
+
+        def W(i):
+            return g[weights[i]], g[biases[i]]
+
+        def ln(x, i):
+            sc_, b_ = g[lns[i][0]], g[lns[i][1]]
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * sc_ + b_
+
+        x = g[tok_emb][tokens] + g[pos_tab][positions]       # [S, D]
+        # this tick's K/V land at the cursor's (block, offset); inactive
+        # slots are routed to block 0 offset 0 — the pool's reserved
+        # null/scratch block, never owned by a sequence
+        wb = jnp.where(active, tables[lane, positions // bs], 0)
+        wi = jnp.where(active, positions % bs, 0)
+        # mask over the table's logical span: position j participates
+        # iff j <= cursor, which also hides unallocated tail entries
+        pos_mask = jnp.arange(nb * bs)[None, :] <= positions[:, None]
+        for l in range(n_layers):
+            h = ln(x, 2 * l)
+            wq, bq = W(6 * l + 0)
+            wk, bk = W(6 * l + 1)
+            wv, bv = W(6 * l + 2)
+            wo, bo = W(6 * l + 3)
+            q = h @ wq + bq
+            kk = h @ wk + bk
+            vv = h @ wv + bv
+            pool_k = pool_k.at[l, wb, wi].set(kk)
+            pool_v = pool_v.at[l, wb, wi].set(vv)
+            # gather-based attention over the block table: [S, NB, BS, D]
+            # in table order IS logical order, so after the reshape the
+            # math is the dense cache's math on the same values
+            kh = pool_k[l][tables].reshape(s_n, nb * bs, n_heads, d_head)
+            vh = pool_v[l][tables].reshape(s_n, nb * bs, n_heads, d_head)
+            qh = q.reshape(s_n, n_heads, d_head)
+            sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
+            sc = jnp.where(pos_mask[:, None, :], sc, -jnp.inf)
+            w_att = jax.nn.softmax(sc, axis=-1)
+            ctxh = jnp.einsum("bhs,bshd->bhd", w_att, vh)
+            x = x + (ctxh.reshape(s_n, d_model) @ wo + bo)
+            h2 = ln(x, 2 * l + 1)
+            w1, b1 = W(6 * l + 4)
+            w2, b2 = W(6 * l + 5)
+            x = x + (jax.nn.relu(h2 @ w1 + b1) @ w2 + b2)
+        xf = ln(x, 2 * n_layers)
+        wf, bf = W(6 * n_layers)
+        logits = xf @ wf + bf                                # [S, V]
+        greedy = jnp.argmax(logits, axis=-1)
+        # stateless per-sequence sampling: the key depends only on
+        # (seed, position), never on the slot or tick number
+        subs = jax.vmap(
+            lambda sd, p: jax.random.fold_in(jax.random.key(sd), p))(
+                seeds, positions)
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(subs,
+                                                   logits / safe_t)
+        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        return nxt, pool_k, pool_v
+
+    def init_pool(num_blocks, device=None):
+        shape = (n_layers, int(num_blocks), bs, d_model)
+        zk = jnp.zeros(shape, jnp.float32)
+        zv = jnp.zeros(shape, jnp.float32)
+        if device is not None:
+            zk = jax.device_put(zk, device)
+            zv = jax.device_put(zv, device)
+        return zk, zv
+
+    import types
+
+    decoder = types.SimpleNamespace(
+        step=step, init_pool=init_pool, state_names=sorted(shapes),
+        state_shapes=shapes, block_size=bs, max_blocks_per_seq=nb,
+        max_len=max_len, n_layers=n_layers, d_model=d_model,
+        vocab_size=vocab_size)
+    return startup, decoder
 
 
 def build_translate_generator(src_vocab, tgt_vocab, max_src_len,

@@ -31,15 +31,15 @@ import itertools
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-from .core.resilience import fault_injector
-from .observability import metrics as obs_metrics
-from .observability import tracing as obs_tracing
-from .reader.pipeline import stage_to_device
+from ..core.resilience import fault_injector
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+from ..reader.pipeline import stage_to_device
 
 __all__ = ["InferenceServer", "ServerSaturated", "RequestDeadlineExceeded"]
 
@@ -104,7 +104,7 @@ class InferenceServer:
                  window_ms: float = 0.3, max_queue: int = 1024):
         import jax
 
-        from .core.executor import TPUPlace, program_to_fn
+        from ..core.executor import TPUPlace, program_to_fn
 
         self._feed_name = feed_name
         fetch_name = getattr(fetch_var, "name", str(fetch_var))
@@ -123,7 +123,7 @@ class InferenceServer:
             return fn(feeds, states, key)[0][fetch_name]
 
         jfn = jax.jit(fwd)
-        from .core.types import np_dtype
+        from ..core.types import np_dtype
 
         sample, self._dtype = None, np.dtype("float32")
         for v in program.global_block().vars.values():
@@ -243,6 +243,11 @@ class InferenceServer:
         if expires is None or time.monotonic() < expires:
             return False
         self._m_deadline.inc()
+        # a deadline storm drains the queue HERE, not through dispatch —
+        # without this update the gauge freezes at its submit-time high
+        # water mark and overload reads as a permanently full queue
+        if obs_metrics.enabled():
+            self._m_qdepth.set(self._q.qsize())
         _deliver(fut, exception=RequestDeadlineExceeded(
             "request deadline expired while queued"))
         return True
@@ -328,11 +333,13 @@ class InferenceServer:
 def _deliver(fut: Future, result=None, exception=None):
     """Resolve a future, tolerating client-side cancellation — a
     set_result on a cancelled Future raises InvalidStateError, which
-    must not kill the worker loop (every later request would hang)."""
+    must not kill the worker loop (every later request would hang).
+    ONLY that: a broader catch would also swallow worker bugs (a
+    result the Future machinery rejects for a real reason)."""
     try:
         if exception is not None:
             fut.set_exception(exception)
         else:
             fut.set_result(result)
-    except Exception:
+    except InvalidStateError:
         pass  # cancelled by the client; nothing to deliver

@@ -1,0 +1,644 @@
+"""Continuous-batching generation server over the paged KV-cache.
+
+The static decode loop (models/transformer.build_lm_kv_decoder) serves
+a CLOSED batch: everyone starts together, nobody leaves until the last
+sequence finishes, and a new request waits for the whole batch to
+drain.  `GenerationServer` replaces that with the vLLM-style in-flight
+schedule:
+
+* ONE resident decode step (build_lm_paged_decoder) runs per tick over
+  the active slot set — a single device dispatch per token position;
+* BETWEEN ticks the scheduler admits queued requests into free slots
+  (prefill is folded into the same per-token step: a just-admitted
+  sequence is teacher-forced through its prompt positions while
+  everyone else decodes), evicts finished sequences IMMEDIATELY and
+  returns their KV blocks to the pool;
+* admission is keyed to free KV blocks (a request is admitted only
+  when its whole prompt+max_new budget fits, so decode can never hit
+  an out-of-pool condition mid-sequence), queued requests past their
+  deadline are shed at dequeue, and a full queue rejects with
+  ServerSaturated at submit;
+* every request streams tokens through its own `GenerationStream`
+  future, and per-request numerics are bit-identical to running the
+  same prompt alone (slot math is independent of batch composition —
+  tests/test_generation_serving.py pins this);
+* `swap_states` performs the zero-downtime checkpoint hot swap: stop
+  admitting, let active sequences drain, swap parameters, resume —
+  queued requests wait instead of failing.
+
+`static_batch=True` degrades the scheduler to the drain-then-refill
+baseline (admit only into an EMPTY active set) — same compiled step,
+same numerics — which is what benchmark/run_serving.py measures the
+continuous schedule against.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.resilience import fault_injector
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+from .batching import RequestDeadlineExceeded, ServerSaturated
+from .kv_cache import PagedKVCache
+
+__all__ = ["GenerationServer", "GenerationStream",
+           "save_generation_model", "load_generation_model"]
+
+MODEL_SPEC_FILENAME = "generation.json"
+MODEL_PARAMS_FILENAME = "generation_params.npz"
+
+_SERVER_IDS = itertools.count()
+# stats()-backing series are always=True (the stats contract predates
+# the PADDLE_TPU_METRICS switch); latency/depth series are gated.
+_M_REQUESTS = obs_metrics.counter(
+    "paddle_tpu_serving_generation_requests_total",
+    "generation requests admitted to a decode slot", ("server",),
+    always=True)
+_M_TOKENS = obs_metrics.counter(
+    "paddle_tpu_serving_generated_tokens_total",
+    "generated tokens delivered to request streams", ("server",),
+    always=True)
+_M_TICKS = obs_metrics.counter(
+    "paddle_tpu_serving_decode_ticks_total",
+    "resident decode steps dispatched (tokens/tick = active slots)",
+    ("server",), always=True)
+_M_SHED = obs_metrics.counter(
+    "paddle_tpu_serving_generation_shed_total",
+    "requests shed instead of decoded, by reason "
+    "(saturated: full queue at submit; deadline: expired while queued)",
+    ("server", "reason"), always=True)
+_M_SWAPS = obs_metrics.counter(
+    "paddle_tpu_serving_hot_swaps_total",
+    "zero-downtime checkpoint hot swaps completed", ("server",),
+    always=True)
+_M_LATENCY = obs_metrics.histogram(
+    "paddle_tpu_serving_generation_seconds",
+    "submit -> last-token wall latency per request", ("server",))
+_M_TTFT = obs_metrics.histogram(
+    "paddle_tpu_serving_first_token_seconds",
+    "submit -> first generated token wall latency", ("server",))
+_M_ACTIVE = obs_metrics.gauge(
+    "paddle_tpu_serving_active_sequences",
+    "sequences currently holding a decode slot", ("server",))
+_M_QDEPTH = obs_metrics.gauge(
+    "paddle_tpu_serving_generation_queue_depth",
+    "requests waiting for admission", ("server",))
+
+
+class GenerationStream:
+    """Per-request streaming future: tokens arrive as the scheduler
+    delivers them; `result()` blocks for the full generation.
+
+    for tok in stream:            # streams tokens as they are decoded
+        ...
+    ids = stream.result()         # or: block until finished
+
+    A failed request raises from both paths; a shed request raises the
+    shed error (RequestDeadlineExceeded)."""
+
+    def __init__(self, prompt: Sequence[int], max_new: int):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self._cond = threading.Condition()
+        self._tokens: List[int] = []
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self._watchers = 0
+
+    # -- scheduler side -----------------------------------------------------
+    def _put(self, tok: int):
+        with self._cond:
+            self._tokens.append(int(tok))
+            # wake waiters per token only when a live iterator streams
+            # this request; result()-style waiters block on `done` and
+            # a wakeup per token is pure GIL churn on the decode path
+            # (it measurably dilutes the continuous-batching win)
+            if self._watchers:
+                self._cond.notify_all()
+
+    def _finish(self):
+        with self._cond:
+            self._done = True
+            self._cond.notify_all()
+
+    def _fail(self, exc: BaseException):
+        with self._cond:
+            if not self._done:
+                self._exc = exc
+                self._done = True
+                self._cond.notify_all()
+
+    # -- client side --------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    def tokens_so_far(self) -> List[int]:
+        with self._cond:
+            return list(self._tokens)
+
+    def __iter__(self):
+        i = 0
+        with self._cond:
+            self._watchers += 1
+        try:
+            while True:
+                # snapshot under the lock, yield OUTSIDE it: a consumer
+                # that processes tokens slowly (a replica writing to a
+                # slow TCP client) must never block the scheduler's
+                # _put — that would stall every other request's decode
+                with self._cond:
+                    self._cond.wait_for(
+                        lambda: self._done or len(self._tokens) > i)
+                    batch = self._tokens[i:]
+                    done = self._done  # final: no tokens arrive after
+                    exc = self._exc
+                for tok in batch:
+                    yield tok
+                i += len(batch)
+                if done:
+                    if exc is not None:
+                        raise exc
+                    return
+        finally:
+            with self._cond:
+                self._watchers -= 1
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise TimeoutError("generation still running")
+            if self._exc is not None:
+                raise self._exc
+            return list(self._tokens)
+
+
+class _Seq:
+    """Scheduler-internal state of one admitted request."""
+
+    __slots__ = ("stream", "tokens", "prompt_len", "max_new", "eos_id",
+                 "temperature", "seed", "cur", "slot", "emitted",
+                 "t_submit", "expires", "trace_ctx")
+
+    def __init__(self, stream, max_new, eos_id, temperature, seed,
+                 expires, trace_ctx):
+        self.stream = stream
+        self.tokens = list(stream.prompt)
+        self.prompt_len = len(stream.prompt)
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.cur = 0
+        self.slot = -1
+        self.emitted = 0
+        self.t_submit = time.perf_counter()
+        self.expires = expires
+        self.trace_ctx = trace_ctx
+
+    @property
+    def positions_needed(self) -> int:
+        # the cursor writes K/V at positions 0 .. prompt+max_new-2 (the
+        # final emitted token is delivered, never re-attended)
+        return self.prompt_len + self.max_new - 1
+
+
+class GenerationServer:
+    """Continuous-batching decode scheduler over one paged decoder.
+
+    decoder/states: a models/transformer.build_lm_paged_decoder bundle
+    plus its trained parameter dict (names must match
+    decoder.state_names — same unique-name discipline as the other
+    generator builders).  `slots` bounds concurrent sequences,
+    `kv_blocks` is the preallocated pool budget shared by ALL of them.
+    """
+
+    def __init__(self, decoder, states, *, slots: int = 8,
+                 kv_blocks: int = 64, max_queue: int = 256,
+                 place=None, static_batch: bool = False,
+                 idle_poll_s: float = 0.005):
+        import jax
+
+        from ..core.executor import TPUPlace
+
+        missing = [n for n in decoder.state_names if n not in states]
+        if missing:
+            raise ValueError(
+                f"states missing {len(missing)} decoder parameter(s), "
+                f"e.g. {missing[:3]} — rebuild the decoder under the "
+                "same unique-name state the parameters were trained in")
+        # matching NAMES are not enough: a spec that rebuilds the
+        # decoder at the wrong max_len/d_model would index the position
+        # table out of bounds inside jit, where gathers CLAMP — silently
+        # wrong tokens instead of an error.  Catch it here.
+        bad = [(n, tuple(np.shape(states[n])), want)
+               for n, want in getattr(decoder, "state_shapes",
+                                      {}).items()
+               if tuple(np.shape(states[n])) != want]
+        if bad:
+            n, got, want = bad[0]
+            raise ValueError(
+                f"{len(bad)} parameter shape(s) do not match the "
+                f"decoder architecture, e.g. {n}: states {got} vs "
+                f"decoder {want} — the model spec (vocab_size/d_model/"
+                "n_heads/n_layers/block_size*max_blocks_per_seq) "
+                "disagrees with the saved parameters")
+        self._decoder = decoder
+        self._slots = int(slots)
+        self._static = bool(static_batch)
+        self._idle_poll_s = float(idle_poll_s)
+        place = place or TPUPlace()
+        self._device = place.jax_device()
+        self._states = {n: jax.device_put(np.asarray(states[n]),
+                                          self._device)
+                        for n in decoder.state_names}
+        sid = self._sid = str(next(_SERVER_IDS))
+        self._cache = PagedKVCache(
+            kv_blocks, decoder.block_size, decoder.max_blocks_per_seq,
+            server_label=f"gen{sid}")
+        # +1: device block 0 is the reserved null/scratch block
+        self._pool_k, self._pool_v = decoder.init_pool(
+            kv_blocks + 1, self._device)
+
+        self._active: List[Optional[_Seq]] = [None] * self._slots
+        self._tables = np.zeros(
+            (self._slots, decoder.max_blocks_per_seq), np.int32)
+        self._queue: deque = deque()
+        self._max_queue = int(max_queue)
+        self._lock = threading.Condition()
+        self._stop = False
+        self._pending_states = None
+        self._swap_done = threading.Event()
+
+        self._m_requests = _M_REQUESTS.labels(server=sid)
+        self._m_tokens = _M_TOKENS.labels(server=sid)
+        self._m_ticks = _M_TICKS.labels(server=sid)
+        self._m_shed = _M_SHED.labels(server=sid, reason="saturated")
+        self._m_deadline = _M_SHED.labels(server=sid, reason="deadline")
+        self._m_swaps = _M_SWAPS.labels(server=sid)
+        self._m_latency = _M_LATENCY.labels(server=sid)
+        self._m_ttft = _M_TTFT.labels(server=sid)
+        self._m_active = _M_ACTIVE.labels(server=sid)
+        self._m_qdepth = _M_QDEPTH.labels(server=sid)
+
+        self._warmup()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _warmup(self):
+        """Compile the resident step before the first request: serving
+        never pays the trace+compile inside a request's latency."""
+        z = np.zeros(self._slots, np.int32)
+        nxt, self._pool_k, self._pool_v = self._decoder.step(
+            self._states, self._pool_k, self._pool_v, self._tables, z,
+            z, z.astype(np.uint32), np.zeros(self._slots, np.float32),
+            np.zeros(self._slots, bool))
+        np.asarray(nxt)  # block: compile is done when this returns
+
+    # -- client side --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenerationStream:
+        """Enqueue one generation request; returns its token stream.
+
+        Requests whose prompt+max_new budget can never fit a sequence's
+        block-table capacity are rejected with ValueError up front; a
+        full admission queue raises ServerSaturated (backpressure); a
+        request still queued when `deadline_ms` passes is shed with
+        RequestDeadlineExceeded instead of occupying a slot."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        stream = GenerationStream(prompt, max_new_tokens)
+        expires = (time.monotonic() + deadline_ms / 1000.0
+                   if deadline_ms is not None else None)
+        seq = _Seq(stream, max_new_tokens, eos_id, temperature, seed,
+                   expires, obs_tracing.current_context())
+        need = self._cache.blocks_for(seq.positions_needed)
+        if (need > self._cache.max_blocks_per_seq
+                or seq.positions_needed > self._decoder.max_len):
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} "
+                f"needs {need} KV blocks > per-sequence capacity "
+                f"{self._cache.max_blocks_per_seq} "
+                f"(block_size {self._cache.block_size})")
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("GenerationServer is closed")
+            if len(self._queue) >= self._max_queue:
+                self._m_shed.inc()
+                raise ServerSaturated(
+                    f"GenerationServer queue full ({self._max_queue} "
+                    "pending) — backpressure: retry later or raise "
+                    "max_queue")
+            self._queue.append(seq)
+            self._lock.notify_all()
+        if obs_metrics.enabled():
+            self._m_qdepth.set(len(self._queue))
+        return stream
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 timeout: Optional[float] = None, **kw) -> List[int]:
+        """Synchronous convenience wrapper around submit()."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).result(
+            timeout)
+
+    def swap_states(self, states: Dict[str, np.ndarray],
+                    wait: bool = True,
+                    timeout: Optional[float] = None) -> bool:
+        """Zero-downtime checkpoint hot swap: drain -> swap -> resume.
+
+        Admission pauses, active sequences run to completion against
+        the OLD parameters (a generation never mixes checkpoints),
+        then the new parameters are installed and admission resumes.
+        Queued requests are NOT failed — they wait out the drain."""
+        missing = [n for n in self._decoder.state_names
+                   if n not in states]
+        if missing:
+            raise ValueError(f"swap states missing {missing[:3]}...")
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("GenerationServer is closed")
+            if self._pending_states is not None:
+                raise RuntimeError("hot swap already in progress")
+            self._swap_done.clear()
+            self._pending_states = {
+                n: np.asarray(states[n])
+                for n in self._decoder.state_names}
+            self._lock.notify_all()
+        if wait:
+            return self._swap_done.wait(timeout)
+        return True
+
+    def stats(self) -> Dict[str, float]:
+        """Serving telemetry view (docs/serving.md): request/token/tick
+        counters, shed accounting, live occupancy, KV-pool state."""
+        with self._lock:
+            active = sum(1 for s in self._active if s is not None)
+            qdepth = len(self._queue)
+        return {"requests": int(self._m_requests.value),
+                "generated_tokens": int(self._m_tokens.value),
+                "ticks": int(self._m_ticks.value),
+                "shed": int(self._m_shed.value),
+                "deadline_expired": int(self._m_deadline.value),
+                "hot_swaps": int(self._m_swaps.value),
+                "active_sequences": active,
+                "queue_depth": qdepth,
+                "kv_blocks_free": self._cache.free_blocks,
+                "kv_blocks_total": self._cache.num_blocks,
+                "kv_pool_utilization": self._cache.utilization()}
+
+    def outstanding_tokens(self) -> int:
+        """Token budget not yet delivered (active + queued) — the load
+        signal the replica router places on (least outstanding)."""
+        with self._lock:
+            out = sum(s.max_new - s.emitted
+                      for s in self._active if s is not None)
+            out += sum(s.max_new for s in self._queue)
+        return out
+
+    def close(self):
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        self._worker.join(timeout=10)
+        err = RuntimeError("GenerationServer closed")
+        with self._lock:
+            leftovers = ([s for s in self._active if s is not None]
+                         + list(self._queue))
+            self._active = [None] * self._slots
+            self._queue.clear()
+        for seq in leftovers:
+            self._cache.release(seq)
+            seq.stream._fail(err)
+        self._cache.close()
+        for fam in (_M_REQUESTS, _M_TOKENS, _M_TICKS, _M_SWAPS,
+                    _M_LATENCY, _M_TTFT, _M_ACTIVE, _M_QDEPTH):
+            fam.remove(server=self._sid)
+        for reason in ("saturated", "deadline"):
+            _M_SHED.remove(server=self._sid, reason=reason)
+
+    # -- scheduler ----------------------------------------------------------
+    def _shed_expired_locked(self, now: float) -> List[_Seq]:
+        shed = []
+        kept: deque = deque()
+        for seq in self._queue:
+            if seq.expires is not None and now >= seq.expires:
+                shed.append(seq)
+            else:
+                kept.append(seq)
+        self._queue = kept
+        return shed
+
+    def _admit_locked(self) -> List[_Seq]:
+        """Move queued requests into free slots, FIFO, while KV blocks
+        and slots last.  Head-of-line order is deliberate: skipping a
+        big request to admit later small ones would starve it."""
+        admitted = []
+        n_active = sum(1 for s in self._active if s is not None)
+        if self._static and n_active:
+            return admitted   # drain-then-refill baseline
+        if self._pending_states is not None:
+            return admitted   # draining for a hot swap
+        while self._queue:
+            slot = next((i for i, s in enumerate(self._active)
+                         if s is None), -1)
+            if slot < 0:
+                break
+            seq = self._queue[0]
+            if not self._cache.can_admit(seq.positions_needed):
+                break
+            self._queue.popleft()
+            table = self._cache.allocate(seq, seq.positions_needed)
+            seq.slot = slot
+            self._active[slot] = seq
+            self._tables[slot] = table
+            admitted.append(seq)
+        return admitted
+
+    def _evict_locked(self, seq: _Seq):
+        self._active[seq.slot] = None
+        self._tables[seq.slot] = 0
+        seq.slot = -1
+        self._cache.release(seq)
+
+    def _loop(self):
+        dec = self._decoder
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                shed = self._shed_expired_locked(time.monotonic())
+                admitted = self._admit_locked()
+                seqs = [s for s in self._active if s is not None]
+                swap = (self._pending_states
+                        if self._pending_states is not None
+                        and not seqs else None)
+                qdepth = len(self._queue)
+            metrics_on = obs_metrics.enabled()
+            for seq in shed:
+                self._m_deadline.inc()
+                seq.stream._fail(RequestDeadlineExceeded(
+                    "request deadline expired while queued for "
+                    "admission"))
+            if admitted:
+                self._m_requests.inc(len(admitted))
+            if metrics_on:
+                self._m_qdepth.set(qdepth)
+                self._m_active.set(len(seqs))
+            if swap is not None:
+                self._install_states(swap)
+                continue
+            if not seqs:
+                with self._lock:
+                    if (not self._queue and not self._stop
+                            and self._pending_states is None):
+                        self._lock.wait(timeout=self._idle_poll_s)
+                continue
+            try:
+                # chaos hook: an error rule fails this tick's sequences
+                # (they are evicted, their streams get the error) but
+                # must never kill the scheduler thread
+                fault_injector().fire("serving.decode")
+                nxt = self._tick(seqs)
+            except Exception as e:
+                with self._lock:
+                    for seq in seqs:
+                        self._evict_locked(seq)
+                for seq in seqs:
+                    seq.stream._fail(e)
+                continue
+            self._deliver(seqs, nxt, metrics_on)
+
+    def _tick(self, seqs: List[_Seq]) -> np.ndarray:
+        tokens = np.zeros(self._slots, np.int32)
+        positions = np.zeros(self._slots, np.int32)
+        temps = np.zeros(self._slots, np.float32)
+        seeds = np.zeros(self._slots, np.uint32)
+        active = np.zeros(self._slots, bool)
+        for seq in seqs:
+            tokens[seq.slot] = seq.tokens[seq.cur]
+            positions[seq.slot] = seq.cur
+            temps[seq.slot] = seq.temperature
+            seeds[seq.slot] = seq.seed
+            active[seq.slot] = True
+        with obs_tracing.span("serving.decode_tick", active=len(seqs)):
+            nxt, self._pool_k, self._pool_v = self._decoder.step(
+                self._states, self._pool_k, self._pool_v, self._tables,
+                positions, tokens, seeds, temps, active)
+            out = np.asarray(nxt)
+        self._m_ticks.inc()
+        return out
+
+    def _deliver(self, seqs: List[_Seq], nxt: np.ndarray,
+                 metrics_on: bool):
+        now = time.perf_counter()
+        delivered = 0
+        finished = []
+        for seq in seqs:
+            tok = int(nxt[seq.slot])
+            seq.cur += 1
+            if seq.cur < seq.prompt_len:
+                continue          # still prefilling: teacher-forced
+            seq.tokens.append(tok)
+            seq.emitted += 1
+            delivered += 1
+            if metrics_on and seq.emitted == 1:
+                self._m_ttft.observe(now - seq.t_submit)
+            seq.stream._put(tok)
+            if (seq.emitted >= seq.max_new
+                    or (seq.eos_id is not None and tok == seq.eos_id)):
+                finished.append(seq)
+        if delivered:
+            self._m_tokens.inc(delivered)
+        if finished:
+            with self._lock:
+                for seq in finished:
+                    self._evict_locked(seq)
+                self._lock.notify_all()
+            for seq in finished:
+                if metrics_on:
+                    self._m_latency.observe(now - seq.t_submit)
+                seq.stream._finish()
+
+    def _install_states(self, states: Dict[str, np.ndarray]):
+        import jax
+
+        new = {n: jax.device_put(v, self._device)
+               for n, v in states.items()}
+        with self._lock:
+            self._states = new
+            self._pending_states = None
+            self._lock.notify_all()
+        self._m_swaps.inc()
+        self._swap_done.set()
+
+
+# -- model dir format --------------------------------------------------------
+
+def save_generation_model(dirname: str, states: Dict[str, np.ndarray],
+                          spec: Dict) -> str:
+    """Persist a generation model: `generation.json` (architecture
+    spec: vocab_size/d_model/n_heads/n_layers/d_inner, plus optional
+    serving defaults block_size/max_blocks_per_seq/slots/kv_blocks) and
+    one npz of parameters.  The directory is what `cli serve` and the
+    replica hot-swap verb consume."""
+    os.makedirs(dirname, exist_ok=True)
+    for key in ("vocab_size", "d_model", "n_heads", "n_layers"):
+        if key not in spec:
+            raise ValueError(f"spec missing {key!r}")
+    with open(os.path.join(dirname, MODEL_SPEC_FILENAME), "w") as f:
+        json.dump(spec, f, indent=1, sort_keys=True)
+    np.savez(os.path.join(dirname, MODEL_PARAMS_FILENAME),
+             **{n: np.asarray(v) for n, v in states.items()})
+    return dirname
+
+
+def load_generation_model(dirname: str):
+    """-> (states, spec) saved by save_generation_model."""
+    with open(os.path.join(dirname, MODEL_SPEC_FILENAME)) as f:
+        spec = json.load(f)
+    with np.load(os.path.join(dirname, MODEL_PARAMS_FILENAME)) as z:
+        states = {n: z[n] for n in z.files}
+    return states, spec
+
+
+def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
+                          max_blocks_per_seq: Optional[int] = None,
+                          slots: Optional[int] = None,
+                          kv_blocks: Optional[int] = None,
+                          **kw) -> GenerationServer:
+    """Build a GenerationServer from a saved model dir.
+
+    Resets the framework unique-name counters to rebuild the decoder
+    under the names the parameters were saved with — intended for
+    fresh serving processes (cli serve, replicas), not mid-session."""
+    from ..core import framework as fw
+    from ..models.transformer import build_lm_paged_decoder
+
+    states, spec = load_generation_model(dirname)
+    bs = int(block_size or spec.get("block_size", 16))
+    nb = int(max_blocks_per_seq
+             or spec.get("max_blocks_per_seq",
+                         -(-int(spec.get("max_len", 256)) // bs)))
+    fw.reset_unique_names()
+    _, decoder = build_lm_paged_decoder(
+        spec["vocab_size"], bs, nb, d_model=spec["d_model"],
+        n_heads=spec["n_heads"], n_layers=spec["n_layers"],
+        d_inner=spec.get("d_inner"))
+    return GenerationServer(
+        decoder, states,
+        slots=int(slots or spec.get("slots", 8)),
+        kv_blocks=int(kv_blocks or spec.get("kv_blocks", 64)), **kw)

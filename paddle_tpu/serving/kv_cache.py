@@ -1,0 +1,162 @@
+"""Paged KV-cache: block-granular memory management for decode.
+
+The dense serving cache ([slots, max_len, d_model] per layer) couples a
+sequence's HBM footprint to the WORST-CASE length: a 16-token request
+in a 2048-token slot pins 128x the memory it uses, and a long request
+cannot start until a whole slot's worth of contiguous cache is free.
+The vLLM PagedAttention design decouples the two:
+
+* ONE pool of fixed-size blocks ([n_layers, num_blocks, block_size,
+  d_model] for K and for V) is preallocated up front — serving never
+  allocates device memory again;
+* each sequence owns an ordered BLOCK TABLE of pool indices; logical
+  position j lives in table[j // block_size] at offset j % block_size;
+* blocks are allocated as a sequence grows past a block boundary-free
+  at admit time for the whole admitted budget here, since the scheduler
+  (serving/generation.py) admits only requests whose prompt+max_new
+  budget fits — and returned to the free list the moment the sequence
+  finishes, so long and short sequences share the pool without
+  fragmentation (any free block serves any sequence; "fragmentation"
+  can only exist inside a sequence's LAST partially-filled block).
+
+Block 0 is reserved as the null/scratch block: unallocated table
+entries point at it (gathers stay in-bounds; the position mask hides
+the values) and inactive decode slots write into it.
+
+This module is the HOST-side manager (free list, tables, accounting);
+the device-side gather/scatter math lives in
+models/transformer.build_lm_paged_decoder.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import metrics as obs_metrics
+
+__all__ = ["PagedKVCache", "KVPoolExhausted"]
+
+_CACHE_IDS = itertools.count()
+_M_BLOCKS_USED = obs_metrics.gauge(
+    "paddle_tpu_serving_kv_blocks_in_use",
+    "allocated KV-cache blocks (out of kv_blocks_total)", ("server",),
+    always=True)
+_M_BLOCKS_TOTAL = obs_metrics.gauge(
+    "paddle_tpu_serving_kv_blocks_total",
+    "allocatable KV-cache blocks in the preallocated pool", ("server",),
+    always=True)
+_M_UTIL = obs_metrics.gauge(
+    "paddle_tpu_serving_kv_pool_utilization",
+    "fraction of the KV block pool currently allocated", ("server",),
+    always=True)
+
+
+class KVPoolExhausted(RuntimeError):
+    """An allocation asked for more blocks than are free.  The scheduler
+    treats this as admission backpressure (the request waits for blocks
+    to free), never as a crash."""
+
+
+class PagedKVCache:
+    """Free-list manager over one preallocated pool of KV blocks.
+
+    `num_blocks` is the allocatable budget (the device pool holds one
+    extra reserved null block).  `server_label` ties the utilization
+    series to the owning GenerationServer's metrics instance.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int,
+                 server_label: Optional[str] = None):
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        # device block ids 1..num_blocks (0 is the reserved null block)
+        self._free: List[int] = list(range(1, self.num_blocks + 1))
+        self._owned: Dict[object, List[int]] = {}
+        self._lock = threading.Lock()
+        self._sid = server_label or f"kv{next(_CACHE_IDS)}"
+        self._m_used = _M_BLOCKS_USED.labels(server=self._sid)
+        self._m_total = _M_BLOCKS_TOTAL.labels(server=self._sid)
+        self._m_util = _M_UTIL.labels(server=self._sid)
+        self._m_total.set(self.num_blocks)
+        self._publish()
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - self.free_blocks
+
+    def utilization(self) -> float:
+        return self.used_blocks / self.num_blocks
+
+    def blocks_for(self, num_positions: int) -> int:
+        """Blocks needed to hold `num_positions` KV entries."""
+        return -(-int(num_positions) // self.block_size)
+
+    def can_admit(self, num_positions: int) -> bool:
+        n = self.blocks_for(num_positions)
+        if n > self.max_blocks_per_seq:
+            return False
+        with self._lock:
+            return n <= len(self._free)
+
+    def _publish(self):
+        self._m_used.set(self.num_blocks - len(self._free))
+        self._m_util.set((self.num_blocks - len(self._free))
+                         / self.num_blocks)
+
+    # -- alloc/free ---------------------------------------------------------
+    def allocate(self, owner, num_positions: int) -> np.ndarray:
+        """Allocate blocks for `num_positions` under `owner` (one admit
+        = one owner, usually the sequence object) and return the padded
+        block table [max_blocks_per_seq] int32 (tail entries 0 → the
+        null block)."""
+        n = self.blocks_for(num_positions)
+        if n > self.max_blocks_per_seq:
+            raise ValueError(
+                f"{num_positions} positions need {n} blocks > "
+                f"max_blocks_per_seq {self.max_blocks_per_seq}")
+        with self._lock:
+            if owner in self._owned:
+                raise ValueError("owner already holds blocks")
+            if n > len(self._free):
+                raise KVPoolExhausted(
+                    f"need {n} KV blocks, {len(self._free)} free "
+                    f"(pool {self.num_blocks})")
+            blocks = [self._free.pop() for _ in range(n)]
+            self._owned[owner] = blocks
+            self._publish()
+        table = np.zeros(self.max_blocks_per_seq, np.int32)
+        table[:n] = blocks
+        return table
+
+    def release(self, owner) -> None:
+        """Return `owner`'s blocks to the free list (idempotent — a
+        sequence evicted twice must not double-free)."""
+        with self._lock:
+            blocks = self._owned.pop(owner, None)
+            if blocks:
+                self._free.extend(blocks)
+                self._publish()
+
+    def close(self):
+        """Reclaim this pool's registry series (server churn must not
+        grow metric dumps without bound)."""
+        for fam in (_M_BLOCKS_USED, _M_BLOCKS_TOTAL, _M_UTIL):
+            fam.remove(server=self._sid)
+
+    def __repr__(self):
+        return (f"PagedKVCache(blocks={self.num_blocks}, "
+                f"block_size={self.block_size}, "
+                f"free={self.free_blocks})")

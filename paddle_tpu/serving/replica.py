@@ -1,0 +1,253 @@
+"""TCP front for one GenerationServer process — the replica side of the
+multi-replica front door (paddle_tpu/cloud/router.py).
+
+Wire protocol: one JSON object per line, newline-delimited both ways
+(the registry/cluster line-protocol convention, sized for control
+traffic — tokens are a few bytes each and generation is compute-bound,
+so a text protocol costs nothing measurable):
+
+  {"op":"generate","prompt":[..],"max_new":8,"temperature":0,
+   "seed":0,"eos_id":null,"deadline_ms":null,"skip":0}
+      -> {"tok":17} per generated token (the first `skip` tokens are
+         recomputed but NOT re-sent — the router's resume path after a
+         replica death: decode is deterministic per (prompt, seed), so
+         the survivor regenerates the same stream and the client never
+         sees a duplicate), then {"done":true,"n":<generated>}
+      -> {"err":"...","shed":true}  (deadline/saturation shed — a
+         POLICY answer, the router must not retry it)
+      -> {"err":"...","fatal":true} (caller error, e.g. over-capacity
+         request — retrying elsewhere cannot help)
+      -> {"err":"..."}              (replica-local failure — the router
+         retries on a survivor)
+  {"op":"ping"}   -> {"ok":true,"outstanding":N,"free_blocks":F,
+                      "draining":false}
+  {"op":"stats"}  -> {"ok":true,"stats":{...}}
+  {"op":"swap","dir":"..."} -> {"ok":true} after drain+swap+resume
+  {"op":"stop"}   -> {"ok":true}, then the replica shuts down
+
+A replica registers itself in the front door's TTL-lease registry
+(kind "generation") and holds the lease for its lifetime: lease expiry
+IS the health check — a SIGKILLed replica vanishes from the routing
+table within one TTL.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Iterator, Optional
+
+from ..core.resilience import fault_injector
+from .batching import RequestDeadlineExceeded, ServerSaturated
+
+__all__ = ["ReplicaServer", "ReplicaError", "ReplicaShed",
+           "replica_call", "replica_stream"]
+
+
+class ReplicaError(RuntimeError):
+    """The replica answered with a non-shed error (`fatal` marks caller
+    errors that must not be retried on another replica)."""
+
+    def __init__(self, message: str, fatal: bool = False):
+        super().__init__(message)
+        self.fatal = fatal
+
+
+class ReplicaShed(RequestDeadlineExceeded):
+    """The replica shed the request (deadline/saturation policy)."""
+
+
+class ReplicaServer:
+    """Serve one GenerationServer over TCP; optionally hold a TTL lease
+    in a registry so the router can discover and health-check it."""
+
+    def __init__(self, server, port: int = 0, host: str = "127.0.0.1",
+                 registry_addr: Optional[str] = None,
+                 kind: str = "generation", ttl_s: float = 2.0):
+        self._server = server
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self.addr = f"{host}:{self.port}"
+        self._stop = threading.Event()
+        self._lease = None
+        if registry_addr:
+            # lazy import: the registry rides the native lib, which a
+            # plain in-process server never needs
+            from ..cloud.registry import Lease, RegistryClient
+
+            self._lease = Lease(RegistryClient(registry_addr), kind,
+                                self.addr, ttl_s=ttl_s)
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- server side --------------------------------------------------------
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            f = conn.makefile("rw", newline="\n")
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except ValueError:
+                    self._reply(f, {"err": "malformed request",
+                                    "fatal": True})
+                    continue
+                if not self._dispatch(f, req):
+                    break
+        except (OSError, ValueError):
+            pass  # client went away mid-reply; nothing to deliver
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _reply(f, obj) -> None:
+        f.write(json.dumps(obj, separators=(",", ":")) + "\n")
+        f.flush()
+
+    def _dispatch(self, f, req) -> bool:
+        op = req.get("op")
+        if op == "generate":
+            self._op_generate(f, req)
+        elif op == "ping":
+            self._reply(f, {
+                "ok": True,
+                "outstanding": self._server.outstanding_tokens(),
+                "free_blocks": self._server._cache.free_blocks,
+                "draining": self._server._pending_states is not None})
+        elif op == "stats":
+            self._reply(f, {"ok": True, "stats": self._server.stats()})
+        elif op == "swap":
+            try:
+                fault_injector().fire("serving.replica_swap")
+                from .generation import load_generation_model
+
+                states, _ = load_generation_model(req["dir"])
+                ok = self._server.swap_states(
+                    states, wait=True, timeout=req.get("timeout", 120))
+                self._reply(f, {"ok": bool(ok)})
+            except Exception as e:
+                self._reply(f, {"err": f"swap failed: {e!r}"})
+        elif op == "stop":
+            self._reply(f, {"ok": True})
+            self.close()
+            return False
+        else:
+            self._reply(f, {"err": f"unknown op {op!r}", "fatal": True})
+        return True
+
+    def _op_generate(self, f, req):
+        try:
+            stream = self._server.submit(
+                req["prompt"], int(req["max_new"]),
+                temperature=float(req.get("temperature", 0.0)),
+                seed=int(req.get("seed", 0)),
+                eos_id=req.get("eos_id"),
+                deadline_ms=req.get("deadline_ms"))
+        except ServerSaturated as e:
+            self._reply(f, {"err": str(e), "shed": True})
+            return
+        except ValueError as e:
+            # caller error (e.g. over-capacity request): no other
+            # replica can serve it either — don't retry
+            self._reply(f, {"err": str(e), "fatal": True})
+            return
+        except RuntimeError as e:
+            # replica-local state (server closing mid-accept during a
+            # rolling restart): a SURVIVOR can serve this — retryable
+            self._reply(f, {"err": str(e)})
+            return
+        skip = int(req.get("skip", 0))
+        n = 0
+        try:
+            for tok in stream:
+                n += 1
+                if n > skip:
+                    self._reply(f, {"tok": tok})
+            self._reply(f, {"done": True, "n": n})
+        except RequestDeadlineExceeded as e:
+            self._reply(f, {"err": str(e), "shed": True})
+        except Exception as e:
+            self._reply(f, {"err": repr(e)})
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the replica is stopped (a remote `stop` op or
+        close()); the `cli serve` foreground loop."""
+        return self._stop.wait(timeout)
+
+    def close(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._lease is not None:
+            self._lease.release()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- client helpers (used by the router and tests) ---------------------------
+
+def _connect(addr: str, timeout_s: float):
+    host, port = addr.rsplit(":", 1)
+    return socket.create_connection((host, int(port)),
+                                    timeout=timeout_s)
+
+
+def replica_call(addr: str, obj: dict, timeout_s: float = 30.0) -> dict:
+    """One request, one JSON reply (ping/stats/swap/stop)."""
+    with _connect(addr, timeout_s) as s:
+        f = s.makefile("rw", newline="\n")
+        f.write(json.dumps(obj, separators=(",", ":")) + "\n")
+        f.flush()
+        line = f.readline()
+        if not line:
+            raise OSError(f"replica {addr} closed connection")
+        return json.loads(line)
+
+
+def replica_stream(addr: str, obj: dict,
+                   timeout_s: float = 120.0) -> Iterator[int]:
+    """Stream a generate request's tokens; raises ReplicaShed on a
+    policy shed, ReplicaError on replica-reported failure, OSError when
+    the replica dies mid-stream (the router's retry trigger)."""
+    with _connect(addr, timeout_s) as s:
+        f = s.makefile("rw", newline="\n")
+        f.write(json.dumps(obj, separators=(",", ":")) + "\n")
+        f.flush()
+        while True:
+            line = f.readline()
+            if not line:
+                raise OSError(
+                    f"replica {addr} died mid-stream")
+            msg = json.loads(line)
+            if "tok" in msg:
+                yield int(msg["tok"])
+            elif msg.get("done"):
+                return
+            elif "err" in msg:
+                if msg.get("shed"):
+                    raise ReplicaShed(msg["err"])
+                raise ReplicaError(msg["err"],
+                                   fatal=bool(msg.get("fatal")))
+            else:
+                raise ReplicaError(f"unexpected reply {msg!r}")

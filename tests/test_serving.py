@@ -1,11 +1,15 @@
-"""Resident inference server (paddle_tpu/serving.py).
+"""Resident inference server (paddle_tpu/serving/batching.py).
 
 Pins the serving contract: per-request results are IDENTICAL to direct
 single-call execution (dynamic batching must not change numerics —
 is_test batch-norm has no cross-sample coupling), concurrent submits
 aggregate into fewer dispatches, and padding to a bucket never leaks
-into delivered results.
+into delivered results.  Also pins the package compat shim (the old
+`paddle_tpu.serving` module became the serving package) and the
+queue-depth gauge's shed-path update.
 """
+import time
+
 import numpy as np
 
 import paddle_tpu as fluid
@@ -57,6 +61,73 @@ def test_server_matches_direct_and_aggregates():
         assert stats["dispatches"] < 13, stats
     finally:
         server.close()
+
+
+def test_serving_package_compat_shim():
+    """The serving.py -> serving/ package move must keep every historic
+    import path working (examples, benchmarks, user code)."""
+    import paddle_tpu.serving as serving
+    from paddle_tpu.serving import (RequestDeadlineExceeded,
+                                    ServerSaturated)
+    from paddle_tpu.serving.batching import InferenceServer as Impl
+
+    assert serving.InferenceServer is Impl
+    assert issubclass(ServerSaturated, RuntimeError)
+    assert issubclass(RequestDeadlineExceeded, TimeoutError)
+    # and the new generation surface rides the same package
+    for name in ("GenerationServer", "PagedKVCache",
+                 "save_generation_model", "server_from_model_dir"):
+        assert hasattr(serving, name), name
+
+
+def test_queue_depth_gauge_updates_on_deadline_shed():
+    """A deadline storm drains the queue at DEQUEUE time; the gauge
+    must follow it down instead of freezing at the submit-time high
+    water mark (a storm must not read as a permanently full queue)."""
+    from paddle_tpu.core.resilience import fault_injector
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.serving import RequestDeadlineExceeded, batching
+
+    main, startup, predict = _build_cnn()
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    infer_prog = prune(main, [predict], for_test=True)
+
+    was = obs_metrics.enabled()
+    obs_metrics.set_enabled(True)
+    inj = fault_injector()
+    inj.clear()
+    # stall the FIRST dispatch so requests with tiny deadlines pile up
+    # behind it and all expire in the queue
+    inj.inject("serving.dispatch", "delay", delay_s=0.8, nth=1, count=1)
+    server = InferenceServer(infer_prog, "img", predict, scope,
+                             place=fluid.CPUPlace(), buckets=(1,),
+                             window_ms=0.1, max_queue=16)
+    x = np.zeros((3, 16, 16), np.float32)
+    try:
+        f1 = server.submit(x)
+        time.sleep(0.2)       # worker holds f1 inside the stall
+        doomed = [server.submit(x, deadline_ms=1.0) for _ in range(3)]
+        gauge = batching._M_QDEPTH.labels(server=server._sid)
+        assert gauge.value >= 3      # submit-time high water mark
+        for fut in doomed:
+            try:
+                fut.result(timeout=30)
+                raise AssertionError("doomed request delivered")
+            except RequestDeadlineExceeded:
+                pass
+        assert np.asarray(f1.result(timeout=30)).shape == (1, 10)
+        # all sheds happened at dequeue with NO dispatch after them —
+        # only the shed-path gauge update can bring the reading down
+        deadline = time.monotonic() + 5
+        while gauge.value != 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gauge.value == 0, gauge.value
+        assert server.stats()["deadline_expired"] == 3
+    finally:
+        inj.clear()
+        server.close()
+        obs_metrics.set_enabled(was)
 
 
 def test_server_single_request_and_shape_check():

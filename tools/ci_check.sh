@@ -12,17 +12,17 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 export PADDLE_TPU_DATASET="${PADDLE_TPU_DATASET:-synthetic}"
 
-echo "== [1/6] repo lint (tools/lint.py) =="
+echo "== [1/7] repo lint (tools/lint.py) =="
 python tools/lint.py
 
-echo "== [2/6] static verification of example programs =="
+echo "== [2/7] static verification of example programs =="
 python -m paddle_tpu.cli verify \
     examples/transformer_lm.py \
     examples/pipeline_transformer_lm.py \
     examples/serve_image_classifier.py \
     examples/dist_ckpt_worker.py
 
-echo "== [3/6] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
+echo "== [3/7] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
 PADDLE_TPU_VERIFY=error python -m pytest \
     tests/test_analysis.py \
     tests/test_registry.py \
@@ -37,7 +37,7 @@ PADDLE_TPU_VERIFY=error python -m pytest \
 # flake — it fails identically on the pre-PR tree, unrelated to
 # verification)
 
-echo "== [4/6] observability + comm subset with PADDLE_TPU_METRICS=on =="
+echo "== [4/7] observability + comm subset with PADDLE_TPU_METRICS=on =="
 # the instrumented hot paths must behave identically with the metric
 # instruments armed (docs/observability.md); test_comm.py also pins the
 # bucketed wire path's backward compatibility both directions
@@ -49,7 +49,7 @@ PADDLE_TPU_METRICS=on python -m pytest \
     tests/test_comm.py \
     -q -m 'not slow' -p no:cacheprovider
 
-echo "== [5/6] memory layer: fast book subset + memory plan with the optimizer armed =="
+echo "== [5/7] memory layer: fast book subset + memory plan with the optimizer armed =="
 # the whole-program memory layer (donation plan, dead-var freeing,
 # rename pass — docs/performance.md 'Memory') must leave training
 # semantics untouched with the verifier also armed: the book models
@@ -63,7 +63,7 @@ PADDLE_TPU_MEMORY_OPTIMIZE=on PADDLE_TPU_VERIFY=error python -m pytest \
     -q -p no:cacheprovider
 
 
-echo "== [6/6] elastic cluster: fast subset under chaos + metrics =="
+echo "== [6/7] elastic cluster: fast subset under chaos + metrics =="
 # the elastic runtime (docs/resilience.md "Elastic clusters") must hold
 # with the fault injector armed and the metric instruments on: the
 # injected first-rebalance failure is retried by the controller's watch
@@ -99,6 +99,44 @@ lease.release()
 srv.stop()
 ctl.close()
 print("elastic telemetry visible in Prometheus dump")
+EOF
+
+echo "== [7/7] generation serving: fast subset + Prometheus series =="
+# the continuous-batching serving layer (docs/serving.md) must behave
+# identically with the metric instruments armed, and every serving
+# process must expose the generation series a fleet dashboard scrapes
+PADDLE_TPU_METRICS=on python -m pytest \
+    tests/test_generation_serving.py \
+    -q -m 'not slow' -p no:cacheprovider
+PADDLE_TPU_METRICS=on python - <<'EOF'
+import numpy as np
+import paddle_tpu as fluid
+import paddle_tpu.core.framework as fw
+from paddle_tpu.models.transformer import build_lm_paged_decoder
+from paddle_tpu.observability import exporters
+from paddle_tpu.serving import GenerationServer
+
+fw.reset_unique_names()
+startup, dec = build_lm_paged_decoder(23, 4, 4, d_model=16, n_heads=2,
+                                      n_layers=1)
+scope = fluid.Scope()
+fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+states = {n: np.asarray(scope.find_var(n)) for n in dec.state_names}
+srv = GenerationServer(dec, states, slots=2, kv_blocks=8,
+                       place=fluid.CPUPlace())
+assert srv.generate([1, 2, 3], 4, timeout=60)
+text = exporters.prometheus_text()
+for series in ("paddle_tpu_serving_generation_requests_total",
+               "paddle_tpu_serving_generated_tokens_total",
+               "paddle_tpu_serving_decode_ticks_total",
+               "paddle_tpu_serving_generation_shed_total",
+               "paddle_tpu_serving_generation_seconds",
+               "paddle_tpu_serving_first_token_seconds",
+               "paddle_tpu_serving_kv_blocks_in_use",
+               "paddle_tpu_serving_kv_pool_utilization"):
+    assert series in text, f"missing {series} in Prometheus dump"
+srv.close()
+print("generation serving series visible in Prometheus dump")
 EOF
 
 echo "ci_check: all green"

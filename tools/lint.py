@@ -10,9 +10,11 @@ it checks against, so this lint enforces at the SOURCE level:
      descs against these declarations; an undeclared slot list silently
      weakens it to "anything goes".
   2. no bare `except Exception: pass` (or bare `except: pass`) inside
-     `paddle_tpu/core` — the silent-swallow pattern that hid per-op
-     shape-inference failures for months.  Handle the exception, narrow
-     it, or surface it (log/warn/report).
+     `paddle_tpu/core` or `paddle_tpu/serving` — the silent-swallow
+     pattern that hid per-op shape-inference failures for months, and
+     that in the serving worker swallowed worker bugs along with the
+     client-cancellation it meant to tolerate.  Handle the exception,
+     narrow it, or surface it (log/warn/report).
   3. no bare `print(` inside `paddle_tpu/core` or `paddle_tpu/parallel`
      — runtime-layer diagnostics go through `logging` or the
      observability registry/exporters (docs/observability.md) so
@@ -32,9 +34,13 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_PATHS = [os.path.join(REPO_ROOT, "paddle_tpu")]
 
-# rule 2 scope: the core package only (ISSUE: silent failures in the
-# executor/inference layer are the ones that ate diagnostics)
+# rule 2 scope: the core package (ISSUE: silent failures in the
+# executor/inference layer are the ones that ate diagnostics) plus the
+# serving subsystem (a resident scheduler thread that swallows its own
+# exceptions hangs every queued request with no trace)
 CORE_DIR = os.path.join(REPO_ROOT, "paddle_tpu", "core")
+SILENT_EXCEPT_DIRS = (CORE_DIR,
+                      os.path.join(REPO_ROOT, "paddle_tpu", "serving"))
 
 # rule 3 scope: runtime layers that run inside long-lived server
 # processes (core + the pserver/parallel machinery)
@@ -123,7 +129,8 @@ def lint(paths) -> int:
             continue
         violations.extend(check_register_op_slots(tree, path))
         abspath = os.path.abspath(path)
-        if abspath.startswith(CORE_DIR + os.sep):
+        if any(abspath.startswith(d + os.sep)
+               for d in SILENT_EXCEPT_DIRS):
             violations.extend(check_silent_excepts(tree, path))
         if any(abspath.startswith(d + os.sep) for d in NO_PRINT_DIRS):
             violations.extend(check_no_prints(tree, path))
