@@ -30,7 +30,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
 
 
-def run_single(n, batch_per_dev, iters, depth, img):
+def run_single(n, batch_per_dev, iters, depth, img, overlap="off"):
     import jax
 
     # honor an explicit JAX_PLATFORMS=cpu even when the TPU-tunnel site
@@ -57,9 +57,24 @@ def run_single(n, batch_per_dev, iters, depth, img):
         avg = fluid.layers.mean(cost)
         fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg)
 
-    pe = parallel.ParallelExecutor(main, ["img", "label"], [avg],
-                                   mesh={"dp": n},
-                                   startup_program=startup)
+    if overlap != "off":
+        # the MAINLINE multichip path (docs/performance.md "Multichip
+        # sharding"): spmd transpile + bucketed-psum grad overlap, so
+        # scaling rounds measure the transpiler, not a hand-built
+        # executor.  ResNet's training-mode batch_norm makes 'auto'
+        # stand down to the GSPMD step — the record says why.
+        t = fluid.ShardingTranspiler()
+        # shard_optimizer_states=False: the direct ParallelExecutor arm
+        # below runs without ZeRO-1, and an A/B between the arms must
+        # not attribute ZeRO's placement collectives to the overlap path
+        t.transpile(program=main, startup_program=startup,
+                    mesh={"dp": n}, overlap=overlap,
+                    shard_optimizer_states=False)
+        pe = t.build_executor(["img", "label"], [avg])
+    else:
+        pe = parallel.ParallelExecutor(main, ["img", "label"], [avg],
+                                       mesh={"dp": n},
+                                       startup_program=startup)
     r = np.random.RandomState(0)
     feed = {"img": r.rand(batch, 3, img, img).astype("float32")
             .astype("bfloat16"),
@@ -73,6 +88,8 @@ def run_single(n, batch_per_dev, iters, depth, img):
     ms = (time.perf_counter() - t0) / iters * 1000
     out = {"devices": n, "batch": batch, "ms_per_batch": round(ms, 2),
            "images_per_sec": round(batch / ms * 1000, 1)}
+    if overlap != "off":
+        out["overlap"] = dict(pe.overlap_info)
     if jax.default_backend() != "tpu":
         # the communication structure is meaningful even when virtual
         # throughput is not: dp-N must show grad all-reduces (and only
@@ -93,13 +110,21 @@ def main():
     ap.add_argument("--img", type=int, default=32)
     ap.add_argument("--virtual", action="store_true",
                     help="respawn per-N with virtual CPU devices")
+    ap.add_argument("--overlap", default="off",
+                    choices=["off", "auto"],
+                    help="'auto': run the mainline spmd-transpiler "
+                         "path; the bucketed compute/collective "
+                         "overlap engages where eligible and the "
+                         "record carries overlap_info (ResNet's "
+                         "training-mode batch_norm makes it stand "
+                         "down with the reason recorded)")
     ap.add_argument("--single", type=int, default=0,
                     help="(internal) run one N in this process")
     a = ap.parse_args()
 
     if a.single:
         print(json.dumps(run_single(a.single, a.batch_per_dev, a.iters,
-                                    a.depth, a.img)))
+                                    a.depth, a.img, a.overlap)))
         return
 
     counts = [int(x) for x in a.devices.split(",")]
@@ -115,7 +140,7 @@ def main():
                  "--single", str(n),
                  "--batch-per-dev", str(a.batch_per_dev),
                  "--iters", str(a.iters), "--depth", str(a.depth),
-                 "--img", str(a.img)],
+                 "--img", str(a.img), "--overlap", a.overlap],
                 env=env, capture_output=True, text=True, check=True)
             results.append(json.loads(out.stdout.strip().splitlines()[-1]))
         else:
@@ -126,7 +151,7 @@ def main():
                                   "skipped": "not enough devices"}))
                 continue
             results.append(run_single(n, a.batch_per_dev, a.iters,
-                                      a.depth, a.img))
+                                      a.depth, a.img, a.overlap))
     if results:
         base = results[0]["images_per_sec"] / results[0]["devices"]
         for rec in results:

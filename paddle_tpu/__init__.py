@@ -85,6 +85,7 @@ from .memory_optimization_transpiler import memory_optimize  # noqa: F401
 from .parallel.executor import (  # noqa: F401
     DistributeTranspiler,
     ParallelExecutor,
+    ShardingTranspiler,
     SimpleDistributeTranspiler,
 )
 from .parallel.pipeline_program import PipelineExecutor  # noqa: F401

@@ -710,6 +710,34 @@ def check_distributed(ctx):
 # ---------------------------------------------------------------------------
 
 
+@register_pass("sharding-consistency", order=72)
+def check_sharding_consistency(ctx):
+    """Multichip sharding annotations (layers.shard /
+    data(sharding=...) / op dist_attr) validated at build time by
+    re-running the spmd propagation (parallel/spmd.py) and re-emitting
+    its findings: contradictory specs for one var and mesh-axis arity
+    mismatches (spec longer than the tensor's rank, an axis naming two
+    dims, an axis missing from the declared Program.mesh_axes) are
+    errors; resharding hotspots (operands that force GSPMD to
+    all-gather or reshard mid-graph) and non-divisible dims are
+    warnings.  Programs with no sharding annotations skip the pass
+    entirely (docs/performance.md 'Multichip sharding')."""
+    program = ctx.program
+    block = program.global_block()
+    from ..parallel.spmd import has_annotations, propagate_sharding
+
+    if not has_annotations(block):
+        return
+
+    plan = propagate_sharding(program)
+    for f in plan.findings:
+        op = (block.ops[f.op_idx]
+              if f.op_idx is not None and f.op_idx < len(block.ops)
+              else None)
+        yield ctx.diag(f.severity, f.message, block, f.op_idx, op,
+                       hint=f.hint)
+
+
 @register_pass("donation-safety", order=75)
 def check_donation_safety(ctx):
     """Vars hinted `donate=True` (layers.data(donate=True)) hand their

@@ -148,6 +148,17 @@ define_flag("comm_bucket_bytes", 4 << 20,
             "fallback against a server that predates the batch "
             "verbs).  An oversized var still ships, alone in its "
             "bucket")
+define_flag("overlap_bucket_bytes", 4 << 20,
+            "size cap (bytes) for the compute/collective-overlap "
+            "gradient buckets of the spmd path (docs/performance.md "
+            "'Multichip sharding'): ParallelExecutor(overlap="
+            "'bucketed'|'auto') concatenates parameter gradients in "
+            "production (backward) order into buckets of at most this "
+            "many bytes and issues ONE lax.psum per bucket, so early "
+            "buckets' all-reduces overlap with the remaining backward "
+            "compute (DDP-style).  0 puts every gradient in its own "
+            "bucket; the bucket count is pinned structurally via "
+            "compiled_collectives")
 define_flag("memory_optimize", False,
             "whole-program memory optimization "
             "(memory_optimization_transpiler + docs/performance.md "

@@ -43,6 +43,52 @@ __all__ = [
 
 GRAD_SUFFIX = "@GRAD"
 
+
+def normalize_sharding(spec):
+    """Canonical form of a GSPMD-style sharding annotation: a tuple with
+    one entry per tensor dim — `None` (replicated dim), a mesh-axis name
+    string, or a tuple of axis names (dim split over their product).
+    Accepts jax PartitionSpec, lists, or the canonical form itself;
+    returns None for "no annotation"."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        # a bare "dp" would iterate as ('d', 'p') — an unintended rank-2
+        # split over nonexistent axes; demand the explicit per-dim form
+        raise ValueError(
+            f"sharding spec must have one entry per tensor dim — got the "
+            f"bare string {spec!r}; write ({spec!r},) to shard dim 0")
+    out = []
+    for e in tuple(spec):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, str):
+            out.append(e)
+        elif isinstance(e, (list, tuple)):
+            bad = [a for a in e if not isinstance(a, str)]
+            if bad:
+                raise ValueError(
+                    f"sharding spec entry {e!r}: grouped axes must be "
+                    "mesh-axis names")
+            out.append(tuple(e))
+        else:
+            raise ValueError(
+                f"sharding spec entry {e!r} is not None, an axis name, "
+                "or a tuple of axis names")
+    return tuple(out)
+
+
+def sharding_axes(spec):
+    """Flat list of mesh-axis names referenced by a normalized spec (a
+    repeated name appears repeatedly — callers detect duplicates)."""
+    axes = []
+    for e in spec or ():
+        if isinstance(e, str):
+            axes.append(e)
+        elif isinstance(e, tuple):
+            axes.extend(e)
+    return axes
+
 # sentinel "no variable here" slot entries (reference: kEmptyVarName) —
 # grad descs use them for inputs that need no gradient; every name-based
 # walk (execution dispatch, backward, the analysis passes) skips them
@@ -132,6 +178,7 @@ class Variable:
         type: str = VarType.LOD_TENSOR,
         initializer=None,
         donate: bool = False,
+        sharding=None,
     ):
         self.block = block
         self.name = name
@@ -147,6 +194,13 @@ class Variable:
         # .plan_donation validates the hint at build time; the
         # donation-safety analysis pass lints it)
         self.donate = bool(donate)
+        # GSPMD-style sharding annotation (normalize_sharding form): one
+        # entry per dim naming the mesh axis (or axis tuple) that dim is
+        # split over, None = replicated.  Inert under the serial
+        # executor; the spmd transpiler (parallel/spmd.py) propagates it
+        # across ops and lowers the program onto a mesh, and the
+        # sharding-consistency analysis pass lints it at build time.
+        self.sharding = normalize_sharding(sharding)
         # op that produced this var most recently (set by append_op)
         self.op: Optional["Operator"] = None
 
@@ -200,6 +254,9 @@ class Variable:
             "is_parameter": isinstance(self, Parameter),
             "trainable": getattr(self, "trainable", None),
             "donate": self.donate,
+            "sharding": (None if self.sharding is None
+                         else [list(e) if isinstance(e, tuple) else e
+                               for e in self.sharding]),
         }
 
     def __repr__(self):
@@ -272,6 +329,21 @@ class Operator:
 
     def output(self, slot) -> List[str]:
         return self.outputs.get(slot, [])
+
+    @property
+    def dist_attr(self) -> Dict:
+        """Distributed attributes of this op desc — a plain dict rider
+        under attrs["dist_attr"] (so it serializes through
+        to_dict/from_dict with every other attr).  Keys used by the
+        spmd transpiler: "sharding" ({output name -> spec}, an op-level
+        override of the propagated specs), "reduce_axes" (mesh axes the
+        op's output carries a pending partial-sum over).  Reading never
+        inserts the attr (op descs stay fingerprint-stable); write
+        through set_dist_attr."""
+        return self.attrs.get("dist_attr", {})
+
+    def set_dist_attr(self, key: str, value) -> None:
+        self.attrs.setdefault("dist_attr", {})[key] = value
 
     def sub_block(self, attr_name="sub_block") -> Optional["Block"]:
         ref = self.attrs.get(attr_name)
@@ -446,6 +518,11 @@ class Program:
         self.blocks: List[Block] = [Block(self, 0)]
         self._current_block_idx = 0
         self.seed = 0  # program-level RNG seed (0 = derive from executor)
+        # declared device-mesh axes ({name: size}) for the sharding
+        # annotations on this program's vars — set by the user surface
+        # (layers.set_program_mesh) or the spmd transpiler; the
+        # sharding-consistency analysis pass validates specs against it
+        self.mesh_axes: Optional[Dict[str, int]] = None
         self._version = 0  # bumped on mutation -> invalidates compile cache
 
     # -- block management ---------------------------------------------------
@@ -522,7 +599,11 @@ class Program:
         return p
 
     def to_dict(self):
-        return {"blocks": [b.to_dict() for b in self.blocks], "seed": self.seed}
+        d = {"blocks": [b.to_dict() for b in self.blocks],
+             "seed": self.seed}
+        if self.mesh_axes is not None:
+            d["mesh_axes"] = dict(self.mesh_axes)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Program":
@@ -532,6 +613,9 @@ class Program:
         JSON, framework.py to_dict)."""
         p = cls()
         p.seed = d.get("seed", 0)
+        ma = d.get("mesh_axes")
+        p.mesh_axes = ({str(k): int(v) for k, v in ma.items()}
+                       if ma is not None else None)
         # materialize blocks first so sub_block attr refs resolve
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], bd.get("parent_idx", -1))
@@ -547,6 +631,7 @@ class Program:
                     stop_gradient=vd.get("stop_gradient", False),
                     type=vd.get("type", VarType.LOD_TENSOR),
                     donate=vd.get("donate", False),
+                    sharding=vd.get("sharding"),
                 )
                 if vd.get("is_parameter"):
                     kw.pop("persistable")

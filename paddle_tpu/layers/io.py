@@ -13,7 +13,8 @@ __all__ = ["data", "ListenAndServ", "Send", "Recv"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
-         main_program=None, stop_gradient=True, type=None, donate=False):
+         main_program=None, stop_gradient=True, type=None, donate=False,
+         sharding=None):
     """Declare a feed variable.  `append_batch_size=True` prepends -1,
     matching reference layers/io.py:data.
 
@@ -22,7 +23,12 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     validated at build time: donating a buffer the caller still needs —
     e.g. a fetch target — raises `DonationError` before any tracing
     (memory_optimization_transpiler.plan_donation; the donation-safety
-    analysis pass lints the same invariant)."""
+    analysis pass lints the same invariant).
+
+    `sharding`: GSPMD-style per-dim mesh-axis annotation for multichip
+    runs, e.g. `("dp", None)` to split the batch dim over the 'dp' mesh
+    axis (docs/performance.md "Multichip sharding").  Inert under the
+    serial executor; consumed by the spmd transpiler."""
     prog = main_program or default_main_program()
     shape = list(shape)
     if append_batch_size:
@@ -32,7 +38,8 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         kw["type"] = type
     v = prog.global_block().create_var(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
-        stop_gradient=stop_gradient, donate=donate, **kw)
+        stop_gradient=stop_gradient, donate=donate, sharding=sharding,
+        **kw)
     # mirror the var desc into the startup program for symmetry
     default_startup_program()
     return v

@@ -10,8 +10,10 @@ from .collective import (  # noqa: F401
 from .executor import (  # noqa: F401
     DistributeTranspiler,
     ParallelExecutor,
+    ShardingTranspiler,
     SimpleDistributeTranspiler,
 )
+from .spmd import SpmdPlan, propagate_sharding  # noqa: F401
 from .mesh import (  # noqa: F401
     NamedSharding,
     PartitionSpec,

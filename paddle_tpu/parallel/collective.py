@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.execution import data_of, one
+from .mesh import shard_map
 from ..core.registry import register_op
 
 __all__ = ["sharded_embedding_lookup", "shard_embedding_table"]
@@ -77,7 +78,9 @@ def c_broadcast(ctx, ins, attrs):
 def c_ppermute(ctx, ins, attrs):
     x = data_of(one(ins, "X"))
     axis = attrs["ring_id"]
-    n = jax.lax.axis_size(axis)
+    # psum of the literal 1 is the static axis size on every jax
+    # version (jax.lax.axis_size is newer than the floor we support)
+    n = int(jax.lax.psum(1, axis))
     s = attrs.get("shift", 1)
     perm = [(j, (j + s) % n) for j in range(n)]
     return {"Out": jax.lax.ppermute(x, axis, perm)}
@@ -102,7 +105,7 @@ def sharded_embedding_lookup(ids, table, mesh: Mesh, axis: str = "mp"):
     rows_per = vocab // n_shards
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(axis, None)),
         out_specs=P())
     def _lookup(ids_l, tbl_l):
@@ -126,7 +129,7 @@ def sharded_embedding_grad(ids, grad_out, vocab, mesh: Mesh,
     rows_per = vocab // n_shards
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P()),
         out_specs=P(axis, None))
     def _scatter(ids_l, g_l):

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import shard_map
+
 __all__ = ["moe_gate", "moe_dense", "moe_ffn", "moe_ffn_a2a",
            "load_balance", "drop_rate"]
 
@@ -141,7 +143,7 @@ def moe_ffn(x, gate_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
                            dispatch).astype(x.dtype)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
         out_specs=P(axis))
     def _experts(inp, wi, wo):
@@ -171,7 +173,7 @@ def moe_ffn_a2a(x, gate_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
     c_loc = _capacity(T // n, E, capacity_factor, top_k)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=(P(axis), P()))
     def _run(x_blk, gw, wi, wo):

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import shard_map
+
 __all__ = ["ring_attention", "ring_attention_local",
            "all_to_all_attention", "attention_reference"]
 
@@ -116,7 +118,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     assert seq % n == 0, "seq length must divide the sp axis"
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None))
     def _ring(q_blk, k_blk, v_blk):
@@ -135,7 +137,7 @@ def all_to_all_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     assert q.shape[2] % n == 0, "head count must divide the sp axis"
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None))
     def _u(q_blk, k_blk, v_blk):

@@ -35,6 +35,13 @@ class OpTest:
     inputs: dict = {}
     outputs: dict = {}
     attrs: dict = {}
+    # output name -> ndarray: weight that output elementwise inside the
+    # scalar test loss (mean(out * w) instead of mean(out)).  Needed when
+    # the plain mean is a CONSTANT of the inputs — e.g. softmax rows sum
+    # to 1, so mean(softmax(x)) has a zero true gradient and the
+    # finite-difference check compares float32 rounding noise against
+    # itself (an intermittent tier-1 flake before this knob existed).
+    grad_output_weights: dict = {}
 
     # -- program construction ------------------------------------------------
     def _entries(self, d):
@@ -134,9 +141,22 @@ class OpTest:
                     if not np.issubdtype(np.asarray(data).dtype,
                                          np.floating):
                         continue
+                    src = name
+                    w = self.grad_output_weights.get(name)
+                    if w is not None:
+                        w = np.asarray(w, np.float32)
+                        block.create_var(name=f"{name}@LOSS_W",
+                                         shape=tuple(w.shape),
+                                         dtype="float32")
+                        feed[f"{name}@LOSS_W"] = w
+                        src = f"{name}@WEIGHTED"
+                        block.append_op(
+                            "elementwise_mul",
+                            {"X": [name], "Y": [f"{name}@LOSS_W"]},
+                            {"Out": [src]})
                     m = block.create_var(
                         name=f"{name}@MEAN", dtype="float32")
-                    block.append_op("mean", {"X": [name]},
+                    block.append_op("mean", {"X": [src]},
                                     {"Out": [m.name]})
                     means.append(m.name)
             loss = block.create_var(name="loss@TEST", dtype="float32")

@@ -151,12 +151,26 @@ class TestSoftmax(OpTest):
         e = np.exp(x - x.max(-1, keepdims=True))
         self.inputs = {"X": x}
         self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        # softmax rows sum to 1, so the harness's plain mean(Out) loss is
+        # CONSTANT in X: its true gradient is 0 and the check compares
+        # float32 rounding noise right at the tolerance — the historical
+        # intermittent tier-1 flake.  A fixed non-uniform weighting makes
+        # the loss (and gradient) a real function of X.
+        # wide spread so the signal dominates the f32 rounding noise in
+        # the central differences (a narrow spread left it borderline)
+        self.grad_output_weights = {
+            "Out": np.linspace(-4.0, 4.0, 28, dtype=np.float32)
+            .reshape(4, 7)}
 
     def test_output(self):
         self.check_output()
 
     def test_grad(self):
-        self.check_grad(["X"], max_relative_error=1e-2)
+        # wider central-difference step: softmax is smooth, so the
+        # truncation error stays negligible while the f32 eval noise
+        # (∝ 1/delta) drops well under the tolerance
+        self.check_grad(["X"], max_relative_error=1e-2,
+                        numeric_delta=2e-3)
 
 
 class TestMean(OpTest):

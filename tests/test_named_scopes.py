@@ -25,8 +25,10 @@ def test_compiled_block_carries_op_scopes():
     states = {n: np.asarray(scope.find_var(n)) for n in fn.state_in_names}
     feeds = {"x": np.zeros((2, 4), np.float32),
              "y": np.zeros((2, 1), np.float32)}
-    ir = jax.jit(fn).lower(feeds, states,
-                           jax.random.key(0)).as_text(debug_info=True)
+    from paddle_tpu.profiler import lowered_ir_text
+
+    ir = lowered_ir_text(jax.jit(fn).lower(feeds, states,
+                                           jax.random.key(0)))
 
     # forward ops, grad ops and optimizer ops are all attributed
     for marker in ("mul:", "relu:", "mean:", "sgd:", "mul_grad:"):

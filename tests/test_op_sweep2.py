@@ -551,7 +551,8 @@ def test_collective_ops_under_shard_map():
         return tuple(fetches[n] for n in ("ar", "mean", "mx", "ag", "rs"))
 
     x = np.arange(8, dtype=np.float32).reshape(8, 1)  # row i on device i
-    sharded = jax.shard_map(
+    from paddle_tpu.parallel.mesh import shard_map
+    sharded = shard_map(
         lambda xl: local({"x": xl}), mesh=mesh,
         in_specs=P("dp"), out_specs=(P("dp"), P("dp"), P("dp"), P("dp"),
                                      P("dp")))
@@ -711,8 +712,9 @@ def test_collective_broadcast_and_ppermute():
         return fetches["bc"], fetches["pp"]
 
     x = np.arange(8, dtype=np.float32).reshape(8, 1)
-    bc, pp = jax.shard_map(local, mesh=mesh, in_specs=P("dp"),
-                           out_specs=(P("dp"), P("dp")))(x)
+    from paddle_tpu.parallel.mesh import shard_map
+    bc, pp = shard_map(local, mesh=mesh, in_specs=P("dp"),
+                       out_specs=(P("dp"), P("dp")))(x)
     np.testing.assert_allclose(np.asarray(bc), np.full((8, 1), 2.0))
     np.testing.assert_allclose(np.asarray(pp).reshape(-1),
                                np.roll(np.arange(8), -1 * -1))

@@ -273,7 +273,9 @@ def test_collective_ops_in_program():
 
     from jax.sharding import PartitionSpec as P
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+    from paddle_tpu.parallel.mesh import shard_map
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
                        out_specs=P("dp"))
     def run(x):
         from paddle_tpu.core.execution import DictEnv
