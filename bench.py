@@ -41,10 +41,16 @@ with the host-blocked fraction of each loop; BENCH_PREFETCH_ITERS
 steps), BENCH_COMM=1 (pserver comm microbench: per-var serial wire
 path vs bucketed+concurrent CommPool over 2 in-process pservers x 64
 small grads, with a byte-identical final-params check), BENCH_SERVING=1
-(generation serving microbench: continuous batching vs drain-then-refill
-static batch under the open-loop mixed-length load generator —
-benchmark/run_serving.py — with tokens/s, p50/p99, shed rate, KV-pool
-utilization, and a Prometheus dump at BENCH_SERVING_PROM if set).
+(generation serving microbench: the scheduler/optimization ablation
+ladder — static batch, continuous, +prefix caching, +speculative
+decoding, both — under the shared-prefix mixed-length open-loop load
+generator, benchmark/run_serving.py, with tokens/s, p50/p99, shed
+rate, KV-pool utilization, prefix hit rate, draft accept rate, the
+KV-quantization residency table, and a Prometheus dump at
+BENCH_SERVING_PROM if set.  Knobs: BENCH_SERVING_PREFIX_POOL/
+_PREFIX_LEN/_PREFIX_HIT shape the shared-prefix workload,
+BENCH_SERVING_SPEC_K sets the draft length, BENCH_SERVING_SPEC=0 /
+BENCH_SERVING_QUANT=0 skip those sections).
 """
 import json
 import os
@@ -478,8 +484,17 @@ def main():
     if os.environ.get("BENCH_SERVING", "0").lower() in ("1", "true",
                                                         "yes", "on"):
         from run_serving import run_serving_bench
+        env = os.environ.get
         out["serving"] = run_serving_bench(
-            prom_out=os.environ.get("BENCH_SERVING_PROM", ""))
+            prom_out=env("BENCH_SERVING_PROM", ""),
+            prefix_pool=int(env("BENCH_SERVING_PREFIX_POOL", "3")),
+            prefix_len=int(env("BENCH_SERVING_PREFIX_LEN", "24")),
+            prefix_hit=float(env("BENCH_SERVING_PREFIX_HIT", "0.75")),
+            spec_k=int(env("BENCH_SERVING_SPEC_K", "4")),
+            with_spec=env("BENCH_SERVING_SPEC", "1").lower() not in (
+                "0", "false", "no", "off"),
+            with_quant=env("BENCH_SERVING_QUANT", "1").lower() not in (
+                "0", "false", "no", "off"))
     if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
             "0", "false", "no", "off"):
         conv = run_convergence()

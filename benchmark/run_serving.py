@@ -1,5 +1,5 @@
-"""Open-loop mixed-length generation serving load: continuous batching
-vs the drain-then-refill static batch.
+"""Open-loop mixed-length generation serving load: scheduling AND the
+algorithmic serving optimizations, measured one ablation at a time.
 
 The generator is OPEN-LOOP: request arrival times come from the rate
 schedule alone (never from completions), which is what exposes a
@@ -11,18 +11,33 @@ every batch runs at the speed of its LONGEST member, which is exactly
 the pathology continuous batching removes (finished sequences leave
 immediately and queued requests take their slots between ticks).
 
-Both modes run the SAME compiled decode step, model, KV pool, and
-request set — the only difference is GenerationServer's
-static_batch flag — so the measured ratio is pure scheduling.
+On top of the PR 8 static-vs-continuous comparison this bench drives
+the SHARED-PREFIX workload (a configurable pool of system prompts +
+hit ratio — the millions-of-users shape) through the ablation ladder:
 
-Reports per mode: sustained tokens/s, p50/p99 request latency, shed
-rate, and peak/mean KV-pool utilization; with --prom_out (or under
-bench.py BENCH_SERVING=1) the run writes the full Prometheus dump of
-the `paddle_tpu_serving_*` series.
+  static_batch   drain-then-refill baseline
+  continuous     PR 8 scheduling (prefix cache off, no draft)
+  prefix         + block-level prefix caching
+  spec           + speculative decoding (draft model)
+  prefix+spec    both
+
+Every row runs the same request set and reports sustained tokens/s,
+p50/p99 request latency, shed rate, peak/mean KV-pool utilization,
+prefix-cache hit rate, draft accept rate, and peak resident sequences.
+Speculative rows TRAIN the target and a smaller draft briefly on a
+cyclic-motif stream first (a random-init draft agrees with a
+random-init target at ~1/vocab — no real serving deployment runs an
+untrained draft, and the accept rate is the whole mechanism).
+
+A final section sizes KV QUANTIZATION: same device byte budget, pool
+blocks re-derived per kv_dtype, long-lived requests — reporting how
+many sequences each precision holds resident at once.
 
 Usage: python benchmark/run_serving.py [--requests 48] [--rate 0]
        [--slots 4] [--kv-blocks 56] [--block-size 8] [--d-model 128]
-       [--layers 2] [--heads 4] [--prom_out serving_prom.txt]
+       [--layers 2] [--heads 4] [--prefix-pool 3] [--prefix-len 24]
+       [--prefix-hit 0.75] [--spec-k 4] [--no-spec] [--no-quant]
+       [--prom_out serving_prom.txt]
 (--rate 0 = saturation: the whole request set arrives up front.)
 """
 from __future__ import annotations
@@ -39,9 +54,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 VOCAB = 211
+MOTIF = [3, 17, 42, 9, 88, 120, 5, 61, 199, 14, 73]
 
 
-def _build_decoder(d_model, n_layers, n_heads, block_size, max_blocks):
+def _train_lm(d_model, n_layers, n_heads, max_len, iters=120, lr=3e-3,
+              batch=8, seed=0):
+    """Teach one decoder-only LM the cyclic motif (teacher-forced next-
+    token loss) and return its trained state dict, extracted under the
+    SAME unique-name discipline build_lm_paged_decoder uses.  A few
+    seconds on CPU — the motif is trivial — but it makes greedy decode
+    PREDICTABLE, which is what gives a smaller draft a real accept
+    rate against the target."""
+    import paddle_tpu as fluid
+    import paddle_tpu.core.framework as fw
+    from paddle_tpu.models.transformer import transformer_lm
+
+    fw.reset_unique_names()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[max_len],
+                                dtype="int64")
+        lbl = fluid.layers.data(name="lbl", shape=[max_len, 1],
+                                dtype="int64")
+        probs = transformer_lm(ids, VOCAB, d_model=d_model,
+                               n_heads=n_heads, n_layers=n_layers,
+                               max_len=max_len)
+        p2 = fluid.layers.reshape(probs, shape=[-1, VOCAB])
+        l2 = fluid.layers.reshape(lbl, shape=[-1, 1])
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=p2, label=l2))
+        fluid.Adam(learning_rate=lr).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(seed)
+    motif = np.asarray(MOTIF, np.int64)
+    for _ in range(iters):
+        offs = r.randint(0, len(motif), batch)
+        rows = np.stack([
+            motif[(np.arange(max_len + 1) + o) % len(motif)]
+            for o in offs])
+        exe.run(main, feed={
+            "ids": rows[:, :max_len].astype(np.int32),
+            "lbl": rows[:, 1:, None].astype(np.int32)},
+            fetch_list=[loss], scope=scope)
+    params = [v.name for v in main.global_block().all_parameters()]
+    return {n: np.asarray(scope.find_var(n)) for n in params}
+
+
+def _build_decoder(d_model, n_layers, n_heads, block_size, max_blocks,
+                   kv_dtype=None, states=None):
     import paddle_tpu as fluid
     import paddle_tpu.core.framework as fw
     from paddle_tpu.models.transformer import build_lm_paged_decoder
@@ -49,20 +111,34 @@ def _build_decoder(d_model, n_layers, n_heads, block_size, max_blocks):
     fw.reset_unique_names()
     startup, dec = build_lm_paged_decoder(
         VOCAB, block_size, max_blocks, d_model=d_model, n_heads=n_heads,
-        n_layers=n_layers)
-    scope = fluid.Scope()
-    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
-    states = {n: np.asarray(scope.find_var(n)) for n in dec.state_names}
+        n_layers=n_layers, kv_dtype=kv_dtype)
+    if states is None:
+        scope = fluid.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+        states = {n: np.asarray(scope.find_var(n))
+                  for n in dec.state_names}
     return dec, states
 
 
-def make_requests(n, max_len, rng, long_every=4):
+def make_requests(n, max_len, rng, long_every=4, prefix_pool=0,
+                  prefix_len=0, prefix_hit=0.0):
     """Mixed-length open-loop mix: 1 long pole per `long_every`
     requests, the rest short — the shape that separates the two
-    schedulers (a drain-then-refill batch always waits for its pole)."""
+    schedulers (a drain-then-refill batch always waits for its pole).
+
+    With `prefix_pool` > 0, a fraction `prefix_hit` of requests draw
+    their first `prefix_len` tokens from a pool of `prefix_pool`
+    distinct shared prefixes (system prompts) — the workload shape
+    block-level prefix caching converts into skipped prefill."""
+    prefixes = [list(rng.randint(0, VOCAB, prefix_len))
+                for _ in range(prefix_pool)]
     reqs = []
     for i in range(n):
-        prompt = list(rng.randint(0, VOCAB, rng.randint(2, 9)))
+        if prefixes and rng.rand() < prefix_hit:
+            prompt = (prefixes[rng.randint(len(prefixes))]
+                      + list(rng.randint(0, VOCAB, rng.randint(2, 9))))
+        else:
+            prompt = list(rng.randint(0, VOCAB, rng.randint(2, 9)))
         if i % long_every == long_every - 1:
             max_new = max_len - len(prompt) - 8   # long pole
         else:
@@ -71,27 +147,36 @@ def make_requests(n, max_len, rng, long_every=4):
     return reqs
 
 
-def run_load(dec, states, reqs, *, static_batch, slots, kv_blocks,
-             rate_rps=0.0, deadline_ms=None, place=None):
-    """Drive one request set through one scheduler mode; returns the
-    measured row (tokens/s, latency percentiles, shed rate, KV util)."""
+def run_load(dec, states, reqs, *, static_batch=False, slots=4,
+             kv_blocks=56, rate_rps=0.0, deadline_ms=None, place=None,
+             prefix_cache=False, draft=None, draft_states=None,
+             spec_k=4, mode_label=None):
+    """Drive one request set through one server configuration; returns
+    the measured row (tokens/s, latency percentiles, shed rate, KV
+    util, prefix hit rate, draft accept rate, peak residency)."""
     import paddle_tpu as fluid
     from paddle_tpu.serving import GenerationServer, ServerSaturated
 
     server = GenerationServer(
         dec, states, slots=slots, kv_blocks=kv_blocks,
-        static_batch=static_batch, place=place or fluid.CPUPlace())
+        static_batch=static_batch, place=place or fluid.CPUPlace(),
+        prefix_cache=prefix_cache, draft_decoder=draft,
+        draft_states=draft_states,
+        spec_k=spec_k if draft is not None else None)
     n = len(reqs)
     lat = [None] * n
     toks = [0] * n
     shed = [False] * n
     waiters = []
     util_samples = []
+    resident_samples = []
     stop_sampling = threading.Event()
 
     def sample_util():
         while not stop_sampling.wait(0.02):
-            util_samples.append(server.stats()["kv_pool_utilization"])
+            st = server.stats()
+            util_samples.append(st["kv_pool_utilization"])
+            resident_samples.append(st["active_sequences"])
 
     sampler = threading.Thread(target=sample_util, daemon=True)
     sampler.start()
@@ -132,8 +217,11 @@ def run_load(dec, states, reqs, *, static_batch, slots, kv_blocks,
 
     done_lat = [l for l in lat if l is not None]
     total_tokens = sum(toks)
+    lookups = stats["prefix_hits"] + stats["prefix_misses"]
+    if mode_label is None:
+        mode_label = "static_batch" if static_batch else "continuous"
     return {
-        "mode": "static_batch" if static_batch else "continuous",
+        "mode": mode_label,
         "requests": n,
         "completed": len(done_lat),
         "tokens": total_tokens,
@@ -148,17 +236,72 @@ def run_load(dec, states, reqs, *, static_batch, slots, kv_blocks,
         else None,
         "kv_util_mean": round(float(np.mean(util_samples)), 3)
         if util_samples else None,
+        "resident_peak": int(max(resident_samples))
+        if resident_samples else None,
         "decode_ticks": stats["ticks"],
+        "prefix_hit_rate": round(stats["prefix_hits"] / lookups, 3)
+        if lookups else None,
+        "draft_accept_rate": round(
+            stats["draft_accepted"] / stats["draft_proposed"], 3)
+        if stats["draft_proposed"] else None,
     }
+
+
+def _quant_residency(d_model, n_layers, n_heads, block_size, max_blocks,
+                     states, kv_blocks_fp32, place=None):
+    """Same device byte budget per precision, pool blocks re-derived
+    from bytes_per_block, long-lived concurrent requests: how many
+    sequences does each kv_dtype hold resident at once?"""
+    import paddle_tpu as fluid
+
+    rows = {}
+    rng = np.random.RandomState(7)
+    budget = None
+    for kv_dtype in ("fp32", "bf16", "int8"):
+        dec, _ = _build_decoder(d_model, n_layers, n_heads, block_size,
+                                max_blocks, kv_dtype=kv_dtype,
+                                states=states)
+        if budget is None:
+            budget = kv_blocks_fp32 * dec.bytes_per_block
+        kv_blocks = max(1, budget // dec.bytes_per_block)
+        from paddle_tpu.serving import GenerationServer
+
+        srv = GenerationServer(dec, states, slots=64,
+                               kv_blocks=int(kv_blocks),
+                               place=place or fluid.CPUPlace())
+        max_len = block_size * max_blocks
+        n_req = int(kv_blocks) // max(1, dec.max_blocks_per_seq) + 6
+        streams = [srv.submit(list(rng.randint(0, VOCAB, 4)),
+                              max_len - 12)
+                   for _ in range(n_req)]
+        peak = 0
+        deadline = time.monotonic() + 120
+        while (any(not s.done for s in streams)
+               and time.monotonic() < deadline):
+            peak = max(peak, srv.stats()["active_sequences"])
+            time.sleep(0.01)
+        srv.close()
+        rows[kv_dtype] = {"kv_blocks": int(kv_blocks),
+                          "bytes_per_block": dec.bytes_per_block,
+                          "resident_peak": peak}
+    rows["int8_vs_fp32_residency"] = round(
+        rows["int8"]["resident_peak"]
+        / max(rows["fp32"]["resident_peak"], 1), 2)
+    rows["byte_budget"] = int(budget)
+    return rows
 
 
 def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
                       block_size=8, max_blocks=12, d_model=128,
                       n_layers=2, n_heads=4, deadline_ms=None,
-                      prom_out="", trials=2):
-    """BENCH_SERVING entry point (bench.py): both scheduler modes over
-    the same mixed-length open-loop request set; best-of-`trials` per
-    mode; optional Prometheus dump of the serving series."""
+                      prom_out="", trials=2, prefix_pool=3,
+                      prefix_len=24, prefix_hit=0.75, spec_k=4,
+                      draft_d_model=32, draft_layers=1, with_spec=True,
+                      with_quant=True):
+    """BENCH_SERVING entry point (bench.py): the scheduler ablation
+    ladder over the same shared-prefix mixed-length open-loop request
+    set; best-of-`trials` per mode; optional Prometheus dump of the
+    serving series."""
     from paddle_tpu.observability import exporters
     from paddle_tpu.observability import metrics as obs_metrics
 
@@ -168,33 +311,79 @@ def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
     metrics_were_on = obs_metrics.enabled()
     obs_metrics.set_enabled(True)
     try:
+        max_len = block_size * max_blocks
+        t0 = time.perf_counter()
+        states = draft_states = None
+        if with_spec:
+            states = _train_lm(d_model, n_layers, n_heads, max_len)
+            draft_states = _train_lm(draft_d_model, draft_layers,
+                                     n_heads, max_len, iters=120,
+                                     seed=1)
+        train_s = round(time.perf_counter() - t0, 1)
         dec, states = _build_decoder(d_model, n_layers, n_heads,
-                                     block_size, max_blocks)
-        reqs = make_requests(requests, block_size * max_blocks,
-                             np.random.RandomState(0))
+                                     block_size, max_blocks,
+                                     states=states)
+        draft = None
+        if with_spec:
+            draft, draft_states = _build_decoder(
+                draft_d_model, draft_layers, n_heads, block_size,
+                max_blocks, states=draft_states)
+        reqs = make_requests(requests, max_len, np.random.RandomState(0),
+                             prefix_pool=prefix_pool,
+                             prefix_len=prefix_len,
+                             prefix_hit=prefix_hit)
+        ladder = [
+            ("static_batch", dict(static_batch=True)),
+            ("continuous", dict()),
+            ("prefix", dict(prefix_cache=True)),
+        ]
+        if with_spec:
+            ladder += [
+                ("spec", dict(draft=draft, draft_states=draft_states,
+                              spec_k=spec_k)),
+                ("prefix+spec", dict(prefix_cache=True, draft=draft,
+                                     draft_states=draft_states,
+                                     spec_k=spec_k)),
+            ]
         rows = {}
-        for static in (True, False):
+        for label, kw in ladder:
             best = None
             for _ in range(trials):
-                row = run_load(dec, states, reqs, static_batch=static,
-                               slots=slots, kv_blocks=kv_blocks,
-                               rate_rps=rate_rps,
-                               deadline_ms=deadline_ms)
+                row = run_load(dec, states, reqs, slots=slots,
+                               kv_blocks=kv_blocks, rate_rps=rate_rps,
+                               deadline_ms=deadline_ms,
+                               mode_label=label, **kw)
                 if best is None or row["tokens_per_sec"] > best[
                         "tokens_per_sec"]:
                     best = row
-            rows[best["mode"]] = best
+            rows[label] = best
+        base = rows["continuous"]["tokens_per_sec"]
         out = {
             "bench": "serving",
             "slots": slots, "kv_blocks": kv_blocks,
             "block_size": block_size, "d_model": d_model,
             "layers": n_layers, "rate_rps": rate_rps,
-            "static_batch": rows["static_batch"],
-            "continuous": rows["continuous"],
+            "prefix_pool": prefix_pool, "prefix_len": prefix_len,
+            "prefix_hit": prefix_hit,
+            "spec_k": spec_k if with_spec else 0,
+            "train_s": train_s,
+            "ablation": rows,
             "continuous_speedup": round(
-                rows["continuous"]["tokens_per_sec"]
-                / max(rows["static_batch"]["tokens_per_sec"], 1e-9), 2),
+                base / max(rows["static_batch"]["tokens_per_sec"],
+                           1e-9), 2),
+            "prefix_speedup": round(
+                rows["prefix"]["tokens_per_sec"] / max(base, 1e-9), 2),
         }
+        if with_spec:
+            out["spec_speedup"] = round(
+                rows["spec"]["tokens_per_sec"] / max(base, 1e-9), 2)
+            out["stacked_speedup"] = round(
+                rows["prefix+spec"]["tokens_per_sec"]
+                / max(base, 1e-9), 2)
+        if with_quant:
+            out["kv_quantization"] = _quant_residency(
+                d_model, n_layers, n_heads, block_size, max_blocks,
+                states, kv_blocks)
         if prom_out:
             out["prometheus_dump"] = exporters.write_prometheus(prom_out)
         return out
@@ -217,6 +406,17 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--trials", type=int, default=2)
+    ap.add_argument("--prefix-pool", type=int, default=3,
+                    help="distinct shared prefixes (system prompts)")
+    ap.add_argument("--prefix-len", type=int, default=24)
+    ap.add_argument("--prefix-hit", type=float, default=0.75,
+                    help="fraction of requests drawing a pooled prefix")
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--no-spec", action="store_true",
+                    help="skip the speculative-decoding rows (and the "
+                    "brief target/draft training they need)")
+    ap.add_argument("--no-quant", action="store_true",
+                    help="skip the KV-quantization residency section")
     ap.add_argument("--prom_out", default="",
                     help="write the Prometheus text dump here")
     a = ap.parse_args()
@@ -225,6 +425,9 @@ def main():
         kv_blocks=a.kv_blocks, block_size=a.block_size,
         max_blocks=a.max_blocks, d_model=a.d_model, n_layers=a.layers,
         n_heads=a.heads, deadline_ms=a.deadline_ms, trials=a.trials,
+        prefix_pool=a.prefix_pool, prefix_len=a.prefix_len,
+        prefix_hit=a.prefix_hit, spec_k=a.spec_k,
+        with_spec=not a.no_spec, with_quant=not a.no_quant,
         prom_out=a.prom_out)
     print(json.dumps(out))
 

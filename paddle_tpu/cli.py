@@ -333,6 +333,17 @@ def cmd_serve(argv):
                     help="KV pool blocks (0 = model spec / default 64)")
     ap.add_argument("--block_size", type=int, default=0,
                     help="KV block size in positions (0 = spec / 16)")
+    ap.add_argument("--kv_dtype", default="",
+                    help="KV pool precision: fp32|bf16|int8 ('' = "
+                    "model spec / PADDLE_TPU_SERVING_KV_DTYPE / fp32); "
+                    "docs/serving.md 'KV quantization'")
+    ap.add_argument("--spec_k", type=int, default=0,
+                    help="speculative draft tokens per tick (0 = model "
+                    "spec / flag default; needs draft params in the "
+                    "model dir)")
+    ap.add_argument("--no_draft", action="store_true",
+                    help="ignore draft params in the model dir "
+                    "(disable speculative decoding)")
     ap.add_argument("--registry",
                     default=os.environ.get("PADDLE_TPU_REGISTRY", ""),
                     help="TTL-lease registry HOST:PORT to register "
@@ -346,6 +357,9 @@ def cmd_serve(argv):
         args.model_dir, slots=args.slots or None,
         kv_blocks=args.kv_blocks or None,
         block_size=args.block_size or None,
+        kv_dtype=args.kv_dtype or None,
+        spec_k=args.spec_k or None,
+        use_draft=not args.no_draft,
         place=_place(args.use_tpu))
     rep = ReplicaServer(server, port=args.port, host=args.host,
                         registry_addr=args.registry or None,

@@ -489,7 +489,7 @@ def build_lm_kv_decoder(vocab_size, max_len, d_model=256, n_heads=4,
 
 def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
                            d_model=256, n_heads=4, n_layers=2,
-                           d_inner=None):
+                           d_inner=None, kv_dtype=None):
     """Paged-attention decode step for the decoder-only LM.
 
     `build_lm_kv_decoder` owns a dense per-sequence cache
@@ -534,6 +534,32 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
       decoder.state_names — parameter names, same trained values as the
       Program path (shared structural extraction with the dense
       decoder).
+
+    `kv_dtype` selects the POOL's storage precision (the
+    quantize-on-write / dequantize-on-gather side of docs/serving.md
+    "KV quantization"; compute stays float32):
+      * "fp32" (default): plain float32 blocks;
+      * "bf16": blocks stored bfloat16 (half the resident bytes,
+        ~mantissa-rounding error on attention values);
+      * "int8": blocks stored int8 with ONE float32 scale per
+        (layer, block).  A write re-quantizes the whole target block
+        under the new running max (blocks fill strictly in position
+        order, so the valid region is exactly the offsets below the
+        cursor) — a quarter of the resident bytes.
+    None reads the `serving_kv_dtype` flag (PADDLE_TPU_SERVING_KV_DTYPE)
+    and falls back to fp32.  Pools for bf16/int8 are pytrees the caller
+    treats opaquely; `decoder.bytes_per_block` reports the resident
+    K+V bytes per block for sizing/telemetry.
+
+    `decoder.step_window(states, pool_k, pool_v, tables, positions,
+    tokens [S, W], seeds, temps, n_valid [S]) -> (preds [S, W], pools)`
+    is the teacher-forced MULTI-position step: slot s processes
+    positions `positions[s] .. positions[s]+n_valid[s]-1` with the
+    given tokens in ONE dispatch (causal within the window), writing
+    each position's K/V and returning each position's next-token
+    prediction.  It is what chunked prefill and speculative-decoding
+    verification (serving/generation.py) run; window rows past
+    n_valid write into the null block and return garbage.
     """
     import functools
     import math
@@ -541,10 +567,19 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
     import jax
     import jax.numpy as jnp
 
+    from ..core import flags as core_flags
+
     d_inner = d_inner or 4 * d_model
     d_head = d_model // n_heads
     nb, bs = int(max_blocks_per_seq), int(block_size)
     max_len = nb * bs
+    if kv_dtype is None:
+        kv_dtype = core_flags.get_flag("serving_kv_dtype") or "fp32"
+    kv_dtype = {"float32": "fp32", "bfloat16": "bf16"}.get(
+        str(kv_dtype).lower(), str(kv_dtype).lower())
+    if kv_dtype not in ("fp32", "bf16", "int8"):
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r} not in ('fp32', 'bf16', 'int8')")
 
     startup, shapes, tok_emb, pos_tab, lns, weights, biases = (
         _lm_param_structure(vocab_size, max_len, d_model, n_heads,
@@ -555,6 +590,56 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
     # whole cache per token); CPU has no donation support and would
     # warn once per compile, so only donate where it lands
     donate = (1, 2) if jax.default_backend() != "cpu" else ()
+
+    # -- pool storage: quantize-on-write / dequantize-on-gather ------------
+    def _write(pool, l, wb, wi, row):
+        """Write `row` [S, D] at (layer l, block wb[s], offset wi[s])."""
+        if kv_dtype == "fp32":
+            return pool.at[l, wb, wi].set(row)
+        if kv_dtype == "bf16":
+            return pool.at[l, wb, wi].set(row.astype(jnp.bfloat16))
+        q, sc_ = pool
+        # int8, one scale per (layer, block): re-quantize the whole
+        # block under the running max.  Blocks fill strictly in
+        # position order, so offsets < wi are the valid entries and
+        # everything above is stale garbage that must NOT widen the
+        # scale (a freshly-reused block holds a dead sequence's data).
+        blk = q[l, wb].astype(jnp.float32)                  # [S, BS, D]
+        s_old = sc_[l, wb]                                  # [S]
+        deq = blk * s_old[:, None, None]
+        offs = jnp.arange(bs)
+        deq = jnp.where((offs[None, :] < wi[:, None])[..., None],
+                        deq, 0.0)
+        deq = jnp.where((offs[None, :] == wi[:, None])[..., None],
+                        row[:, None, :], deq)
+        m = jnp.max(jnp.abs(deq), axis=(1, 2))
+        new_scale = jnp.maximum(m, 1e-8) / 127.0
+        qn = jnp.clip(jnp.round(deq / new_scale[:, None, None]),
+                      -127, 127).astype(jnp.int8)
+        return (q.at[l, wb].set(qn), sc_.at[l, wb].set(new_scale))
+
+    def _gather(pool, l, tables):
+        """Dequantized [S, NB, BS, D] float32 view through the table."""
+        if kv_dtype == "fp32":
+            return pool[l][tables]
+        if kv_dtype == "bf16":
+            return pool[l][tables].astype(jnp.float32)
+        q, sc_ = pool
+        return (q[l][tables].astype(jnp.float32)
+                * sc_[l][tables][:, :, None, None])
+
+    def _sample(logits, seeds, positions, temps):
+        """Greedy/sampled next token per row; stateless per-sequence
+        sampling: the key depends only on (seed, position), never on
+        the slot or tick number."""
+        greedy = jnp.argmax(logits, axis=-1)
+        subs = jax.vmap(
+            lambda sd, p: jax.random.fold_in(jax.random.key(sd), p))(
+                seeds, positions)
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(subs,
+                                                   logits / safe_t)
+        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
     @functools.partial(jax.jit, donate_argnums=donate)
     def step(g, pool_k, pool_v, tables, positions, tokens, seeds, temps,
@@ -589,13 +674,15 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
             q = h @ wq + bq
             kk = h @ wk + bk
             vv = h @ wv + bv
-            pool_k = pool_k.at[l, wb, wi].set(kk)
-            pool_v = pool_v.at[l, wb, wi].set(vv)
+            pool_k = _write(pool_k, l, wb, wi, kk)
+            pool_v = _write(pool_v, l, wb, wi, vv)
             # gather-based attention over the block table: [S, NB, BS, D]
             # in table order IS logical order, so after the reshape the
             # math is the dense cache's math on the same values
-            kh = pool_k[l][tables].reshape(s_n, nb * bs, n_heads, d_head)
-            vh = pool_v[l][tables].reshape(s_n, nb * bs, n_heads, d_head)
+            kh = _gather(pool_k, l, tables).reshape(
+                s_n, nb * bs, n_heads, d_head)
+            vh = _gather(pool_v, l, tables).reshape(
+                s_n, nb * bs, n_heads, d_head)
             qh = q.reshape(s_n, n_heads, d_head)
             sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
             sc = jnp.where(pos_mask[:, None, :], sc, -jnp.inf)
@@ -609,22 +696,105 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
         xf = ln(x, 2 * n_layers)
         wf, bf = W(6 * n_layers)
         logits = xf @ wf + bf                                # [S, V]
-        greedy = jnp.argmax(logits, axis=-1)
-        # stateless per-sequence sampling: the key depends only on
-        # (seed, position), never on the slot or tick number
-        subs = jax.vmap(
-            lambda sd, p: jax.random.fold_in(jax.random.key(sd), p))(
-                seeds, positions)
-        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
-        sampled = jax.vmap(jax.random.categorical)(subs,
-                                                   logits / safe_t)
-        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        nxt = _sample(logits, seeds, positions, temps)
         return nxt, pool_k, pool_v
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def step_window(g, pool_k, pool_v, tables, positions, tokens, seeds,
+                    temps, n_valid):
+        # teacher-forced multi-position step: slot s processes window
+        # positions positions[s]+j for j < n_valid[s] in one dispatch.
+        # Rows past n_valid write to the null block; their predictions
+        # are garbage the scheduler ignores.
+        s_n, w_n = tokens.shape
+        lane = jnp.arange(s_n)
+        offs_w = jnp.arange(w_n)
+
+        def W(i):
+            return g[weights[i]], g[biases[i]]
+
+        def ln(x, i):
+            sc_, b_ = g[lns[i][0]], g[lns[i][1]]
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * sc_ + b_
+
+        pos_w = positions[:, None] + offs_w[None, :]          # [S, W]
+        valid = offs_w[None, :] < n_valid[:, None]            # [S, W]
+        pos_c = jnp.clip(pos_w, 0, max_len - 1)
+        x = g[tok_emb][tokens] + g[pos_tab][pos_c]            # [S, W, D]
+        wb = jnp.where(valid,
+                       tables[lane[:, None],
+                              jnp.clip(pos_w // bs, 0, nb - 1)], 0)
+        wi = jnp.where(valid, pos_w % bs, 0)
+        # causal within the window AND over the committed span: window
+        # row j attends to absolute positions <= positions[s]+j (row 0
+        # reproduces `step`'s mask exactly)
+        pos_mask = (jnp.arange(nb * bs)[None, None, :]
+                    <= pos_w[:, :, None])                     # [S, W, L]
+        for l in range(n_layers):
+            h = ln(x, 2 * l)
+            wq, bq = W(6 * l + 0)
+            wk, bk = W(6 * l + 1)
+            wv, bv = W(6 * l + 2)
+            wo, bo = W(6 * l + 3)
+            q = h @ wq + bq
+            kk = h @ wk + bk
+            vv = h @ wv + bv
+            # the whole window's K/V is written before the gather, so
+            # in-window attention sees the fresh values; int8 blocks
+            # re-quantize per position, in order (the running-max
+            # discipline needs offsets written low-to-high)
+            for j in range(w_n):
+                pool_k = _write(pool_k, l, wb[:, j], wi[:, j], kk[:, j])
+                pool_v = _write(pool_v, l, wb[:, j], wi[:, j], vv[:, j])
+            kh = _gather(pool_k, l, tables).reshape(
+                s_n, nb * bs, n_heads, d_head)
+            vh = _gather(pool_v, l, tables).reshape(
+                s_n, nb * bs, n_heads, d_head)
+            qh = q.reshape(s_n, w_n, n_heads, d_head)
+            sc = jnp.einsum("bqhd,bshd->bqhs", qh, kh) * scale
+            sc = jnp.where(pos_mask[:, :, None, :], sc, -jnp.inf)
+            w_att = jax.nn.softmax(sc, axis=-1)
+            ctxh = jnp.einsum("bqhs,bshd->bqhd", w_att, vh)
+            x = x + (ctxh.reshape(s_n, w_n, d_model) @ wo + bo)
+            h2 = ln(x, 2 * l + 1)
+            w1, b1 = W(6 * l + 4)
+            w2, b2 = W(6 * l + 5)
+            x = x + (jax.nn.relu(h2 @ w1 + b1) @ w2 + b2)
+        xf = ln(x, 2 * n_layers)
+        wf, bf = W(6 * n_layers)
+        logits = xf @ wf + bf                                 # [S, W, V]
+        seeds_w = jnp.broadcast_to(seeds[:, None], (s_n, w_n))
+        temps_w = jnp.broadcast_to(temps[:, None], (s_n, w_n))
+        preds = _sample(logits.reshape(s_n * w_n, -1),
+                        seeds_w.reshape(-1), pos_c.reshape(-1),
+                        temps_w.reshape(-1)).reshape(s_n, w_n)
+        return preds, pool_k, pool_v
+
+    if kv_dtype == "fp32":
+        elem_bytes = 4.0
+    elif kv_dtype == "bf16":
+        elem_bytes = 2.0
+    else:
+        # int8 payload + one f32 scale per (layer, block)
+        elem_bytes = 1.0 + 4.0 / (bs * d_model)
+    bytes_per_block = int(2 * n_layers * bs * d_model * elem_bytes)
 
     def init_pool(num_blocks, device=None):
         shape = (n_layers, int(num_blocks), bs, d_model)
-        zk = jnp.zeros(shape, jnp.float32)
-        zv = jnp.zeros(shape, jnp.float32)
+        if kv_dtype == "int8":
+            def z():
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.full((n_layers, int(num_blocks)), 1e-8,
+                                 jnp.float32))
+        elif kv_dtype == "bf16":
+            def z():
+                return jnp.zeros(shape, jnp.bfloat16)
+        else:
+            def z():
+                return jnp.zeros(shape, jnp.float32)
+        zk, zv = z(), z()
         if device is not None:
             zk = jax.device_put(zk, device)
             zv = jax.device_put(zv, device)
@@ -633,10 +803,11 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
     import types
 
     decoder = types.SimpleNamespace(
-        step=step, init_pool=init_pool, state_names=sorted(shapes),
-        state_shapes=shapes, block_size=bs, max_blocks_per_seq=nb,
-        max_len=max_len, n_layers=n_layers, d_model=d_model,
-        vocab_size=vocab_size)
+        step=step, step_window=step_window, init_pool=init_pool,
+        state_names=sorted(shapes), state_shapes=shapes, block_size=bs,
+        max_blocks_per_seq=nb, max_len=max_len, n_layers=n_layers,
+        d_model=d_model, vocab_size=vocab_size, kv_dtype=kv_dtype,
+        bytes_per_block=bytes_per_block)
     return startup, decoder
 
 

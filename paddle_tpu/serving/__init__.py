@@ -11,13 +11,21 @@ serving subsystem; the import path is unchanged, so every existing
 * **kv_cache** — the paged KV-cache: fixed-size blocks carved out of
   ONE preallocated HBM pool, per-sequence block tables, alloc/free at
   sequence admit/finish.  Long and short sequences share the pool
-  without fragmentation (the vLLM PagedAttention memory design).
+  without fragmentation (the vLLM PagedAttention memory design), and
+  fully-filled prompt blocks are hash-consed so sequences with a
+  shared prefix SHARE blocks (refcounted, LRU-evicted when idle) and
+  skip the shared prefill entirely.
 * **generation** — `GenerationServer`: continuous (in-flight) batching
   for autoregressive decode.  One resident decode step per tick over
   the active sequence set; new requests are admitted into free slots
   BETWEEN ticks (prefill folded into the same per-token step), finished
   sequences are evicted immediately, admission is keyed to free KV
   blocks, and every request streams tokens through its own future.
+  Optionally speculative: a small draft model proposes k tokens per
+  tick and the target verifies the window in one dispatch (greedy
+  output bit-identical by construction).  The KV pool stores fp32,
+  bf16 or int8 blocks (`kv_dtype`) — quantize-on-write, dequantize-
+  on-gather — trading tolerance for 2-4x the resident sequences.
 * **replica** — a TCP front for one `GenerationServer` process
   (JSON-line protocol: generate/ping/swap/stats) so replicas can be
   health-checked, drained, and hot-swapped remotely.

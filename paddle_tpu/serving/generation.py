@@ -30,6 +30,31 @@ schedule:
 baseline (admit only into an EMPTY active set) — same compiled step,
 same numerics — which is what benchmark/run_serving.py measures the
 continuous schedule against.
+
+On top of the PR 8 substrate ride the two algorithmic serving
+optimizations (docs/serving.md):
+
+* PREFIX CACHING (`prefix_cache=True`, default): admission allocates
+  through `PagedKVCache.allocate_prefix`, which shares fully-filled
+  prompt blocks already resident from an earlier sequence with the
+  same prompt prefix — the cursor then STARTS past the shared
+  positions, skipping their prefill ticks entirely.  K/V at a position
+  is a deterministic function of the token prefix, so shared blocks
+  hold exactly what this sequence's prefill would have written:
+  greedy output stays bit-identical to a cold run.
+* SPECULATIVE DECODING (`draft_decoder`/`draft_states`, optional): a
+  small draft model proposes `spec_k` greedy tokens per tick and the
+  target verifies the whole window in ONE `step_window` dispatch.  The
+  accept rule is the greedy degenerate of accept/resample — keep
+  proposals while they equal the target's own argmax chain, then emit
+  the target's next token as the bonus — so the emitted stream is the
+  target's greedy output BY CONSTRUCTION; a tick delivers between 1
+  and spec_k+1 tokens.  The draft keeps its own KV pool indexed by the
+  SAME block tables (admission accounts blocks once; prefix hits warm
+  both pools).  Sampled (temperature>0) requests take the plain
+  one-token path — their per-(seed, position) PRNG contract is
+  untouched.  Prefill is chunked through the same window step
+  (spec_k+1 prompt positions per tick) when a draft is armed.
 """
 from __future__ import annotations
 
@@ -47,13 +72,14 @@ from ..core.resilience import fault_injector
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from .batching import RequestDeadlineExceeded, ServerSaturated
-from .kv_cache import PagedKVCache
+from .kv_cache import KVPoolExhausted, PagedKVCache
 
 __all__ = ["GenerationServer", "GenerationStream",
            "save_generation_model", "load_generation_model"]
 
 MODEL_SPEC_FILENAME = "generation.json"
 MODEL_PARAMS_FILENAME = "generation_params.npz"
+MODEL_DRAFT_PARAMS_FILENAME = "generation_draft_params.npz"
 
 _SERVER_IDS = itertools.count()
 # stats()-backing series are always=True (the stats contract predates
@@ -91,6 +117,14 @@ _M_ACTIVE = obs_metrics.gauge(
 _M_QDEPTH = obs_metrics.gauge(
     "paddle_tpu_serving_generation_queue_depth",
     "requests waiting for admission", ("server",))
+_M_DRAFT_PROPOSED = obs_metrics.counter(
+    "paddle_tpu_serving_draft_proposed_total",
+    "draft-model tokens proposed for target verification "
+    "(speculative decoding)", ("server",), always=True)
+_M_DRAFT_ACCEPTED = obs_metrics.counter(
+    "paddle_tpu_serving_draft_accepted_total",
+    "draft proposals accepted by the target's verify step "
+    "(accept rate = accepted / proposed)", ("server",), always=True)
 
 
 class GenerationStream:
@@ -187,7 +221,8 @@ class _Seq:
 
     __slots__ = ("stream", "tokens", "prompt_len", "max_new", "eos_id",
                  "temperature", "seed", "cur", "slot", "emitted",
-                 "t_submit", "expires", "trace_ctx")
+                 "t_submit", "expires", "trace_ctx", "draft_next",
+                 "prompt_keys")
 
     def __init__(self, stream, max_new, eos_id, temperature, seed,
                  expires, trace_ctx):
@@ -204,6 +239,13 @@ class _Seq:
         self.t_submit = time.perf_counter()
         self.expires = expires
         self.trace_ctx = trace_ctx
+        # next position the DRAFT model's KV is missing (speculative
+        # decoding: the draft trails the target by at most one
+        # position after a fully-accepted window)
+        self.draft_next = 0
+        # chained prefix-cache block keys, computed ONCE at submit
+        # (the scheduler re-checks a blocked queue head every tick)
+        self.prompt_keys = None
 
     @property
     def positions_needed(self) -> int:
@@ -225,34 +267,67 @@ class GenerationServer:
     def __init__(self, decoder, states, *, slots: int = 8,
                  kv_blocks: int = 64, max_queue: int = 256,
                  place=None, static_batch: bool = False,
-                 idle_poll_s: float = 0.005):
+                 idle_poll_s: float = 0.005,
+                 prefix_cache: bool = True,
+                 draft_decoder=None, draft_states=None,
+                 spec_k: Optional[int] = None):
         import jax
 
+        from ..core import flags as core_flags
         from ..core.executor import TPUPlace
 
-        missing = [n for n in decoder.state_names if n not in states]
-        if missing:
+        def _check_states(dec, sts, who):
+            missing = [n for n in dec.state_names if n not in sts]
+            if missing:
+                raise ValueError(
+                    f"{who} states missing {len(missing)} decoder "
+                    f"parameter(s), e.g. {missing[:3]} — rebuild the "
+                    "decoder under the same unique-name state the "
+                    "parameters were trained in")
+            # matching NAMES are not enough: a spec that rebuilds the
+            # decoder at the wrong max_len/d_model would index the
+            # position table out of bounds inside jit, where gathers
+            # CLAMP — silently wrong tokens instead of an error.
+            bad = [(n, tuple(np.shape(sts[n])), want)
+                   for n, want in getattr(dec, "state_shapes",
+                                          {}).items()
+                   if tuple(np.shape(sts[n])) != want]
+            if bad:
+                n, got, want = bad[0]
+                raise ValueError(
+                    f"{len(bad)} {who} parameter shape(s) do not match "
+                    f"the decoder architecture, e.g. {n}: states {got} "
+                    f"vs decoder {want} — the model spec (vocab_size/"
+                    "d_model/n_heads/n_layers/block_size*"
+                    "max_blocks_per_seq) disagrees with the saved "
+                    "parameters")
+
+        _check_states(decoder, states, "target")
+        if (draft_decoder is None) != (draft_states is None):
             raise ValueError(
-                f"states missing {len(missing)} decoder parameter(s), "
-                f"e.g. {missing[:3]} — rebuild the decoder under the "
-                "same unique-name state the parameters were trained in")
-        # matching NAMES are not enough: a spec that rebuilds the
-        # decoder at the wrong max_len/d_model would index the position
-        # table out of bounds inside jit, where gathers CLAMP — silently
-        # wrong tokens instead of an error.  Catch it here.
-        bad = [(n, tuple(np.shape(states[n])), want)
-               for n, want in getattr(decoder, "state_shapes",
-                                      {}).items()
-               if tuple(np.shape(states[n])) != want]
-        if bad:
-            n, got, want = bad[0]
-            raise ValueError(
-                f"{len(bad)} parameter shape(s) do not match the "
-                f"decoder architecture, e.g. {n}: states {got} vs "
-                f"decoder {want} — the model spec (vocab_size/d_model/"
-                "n_heads/n_layers/block_size*max_blocks_per_seq) "
-                "disagrees with the saved parameters")
+                "speculative decoding needs BOTH draft_decoder and "
+                "draft_states (or neither)")
+        if draft_decoder is not None:
+            _check_states(draft_decoder, draft_states, "draft")
+            if (draft_decoder.block_size != decoder.block_size
+                    or draft_decoder.max_blocks_per_seq
+                    != decoder.max_blocks_per_seq):
+                raise ValueError(
+                    "draft decoder block geometry "
+                    f"({draft_decoder.block_size}x"
+                    f"{draft_decoder.max_blocks_per_seq}) must match "
+                    f"the target ({decoder.block_size}x"
+                    f"{decoder.max_blocks_per_seq}) — both pools are "
+                    "indexed by the SAME per-sequence block tables")
+            if draft_decoder.vocab_size != decoder.vocab_size:
+                raise ValueError("draft/target vocab_size mismatch")
         self._decoder = decoder
+        self._draft = draft_decoder
+        self._spec_k = int(spec_k
+                           if spec_k is not None
+                           else core_flags.get_flag("serving_spec_k"))
+        if draft_decoder is not None and self._spec_k < 1:
+            raise ValueError("spec_k must be >= 1 with a draft model")
         self._slots = int(slots)
         self._static = bool(static_batch)
         self._idle_poll_s = float(idle_poll_s)
@@ -262,12 +337,37 @@ class GenerationServer:
                                           self._device)
                         for n in decoder.state_names}
         sid = self._sid = str(next(_SERVER_IDS))
+        bpb = getattr(decoder, "bytes_per_block", 0)
+        if draft_decoder is not None:
+            bpb += getattr(draft_decoder, "bytes_per_block", 0)
         self._cache = PagedKVCache(
             kv_blocks, decoder.block_size, decoder.max_blocks_per_seq,
-            server_label=f"gen{sid}")
+            server_label=f"gen{sid}", prefix_cache=prefix_cache,
+            bytes_per_block=bpb)
+        # int8 pools cannot share a prompt's FINAL block: the
+        # block-aligned full-prompt hit re-runs the last prompt
+        # position, and an int8 write RE-QUANTIZES the whole shared
+        # block in place — mutating bytes other live sequences attend
+        # to.  fp32 and bf16 writes touch only their own (block,
+        # offset) slot with byte-identical values (decode is
+        # deterministic in the prefix), so they keep full sharing;
+        # for int8 the submit-time keys drop the last prompt token,
+        # which excludes exactly the aligned final block.
+        self._kv_int8 = (
+            getattr(decoder, "kv_dtype", "fp32") == "int8"
+            or (draft_decoder is not None
+                and getattr(draft_decoder, "kv_dtype", "fp32")
+                == "int8"))
         # +1: device block 0 is the reserved null/scratch block
         self._pool_k, self._pool_v = decoder.init_pool(
             kv_blocks + 1, self._device)
+        if draft_decoder is not None:
+            self._draft_states = {
+                n: jax.device_put(np.asarray(draft_states[n]),
+                                  self._device)
+                for n in draft_decoder.state_names}
+            self._dpool_k, self._dpool_v = draft_decoder.init_pool(
+                kv_blocks + 1, self._device)
 
         self._active: List[Optional[_Seq]] = [None] * self._slots
         self._tables = np.zeros(
@@ -289,20 +389,43 @@ class GenerationServer:
         self._m_ttft = _M_TTFT.labels(server=sid)
         self._m_active = _M_ACTIVE.labels(server=sid)
         self._m_qdepth = _M_QDEPTH.labels(server=sid)
+        self._m_proposed = _M_DRAFT_PROPOSED.labels(server=sid)
+        self._m_accepted = _M_DRAFT_ACCEPTED.labels(server=sid)
 
         self._warmup()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
     def _warmup(self):
-        """Compile the resident step before the first request: serving
-        never pays the trace+compile inside a request's latency."""
+        """Compile the resident step(s) before the first request:
+        serving never pays the trace+compile inside a request's
+        latency.  A speculative server compiles the target's window
+        step plus the draft's window and single steps; a plain server
+        compiles only the one-token step it runs."""
         z = np.zeros(self._slots, np.int32)
-        nxt, self._pool_k, self._pool_v = self._decoder.step(
-            self._states, self._pool_k, self._pool_v, self._tables, z,
-            z, z.astype(np.uint32), np.zeros(self._slots, np.float32),
+        zs = z.astype(np.uint32)
+        zt = np.zeros(self._slots, np.float32)
+        if self._draft is None:
+            nxt, self._pool_k, self._pool_v = self._decoder.step(
+                self._states, self._pool_k, self._pool_v, self._tables,
+                z, z, zs, zt, np.zeros(self._slots, bool))
+            np.asarray(nxt)  # block: compile is done when this returns
+            return
+        w = self._spec_k + 1
+        zw = np.zeros((self._slots, w), np.int32)
+        nxt, self._pool_k, self._pool_v = self._decoder.step_window(
+            self._states, self._pool_k, self._pool_v, self._tables,
+            z, zw, zs, zt, z)
+        np.asarray(nxt)
+        nxt, self._dpool_k, self._dpool_v = self._draft.step_window(
+            self._draft_states, self._dpool_k, self._dpool_v,
+            self._tables, z, zw, zs, zt, z)
+        np.asarray(nxt)
+        nxt, self._dpool_k, self._dpool_v = self._draft.step(
+            self._draft_states, self._dpool_k, self._dpool_v,
+            self._tables, z, z, zs, zt,
             np.zeros(self._slots, bool))
-        np.asarray(nxt)  # block: compile is done when this returns
+        np.asarray(nxt)
 
     # -- client side --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, *,
@@ -334,6 +457,9 @@ class GenerationServer:
                 f"needs {need} KV blocks > per-sequence capacity "
                 f"{self._cache.max_blocks_per_seq} "
                 f"(block_size {self._cache.block_size})")
+        if self._cache.prefix_cache:
+            keyed = (prompt[:-1] if self._kv_int8 else prompt)
+            seq.prompt_keys = self._cache.prompt_keys(keyed)
         with self._lock:
             if self._stop:
                 raise RuntimeError("GenerationServer is closed")
@@ -356,6 +482,7 @@ class GenerationServer:
             timeout)
 
     def swap_states(self, states: Dict[str, np.ndarray],
+                    draft_states: Optional[Dict] = None,
                     wait: bool = True,
                     timeout: Optional[float] = None) -> bool:
         """Zero-downtime checkpoint hot swap: drain -> swap -> resume.
@@ -363,20 +490,39 @@ class GenerationServer:
         Admission pauses, active sequences run to completion against
         the OLD parameters (a generation never mixes checkpoints),
         then the new parameters are installed and admission resumes.
-        Queued requests are NOT failed — they wait out the drain."""
+        Queued requests are NOT failed — they wait out the drain.
+
+        With a draft armed, pass the new checkpoint's `draft_states`
+        too: a stale draft stays CORRECT (the target verifies every
+        window) but its accept rate against the new target can
+        collapse toward 1/vocab — a silent throughput regression.
+        Omitting them keeps the old draft."""
         missing = [n for n in self._decoder.state_names
                    if n not in states]
         if missing:
             raise ValueError(f"swap states missing {missing[:3]}...")
+        new_draft = None
+        if draft_states is not None:
+            if self._draft is None:
+                raise ValueError(
+                    "draft_states given but this server has no draft "
+                    "armed (a draft cannot be armed mid-flight)")
+            dmissing = [n for n in self._draft.state_names
+                        if n not in draft_states]
+            if dmissing:
+                raise ValueError(
+                    f"swap draft states missing {dmissing[:3]}...")
+            new_draft = {n: np.asarray(draft_states[n])
+                         for n in self._draft.state_names}
         with self._lock:
             if self._stop:
                 raise RuntimeError("GenerationServer is closed")
             if self._pending_states is not None:
                 raise RuntimeError("hot swap already in progress")
             self._swap_done.clear()
-            self._pending_states = {
-                n: np.asarray(states[n])
-                for n in self._decoder.state_names}
+            self._pending_states = (
+                {n: np.asarray(states[n])
+                 for n in self._decoder.state_names}, new_draft)
             self._lock.notify_all()
         if wait:
             return self._swap_done.wait(timeout)
@@ -384,21 +530,30 @@ class GenerationServer:
 
     def stats(self) -> Dict[str, float]:
         """Serving telemetry view (docs/serving.md): request/token/tick
-        counters, shed accounting, live occupancy, KV-pool state."""
+        counters, shed accounting, live occupancy, KV-pool state,
+        prefix-cache hit accounting and speculative accept rates."""
         with self._lock:
             active = sum(1 for s in self._active if s is not None)
             qdepth = len(self._queue)
-        return {"requests": int(self._m_requests.value),
-                "generated_tokens": int(self._m_tokens.value),
-                "ticks": int(self._m_ticks.value),
-                "shed": int(self._m_shed.value),
-                "deadline_expired": int(self._m_deadline.value),
-                "hot_swaps": int(self._m_swaps.value),
-                "active_sequences": active,
-                "queue_depth": qdepth,
-                "kv_blocks_free": self._cache.free_blocks,
-                "kv_blocks_total": self._cache.num_blocks,
-                "kv_pool_utilization": self._cache.utilization()}
+        out = {"requests": int(self._m_requests.value),
+               "generated_tokens": int(self._m_tokens.value),
+               "ticks": int(self._m_ticks.value),
+               "shed": int(self._m_shed.value),
+               "deadline_expired": int(self._m_deadline.value),
+               "hot_swaps": int(self._m_swaps.value),
+               "active_sequences": active,
+               "queue_depth": qdepth,
+               "kv_blocks_free": self._cache.free_blocks,
+               "kv_blocks_total": self._cache.num_blocks,
+               "kv_pool_utilization": self._cache.utilization(),
+               "kv_dtype": getattr(self._decoder, "kv_dtype", "fp32"),
+               "kv_bytes_resident": (self._cache.used_blocks
+                                     * self._cache.bytes_per_block),
+               "draft_proposed": int(self._m_proposed.value),
+               "draft_accepted": int(self._m_accepted.value),
+               "spec_k": self._spec_k if self._draft is not None else 0}
+        out.update(self._cache.prefix_stats())
+        return out
 
     def outstanding_tokens(self) -> int:
         """Token budget not yet delivered (active + queued) — the load
@@ -425,7 +580,8 @@ class GenerationServer:
             seq.stream._fail(err)
         self._cache.close()
         for fam in (_M_REQUESTS, _M_TOKENS, _M_TICKS, _M_SWAPS,
-                    _M_LATENCY, _M_TTFT, _M_ACTIVE, _M_QDEPTH):
+                    _M_LATENCY, _M_TTFT, _M_ACTIVE, _M_QDEPTH,
+                    _M_DRAFT_PROPOSED, _M_DRAFT_ACCEPTED):
             fam.remove(server=self._sid)
         for reason in ("saturated", "deadline"):
             _M_SHED.remove(server=self._sid, reason=reason)
@@ -458,10 +614,29 @@ class GenerationServer:
             if slot < 0:
                 break
             seq = self._queue[0]
-            if not self._cache.can_admit(seq.positions_needed):
+            if not self._cache.can_admit(seq.positions_needed,
+                                         prompt_keys=seq.prompt_keys):
                 break
             self._queue.popleft()
-            table = self._cache.allocate(seq, seq.positions_needed)
+            try:
+                table, cached = self._cache.allocate_prefix(
+                    seq, seq.positions_needed,
+                    prompt_keys=seq.prompt_keys)
+            except KVPoolExhausted:
+                # can_admit/allocate_prefix disagreeing is a bug, but
+                # an unserved admission must back off (head of queue,
+                # retried next tick) — never kill the scheduler thread
+                self._queue.appendleft(seq)
+                break
+            # prefix hit: the first `cached` positions already hold
+            # this prompt's K/V — start the cursor there and skip
+            # their prefill ticks.  A block-ALIGNED full-prompt hit
+            # still re-runs the last prompt position (the step must
+            # produce the first new token); that write lands in a
+            # shared block with byte-identical values — the zero-copy
+            # degenerate of copy-on-write.
+            seq.cur = min(cached, seq.prompt_len - 1)
+            seq.draft_next = seq.cur
             seq.slot = slot
             self._active[slot] = seq
             self._tables[slot] = table
@@ -512,7 +687,10 @@ class GenerationServer:
                 # (they are evicted, their streams get the error) but
                 # must never kill the scheduler thread
                 fault_injector().fire("serving.decode")
-                nxt = self._tick(seqs)
+                if self._draft is None:
+                    nxt = self._tick(seqs)
+                else:
+                    plans, preds = self._tick_spec(seqs)
             except Exception as e:
                 with self._lock:
                     for seq in seqs:
@@ -520,7 +698,15 @@ class GenerationServer:
                 for seq in seqs:
                     seq.stream._fail(e)
                 continue
-            self._deliver(seqs, nxt, metrics_on)
+            if self._draft is None:
+                self._deliver(seqs, nxt, metrics_on)
+            else:
+                self._deliver_spec(plans, preds, metrics_on)
+            # freshly-filled full prompt blocks become shareable the
+            # moment the cursor passes their end (no-op once a
+            # sequence has nothing pending or was evicted)
+            for seq in seqs:
+                self._cache.commit_prefix(seq, seq.cur)
 
     def _tick(self, seqs: List[_Seq]) -> np.ndarray:
         tokens = np.zeros(self._slots, np.int32)
@@ -573,13 +759,200 @@ class GenerationServer:
                     self._m_latency.observe(now - seq.t_submit)
                 seq.stream._finish()
 
-    def _install_states(self, states: Dict[str, np.ndarray]):
+    # -- speculative path ---------------------------------------------------
+    def _tick_spec(self, seqs: List[_Seq]):
+        """One speculative tick: draft catch-up + k greedy proposals
+        per eligible slot, then ONE target step_window verifying the
+        whole window.  Returns (plans, preds) for _deliver_spec.
+
+        A plan is (seq, c, m, teacher, n_prop, proposals): `c` the
+        cursor at tick start, `m` how many committed tokens sit at the
+        window's head (teacher-forced), `n_prop` how many draft tokens
+        follow them.  Sampled requests and prefill interiors get
+        n_prop=0 — pure (chunked) teacher forcing."""
+        w = self._spec_k + 1
+        plans = []
+        for seq in seqs:
+            c = seq.cur
+            m = len(seq.tokens) - c
+            n_max = min(w, seq.positions_needed - c)
+            teacher = min(m, n_max)
+            greedy = seq.temperature == 0.0
+            n_prop = (n_max - teacher
+                      if greedy and teacher == m else 0)
+            plans.append((seq, c, m, teacher, n_prop))
+
+        # draft catch-up: teacher-force the draft over the window's
+        # committed head so its KV tracks the target's (positions a
+        # proposal step will re-write are excluded).  Normally one
+        # chunk; the loop guards the at-most-one-position lag a fully
+        # accepted window leaves behind.  Sampled sequences never
+        # propose but STILL keep the draft warm: the prompt blocks
+        # they commit to the prefix cache must hold valid draft KV for
+        # the greedy sequences that later share them.
+        while True:
+            todo = []
+            for seq, c, m, teacher, n_prop in plans:
+                end = c + teacher - (1 if n_prop else 0)
+                if seq.draft_next < end:
+                    todo.append((seq, min(end - seq.draft_next, w)))
+            if not todo:
+                break
+            pos = np.zeros(self._slots, np.int32)
+            toks = np.zeros((self._slots, w), np.int32)
+            nv = np.zeros(self._slots, np.int32)
+            for seq, n in todo:
+                pos[seq.slot] = seq.draft_next
+                toks[seq.slot, :n] = seq.tokens[
+                    seq.draft_next:seq.draft_next + n]
+                nv[seq.slot] = n
+            _, self._dpool_k, self._dpool_v = self._draft.step_window(
+                self._draft_states, self._dpool_k, self._dpool_v,
+                self._tables, pos, toks,
+                np.zeros(self._slots, np.uint32),
+                np.zeros(self._slots, np.float32), nv)
+            for seq, n in todo:
+                seq.draft_next += n
+
+        # proposal micro-steps: the draft extends each eligible slot
+        # greedily, one position per call, batched across slots; step
+        # i feeds the committed frontier token first, then its own
+        # previous proposal
+        max_prop = max((p[4] for p in plans), default=0)
+        proposals: Dict[object, List[int]] = {p[0]: [] for p in plans}
+        for i in range(max_prop):
+            pos = np.zeros(self._slots, np.int32)
+            toks = np.zeros(self._slots, np.int32)
+            act = np.zeros(self._slots, bool)
+            stepping = []
+            for seq, c, m, teacher, n_prop in plans:
+                if i >= n_prop:
+                    continue
+                base = c + teacher - 1
+                pos[seq.slot] = base + i
+                toks[seq.slot] = (seq.tokens[base] if i == 0
+                                  else proposals[seq][-1])
+                act[seq.slot] = True
+                stepping.append(seq)
+            nxt, self._dpool_k, self._dpool_v = self._draft.step(
+                self._draft_states, self._dpool_k, self._dpool_v,
+                self._tables, pos, toks,
+                np.zeros(self._slots, np.uint32),
+                np.zeros(self._slots, np.float32), act)
+            out = np.asarray(nxt)
+            for seq in stepping:
+                proposals[seq].append(int(out[seq.slot]))
+                seq.draft_next = pos[seq.slot] + 1
+
+        # ONE target dispatch verifies/extends every slot's window
+        pos = np.zeros(self._slots, np.int32)
+        toks = np.zeros((self._slots, w), np.int32)
+        nv = np.zeros(self._slots, np.int32)
+        temps = np.zeros(self._slots, np.float32)
+        seeds = np.zeros(self._slots, np.uint32)
+        for seq, c, m, teacher, n_prop in plans:
+            window = seq.tokens[c:c + teacher] + proposals[seq]
+            pos[seq.slot] = c
+            toks[seq.slot, :len(window)] = window
+            nv[seq.slot] = teacher + n_prop
+            temps[seq.slot] = seq.temperature
+            seeds[seq.slot] = seq.seed
+        with obs_tracing.span("serving.decode_tick", active=len(seqs),
+                              speculative=True):
+            nxt, self._pool_k, self._pool_v = self._decoder.step_window(
+                self._states, self._pool_k, self._pool_v, self._tables,
+                pos, toks, seeds, temps, nv)
+            preds = np.asarray(nxt)
+        self._m_ticks.inc()
+        full_plans = [(seq, c, m, teacher, n_prop, proposals[seq])
+                      for seq, c, m, teacher, n_prop in plans]
+        return full_plans, preds
+
+    def _deliver_spec(self, plans, preds: np.ndarray, metrics_on: bool):
+        """Greedy accept rule over each slot's verified window: keep
+        emitting the target's prediction chain while it agrees with
+        the next window token (committed tokens agree by construction;
+        draft proposals are ACCEPTED on match), stop at the first
+        disagreement with the target's own token as the bonus — the
+        emitted stream is exactly the target's one-token-at-a-time
+        greedy output."""
+        now = time.perf_counter()
+        delivered = 0
+        proposed = accepted = 0
+        finished = []
+        for seq, c, m, teacher, n_prop, props in plans:
+            n_valid = teacher + n_prop
+            window = seq.tokens[c:c + teacher] + props
+            emitted: List[int] = []
+            j_stop = n_valid - 1     # pure-teacher window: no emission
+            j = m - 1
+            if j < n_valid:
+                while True:
+                    tok = int(preds[seq.slot, j])
+                    emitted.append(tok)
+                    if (seq.emitted + len(emitted) >= seq.max_new
+                            or (seq.eos_id is not None
+                                and tok == seq.eos_id)):
+                        j_stop = j
+                        break
+                    if j + 1 < n_valid and tok == window[j + 1]:
+                        j += 1       # proposal verified: keep going
+                        continue
+                    j_stop = j
+                    break
+            seq.cur = c + j_stop + 1
+            proposed += n_prop
+            if n_prop:
+                accepted += min(max(len(emitted) - 1, 0), n_prop)
+            # the draft's KV is valid only where it processed tokens
+            # that ended up committed — never past the bonus token
+            seq.draft_next = min(seq.draft_next, seq.cur)
+            if emitted:
+                if metrics_on and seq.emitted == 0:
+                    self._m_ttft.observe(now - seq.t_submit)
+                seq.tokens.extend(emitted)
+                seq.emitted += len(emitted)
+                delivered += len(emitted)
+                for tok in emitted:
+                    seq.stream._put(tok)
+                if (seq.emitted >= seq.max_new
+                        or (seq.eos_id is not None
+                            and emitted[-1] == seq.eos_id)):
+                    finished.append(seq)
+        if delivered:
+            self._m_tokens.inc(delivered)
+        if proposed:
+            self._m_proposed.inc(proposed)
+        if accepted:
+            self._m_accepted.inc(accepted)
+        if finished:
+            with self._lock:
+                for seq in finished:
+                    self._evict_locked(seq)
+                self._lock.notify_all()
+            for seq in finished:
+                if metrics_on:
+                    self._m_latency.observe(now - seq.t_submit)
+                seq.stream._finish()
+
+    def _install_states(self, pending):
         import jax
 
+        states, draft_states = pending
         new = {n: jax.device_put(v, self._device)
                for n, v in states.items()}
+        new_draft = ({n: jax.device_put(v, self._device)
+                      for n, v in draft_states.items()}
+                     if draft_states is not None else None)
+        # cached prefix K/V is keyed by token content alone and is
+        # valid for exactly ONE parameter version: flush it, or
+        # post-swap requests would skip prefill into the OLD
+        # checkpoint's K/V and silently emit wrong tokens
+        self._cache.flush_prefix()
         with self._lock:
             self._states = new
+            if new_draft is not None:
+                self._draft_states = new_draft
             self._pending_states = None
             self._lock.notify_all()
         self._m_swaps.inc()
@@ -589,16 +962,33 @@ class GenerationServer:
 # -- model dir format --------------------------------------------------------
 
 def save_generation_model(dirname: str, states: Dict[str, np.ndarray],
-                          spec: Dict) -> str:
+                          spec: Dict,
+                          draft_states: Optional[Dict] = None) -> str:
     """Persist a generation model: `generation.json` (architecture
     spec: vocab_size/d_model/n_heads/n_layers/d_inner, plus optional
-    serving defaults block_size/max_blocks_per_seq/slots/kv_blocks) and
-    one npz of parameters.  The directory is what `cli serve` and the
-    replica hot-swap verb consume."""
+    serving defaults block_size/max_blocks_per_seq/slots/kv_blocks/
+    kv_dtype/spec_k and an optional `draft` sub-spec) and one npz of
+    parameters.  With `draft_states`, the speculative-decoding draft
+    model's parameters land in a second npz and spec["draft"] must
+    name its architecture ({d_model, n_heads, n_layers[, d_inner]};
+    vocab and block geometry are shared with the target).  The
+    directory is what `cli serve` and the replica hot-swap verb
+    consume."""
     os.makedirs(dirname, exist_ok=True)
     for key in ("vocab_size", "d_model", "n_heads", "n_layers"):
         if key not in spec:
             raise ValueError(f"spec missing {key!r}")
+    if draft_states is not None:
+        draft = spec.get("draft")
+        if not isinstance(draft, dict):
+            raise ValueError(
+                "draft_states given but spec['draft'] (the draft "
+                "architecture dict) is missing")
+        for key in ("d_model", "n_heads", "n_layers"):
+            if key not in draft:
+                raise ValueError(f"spec['draft'] missing {key!r}")
+        np.savez(os.path.join(dirname, MODEL_DRAFT_PARAMS_FILENAME),
+                 **{n: np.asarray(v) for n, v in draft_states.items()})
     with open(os.path.join(dirname, MODEL_SPEC_FILENAME), "w") as f:
         json.dump(spec, f, indent=1, sort_keys=True)
     np.savez(os.path.join(dirname, MODEL_PARAMS_FILENAME),
@@ -606,39 +996,67 @@ def save_generation_model(dirname: str, states: Dict[str, np.ndarray],
     return dirname
 
 
-def load_generation_model(dirname: str):
-    """-> (states, spec) saved by save_generation_model."""
+def load_generation_model(dirname: str, with_draft: bool = False):
+    """-> (states, spec) saved by save_generation_model; with
+    `with_draft=True`, -> (states, spec, draft_states_or_None)."""
     with open(os.path.join(dirname, MODEL_SPEC_FILENAME)) as f:
         spec = json.load(f)
     with np.load(os.path.join(dirname, MODEL_PARAMS_FILENAME)) as z:
         states = {n: z[n] for n in z.files}
-    return states, spec
+    if not with_draft:
+        return states, spec
+    draft_states = None
+    dpath = os.path.join(dirname, MODEL_DRAFT_PARAMS_FILENAME)
+    if os.path.exists(dpath):
+        with np.load(dpath) as z:
+            draft_states = {n: z[n] for n in z.files}
+    return states, spec, draft_states
 
 
 def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
                           max_blocks_per_seq: Optional[int] = None,
                           slots: Optional[int] = None,
                           kv_blocks: Optional[int] = None,
+                          kv_dtype: Optional[str] = None,
+                          spec_k: Optional[int] = None,
+                          use_draft: bool = True,
                           **kw) -> GenerationServer:
     """Build a GenerationServer from a saved model dir.
 
     Resets the framework unique-name counters to rebuild the decoder
     under the names the parameters were saved with — intended for
-    fresh serving processes (cli serve, replicas), not mid-session."""
+    fresh serving processes (cli serve, replicas), not mid-session.
+    `kv_dtype` overrides the spec's pool precision; a model dir with
+    draft params arms speculative decoding unless `use_draft=False`."""
     from ..core import framework as fw
     from ..models.transformer import build_lm_paged_decoder
 
-    states, spec = load_generation_model(dirname)
+    states, spec, draft_states = load_generation_model(
+        dirname, with_draft=True)
     bs = int(block_size or spec.get("block_size", 16))
     nb = int(max_blocks_per_seq
              or spec.get("max_blocks_per_seq",
                          -(-int(spec.get("max_len", 256)) // bs)))
+    kvd = kv_dtype or spec.get("kv_dtype")
     fw.reset_unique_names()
     _, decoder = build_lm_paged_decoder(
         spec["vocab_size"], bs, nb, d_model=spec["d_model"],
         n_heads=spec["n_heads"], n_layers=spec["n_layers"],
-        d_inner=spec.get("d_inner"))
+        d_inner=spec.get("d_inner"), kv_dtype=kvd)
+    draft_decoder = None
+    if draft_states is not None and use_draft:
+        dspec = spec["draft"]
+        fw.reset_unique_names()
+        _, draft_decoder = build_lm_paged_decoder(
+            spec["vocab_size"], bs, nb, d_model=dspec["d_model"],
+            n_heads=dspec["n_heads"], n_layers=dspec["n_layers"],
+            d_inner=dspec.get("d_inner"), kv_dtype=kvd)
+    else:
+        draft_states = None
     return GenerationServer(
         decoder, states,
         slots=int(slots or spec.get("slots", 8)),
-        kv_blocks=int(kv_blocks or spec.get("kv_blocks", 64)), **kw)
+        kv_blocks=int(kv_blocks or spec.get("kv_blocks", 64)),
+        draft_decoder=draft_decoder, draft_states=draft_states,
+        spec_k=(spec_k if spec_k is not None
+                else spec.get("spec_k")), **kw)

@@ -23,15 +23,32 @@ Block 0 is reserved as the null/scratch block: unallocated table
 entries point at it (gathers stay in-bounds; the position mask hides
 the values) and inactive decode slots write into it.
 
-This module is the HOST-side manager (free list, tables, accounting);
-the device-side gather/scatter math lives in
+PREFIX CACHING (`prefix_cache=True`): millions of users share system
+prompts, so fully-filled PROMPT blocks are hash-consed by content —
+block i's key is the chained digest of every prompt token through the
+end of block i, so a key identifies the block's values exactly (K/V at
+a position is a deterministic function of the token prefix).  A new
+sequence whose leading prompt blocks hit the table SHARES those blocks
+(refcount++) and the scheduler skips their prefill entirely; the share
+is copy-on-write in the degenerate, zero-copy sense: shared blocks are
+fully filled and the only write a sequence can aim at one (re-running
+the last prompt position of a block-aligned hit) writes byte-identical
+values, so no copy is ever needed.  On release, a cached block whose
+refcount drops to zero is NOT freed — it parks in an LRU of
+unreferenced cached blocks and is evicted (hash unregistered, block
+reused) only when an allocation finds the free list empty.
+
+This module is the HOST-side manager (free list, refcounts, hash
+table, LRU, accounting); the device-side gather/scatter math lives in
 models/transformer.build_lm_paged_decoder.
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -52,6 +69,19 @@ _M_UTIL = obs_metrics.gauge(
     "paddle_tpu_serving_kv_pool_utilization",
     "fraction of the KV block pool currently allocated", ("server",),
     always=True)
+_M_PREFIX_HITS = obs_metrics.counter(
+    "paddle_tpu_serving_prefix_hits_total",
+    "prompt blocks served from the prefix cache (prefill skipped)",
+    ("server",), always=True)
+_M_PREFIX_MISSES = obs_metrics.counter(
+    "paddle_tpu_serving_prefix_misses_total",
+    "cacheable prompt blocks that had to be prefilled", ("server",),
+    always=True)
+_M_BYTES_RESIDENT = obs_metrics.gauge(
+    "paddle_tpu_serving_kv_bytes_resident",
+    "device bytes of KV data held by live sequences "
+    "(referenced blocks x bytes per block, K+V, all layers)",
+    ("server",), always=True)
 
 
 class KVPoolExhausted(RuntimeError):
@@ -60,103 +90,322 @@ class KVPoolExhausted(RuntimeError):
     to free), never as a crash."""
 
 
+def _chain_block_hashes(tokens: Sequence[int],
+                        block_size: int) -> List[bytes]:
+    """Chained content digests for each FULL block of `tokens`: key i
+    commits to every token through position (i+1)*block_size, so equal
+    keys mean equal K/V values (decode is deterministic in the prefix).
+    Collision-resistant digests, not Python hash(): a collision would
+    alias two different prefixes into one block — silently wrong
+    tokens, not a crash."""
+    keys = []
+    h = b""
+    for i in range(len(tokens) // block_size):
+        blk = np.asarray(tokens[i * block_size:(i + 1) * block_size],
+                         np.int64)
+        h = hashlib.sha1(h + blk.tobytes()).digest()
+        keys.append(h)
+    return keys
+
+
 class PagedKVCache:
     """Free-list manager over one preallocated pool of KV blocks.
 
     `num_blocks` is the allocatable budget (the device pool holds one
     extra reserved null block).  `server_label` ties the utilization
     series to the owning GenerationServer's metrics instance.
-    """
+    `prefix_cache=True` arms block-level prefix caching (hash-consed
+    full prompt blocks, refcounted sharing, LRU eviction of
+    unreferenced cached blocks).  `bytes_per_block` (device bytes of
+    K+V across all layers for one block) feeds the
+    `paddle_tpu_serving_kv_bytes_resident` gauge."""
 
     def __init__(self, num_blocks: int, block_size: int,
                  max_blocks_per_seq: int,
-                 server_label: Optional[str] = None):
+                 server_label: Optional[str] = None,
+                 prefix_cache: bool = False,
+                 bytes_per_block: int = 0):
         if num_blocks < 1:
             raise ValueError("num_blocks must be >= 1")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.prefix_cache = bool(prefix_cache)
+        self.bytes_per_block = int(bytes_per_block)
         # device block ids 1..num_blocks (0 is the reserved null block)
         self._free: List[int] = list(range(1, self.num_blocks + 1))
         self._owned: Dict[object, List[int]] = {}
+        self._ref: Dict[int, int] = {}            # block -> live refs
+        self._by_hash: Dict[bytes, int] = {}      # content key -> block
+        self._hash_of: Dict[int, bytes] = {}      # block -> content key
+        # unreferenced cached blocks, oldest-released first (eviction
+        # order); values unused
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # owner -> [(filled_end_position, key, block)] awaiting commit:
+        # a freshly-allocated prompt block becomes shareable only after
+        # the scheduler's cursor passes its last position (the K/V is
+        # actually written) — registering earlier would let a second
+        # sequence skip prefill into a still-empty block
+        self._pending: Dict[object, List[Tuple[int, bytes, int]]] = {}
+        self._hits = 0
+        self._misses = 0
         self._lock = threading.Lock()
         self._sid = server_label or f"kv{next(_CACHE_IDS)}"
         self._m_used = _M_BLOCKS_USED.labels(server=self._sid)
         self._m_total = _M_BLOCKS_TOTAL.labels(server=self._sid)
         self._m_util = _M_UTIL.labels(server=self._sid)
+        self._m_hits = _M_PREFIX_HITS.labels(server=self._sid)
+        self._m_misses = _M_PREFIX_MISSES.labels(server=self._sid)
+        self._m_bytes = _M_BYTES_RESIDENT.labels(server=self._sid)
         self._m_total.set(self.num_blocks)
         self._publish()
 
     # -- accounting ---------------------------------------------------------
     @property
     def free_blocks(self) -> int:
+        """Blocks an allocation can claim RIGHT NOW: the free list plus
+        unreferenced cached blocks (evictable).  Admission math and the
+        pool-drained invariants see cached-but-idle memory as free."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._lru)
 
     @property
     def used_blocks(self) -> int:
         return self.num_blocks - self.free_blocks
 
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently registered in the prefix hash table
+        (referenced or parked in the LRU)."""
+        with self._lock:
+            return len(self._by_hash)
+
     def utilization(self) -> float:
         return self.used_blocks / self.num_blocks
+
+    def prefix_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"prefix_hits": self._hits,
+                    "prefix_misses": self._misses,
+                    "kv_blocks_cached": len(self._by_hash)}
 
     def blocks_for(self, num_positions: int) -> int:
         """Blocks needed to hold `num_positions` KV entries."""
         return -(-int(num_positions) // self.block_size)
 
-    def can_admit(self, num_positions: int) -> bool:
+    def prompt_keys(self, prompt_tokens: Sequence[int]) -> List[bytes]:
+        """Precompute the prompt's chained block keys (submit-time
+        memoization hook: the scheduler re-checks a blocked queue head
+        every tick, and re-hashing a long system prompt per tick is
+        wasted host work under the cache lock)."""
+        return _chain_block_hashes(prompt_tokens, self.block_size)
+
+    def _keys(self, prompt_tokens, prompt_keys) -> List[bytes]:
+        if not self.prefix_cache:
+            return []
+        if prompt_keys is not None:
+            return prompt_keys
+        if prompt_tokens is None:
+            return []
+        return _chain_block_hashes(prompt_tokens, self.block_size)
+
+    def can_admit(self, num_positions: int,
+                  prompt_tokens: Optional[Sequence[int]] = None,
+                  prompt_keys: Optional[List[bytes]] = None) -> bool:
         n = self.blocks_for(num_positions)
         if n > self.max_blocks_per_seq:
             return False
+        keys = self._keys(prompt_tokens, prompt_keys)
         with self._lock:
-            return n <= len(self._free)
+            hits, lru_hits = self._count_hits_locked(keys)
+            # hit blocks parked in the LRU are RESURRECTED by the
+            # allocation, not consumed as fresh supply — counting them
+            # on both sides would admit a request allocate_prefix
+            # cannot actually serve
+            avail = len(self._free) + len(self._lru) - lru_hits
+            return n - hits <= avail
+
+    def _count_hits_locked(self, keys) -> Tuple[int, int]:
+        """(leading hit blocks, how many of those sit in the LRU)."""
+        hits = lru_hits = 0
+        for key in keys:
+            blk = self._by_hash.get(key)
+            if blk is None:
+                break           # a hit run must be prefix-contiguous
+            hits += 1
+            if blk in self._lru:
+                lru_hits += 1
+        return hits, lru_hits
 
     def _publish(self):
-        self._m_used.set(self.num_blocks - len(self._free))
-        self._m_util.set((self.num_blocks - len(self._free))
-                         / self.num_blocks)
+        used = self.num_blocks - len(self._free) - len(self._lru)
+        self._m_used.set(used)
+        self._m_util.set(used / self.num_blocks)
+        if self.bytes_per_block:
+            self._m_bytes.set(used * self.bytes_per_block)
 
     # -- alloc/free ---------------------------------------------------------
+    def _take_block_locked(self) -> Optional[int]:
+        """One allocatable block: free list first, else evict the
+        least-recently-released unreferenced cached block (its hash is
+        unregistered — the content is about to be overwritten)."""
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            blk, _ = self._lru.popitem(last=False)
+            key = self._hash_of.pop(blk)
+            self._by_hash.pop(key, None)
+            return blk
+        return None
+
     def allocate(self, owner, num_positions: int) -> np.ndarray:
         """Allocate blocks for `num_positions` under `owner` (one admit
         = one owner, usually the sequence object) and return the padded
         block table [max_blocks_per_seq] int32 (tail entries 0 → the
         null block)."""
+        return self.allocate_prefix(owner, num_positions)[0]
+
+    def allocate_prefix(self, owner, num_positions: int,
+                        prompt_tokens: Optional[Sequence[int]] = None,
+                        prompt_keys: Optional[List[bytes]] = None
+                        ) -> Tuple[np.ndarray, int]:
+        """Allocate like `allocate`, sharing leading fully-filled
+        prompt blocks already in the prefix cache.  Returns (table,
+        cached_positions): the first `cached_positions` logical
+        positions already hold this prompt's K/V — the scheduler starts
+        the cursor there and skips their prefill."""
         n = self.blocks_for(num_positions)
         if n > self.max_blocks_per_seq:
             raise ValueError(
                 f"{num_positions} positions need {n} blocks > "
                 f"max_blocks_per_seq {self.max_blocks_per_seq}")
+        keys = self._keys(prompt_tokens, prompt_keys)
         with self._lock:
             if owner in self._owned:
                 raise ValueError("owner already holds blocks")
-            if n > len(self._free):
-                raise KVPoolExhausted(
-                    f"need {n} KV blocks, {len(self._free)} free "
-                    f"(pool {self.num_blocks})")
-            blocks = [self._free.pop() for _ in range(n)]
+            blocks: List[int] = []
+            hits = 0
+            for key in keys:
+                blk = self._by_hash.get(key)
+                if blk is None:
+                    break
+                blocks.append(blk)
+                self._ref[blk] = self._ref.get(blk, 0) + 1
+                self._lru.pop(blk, None)   # resurrect from eviction
+                hits += 1
+            fresh_start = len(blocks)
+            while len(blocks) < n:
+                blk = self._take_block_locked()
+                if blk is None:
+                    # roll back the shared refs: admission backpressure
+                    # must leave the accounting untouched
+                    for b in blocks[:fresh_start]:
+                        self._release_block_locked(b)
+                    for b in blocks[fresh_start:]:
+                        self._ref.pop(b, None)
+                        self._free.append(b)
+                    raise KVPoolExhausted(
+                        f"need {n} KV blocks, "
+                        f"{len(self._free) + len(self._lru)} free "
+                        f"(pool {self.num_blocks})")
+                self._ref[blk] = 1
+                blocks.append(blk)
             self._owned[owner] = blocks
+            if keys:
+                self._hits += hits
+                self._misses += len(keys) - hits
+                # freshly-allocated FULL prompt blocks become shareable
+                # once commit_prefix sees the cursor pass their end
+                self._pending[owner] = [
+                    ((i + 1) * self.block_size, keys[i], blocks[i])
+                    for i in range(hits, len(keys))]
             self._publish()
+        if hits:
+            self._m_hits.inc(hits)
+        if len(keys) - hits:
+            self._m_misses.inc(len(keys) - hits)
         table = np.zeros(self.max_blocks_per_seq, np.int32)
         table[:n] = blocks
-        return table
+        return table, hits * self.block_size
+
+    def commit_prefix(self, owner, filled_upto: int) -> None:
+        """Register `owner`'s pending prompt blocks whose last position
+        is now < `filled_upto` (the scheduler's cursor: every position
+        below it has its K/V written).  Idempotent; a key another
+        sequence committed first keeps the FIRST block (this owner's
+        copy stays private — identical content, never aliased)."""
+        with self._lock:
+            pend = self._pending.get(owner)
+            if not pend:
+                return
+            remaining = []
+            for end, key, blk in pend:
+                if end <= filled_upto:
+                    if key not in self._by_hash:
+                        self._by_hash[key] = blk
+                        self._hash_of[blk] = key
+                else:
+                    remaining.append((end, key, blk))
+            if remaining:
+                self._pending[owner] = remaining
+            else:
+                self._pending.pop(owner, None)
+
+    def _release_block_locked(self, blk: int) -> None:
+        r = self._ref.get(blk, 0) - 1
+        if r > 0:
+            self._ref[blk] = r
+            return
+        self._ref.pop(blk, None)
+        if blk in self._hash_of:
+            self._lru[blk] = None      # park: evictable, still cached
+        else:
+            self._free.append(blk)
 
     def release(self, owner) -> None:
-        """Return `owner`'s blocks to the free list (idempotent — a
-        sequence evicted twice must not double-free)."""
+        """Drop `owner`'s references (idempotent — a sequence evicted
+        twice must not double-free).  Shared blocks survive while any
+        other sequence references them; cached blocks park in the LRU
+        instead of freeing."""
         with self._lock:
             blocks = self._owned.pop(owner, None)
+            self._pending.pop(owner, None)
             if blocks:
-                self._free.extend(blocks)
+                for blk in blocks:
+                    self._release_block_locked(blk)
                 self._publish()
+
+    def flush_prefix(self) -> None:
+        """Invalidate every cached prefix block: cached K/V is keyed by
+        token content ONLY, so it is valid for exactly one parameter
+        version — a checkpoint hot swap MUST flush or post-swap
+        requests would attend over the old checkpoint's K/V.  Parked
+        (unreferenced) blocks return to the free list; blocks still
+        referenced by live sequences merely lose their registration
+        and free normally on release."""
+        with self._lock:
+            for blk in list(self._lru):
+                self._free.append(blk)
+            self._lru.clear()
+            self._by_hash.clear()
+            self._hash_of.clear()
+            self._pending.clear()
+            self._publish()
+
+    def refcount(self, block: int) -> int:
+        """Live references to `block` (testing/introspection)."""
+        with self._lock:
+            return self._ref.get(int(block), 0)
 
     def close(self):
         """Reclaim this pool's registry series (server churn must not
         grow metric dumps without bound)."""
-        for fam in (_M_BLOCKS_USED, _M_BLOCKS_TOTAL, _M_UTIL):
+        for fam in (_M_BLOCKS_USED, _M_BLOCKS_TOTAL, _M_UTIL,
+                    _M_PREFIX_HITS, _M_PREFIX_MISSES, _M_BYTES_RESIDENT):
             fam.remove(server=self._sid)
 
     def __repr__(self):
         return (f"PagedKVCache(blocks={self.num_blocks}, "
                 f"block_size={self.block_size}, "
-                f"free={self.free_blocks})")
+                f"free={self.free_blocks}, "
+                f"prefix_cache={self.prefix_cache})")

@@ -140,9 +140,17 @@ class ReplicaServer:
                 fault_injector().fire("serving.replica_swap")
                 from .generation import load_generation_model
 
-                states, _ = load_generation_model(req["dir"])
+                states, _, draft_states = load_generation_model(
+                    req["dir"], with_draft=True)
+                # refresh the draft alongside the target when both
+                # sides have one: a stale draft stays correct but its
+                # accept rate against the new checkpoint can collapse
+                # — a silent throughput regression on every swap
+                if getattr(self._server, "_draft", None) is None:
+                    draft_states = None
                 ok = self._server.swap_states(
-                    states, wait=True, timeout=req.get("timeout", 120))
+                    states, draft_states=draft_states,
+                    wait=True, timeout=req.get("timeout", 120))
                 self._reply(f, {"ok": bool(ok)})
             except Exception as e:
                 self._reply(f, {"err": f"swap failed: {e!r}"})

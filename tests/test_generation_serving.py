@@ -13,6 +13,12 @@ Pins the subsystem's contracts:
   * continuous batching beats the drain-then-refill static batch >= 2x
     on tokens/s at no worse p99 under the mixed-length open-loop load
     (perf-marked, structural: both modes run the SAME executable);
+  * block-level prefix caching (hash-consed full prompt blocks,
+    refcounted CoW sharing, LRU eviction) skips shared prefill with
+    bit-identical outputs; speculative decoding (draft + one-dispatch
+    window verify) is bit-identical by construction and cuts ticks
+    ~(spec_k+1)x at high accept rates; bf16/int8 KV pools hold 2-4x
+    the sequences per byte at a pinned token-agreement floor;
   * the replica router survives replica death mid-stream (resumed
     exactly, zero failed requests) and hot-swaps checkpoints with zero
     downtime — in-process (chaos) and across SIGKILLed subprocess
@@ -43,23 +49,31 @@ _DECODERS = {}
 
 
 def _decoder(block_size=4, max_blocks=5, d_model=32, n_heads=2,
-             n_layers=2):
+             n_layers=2, kv_dtype=None):
     """Build (or reuse) a paged decoder + random-init params.  Cached
     per config: the decoder closes over nothing test-mutable, and
     rebuilding+recompiling it per test dominates the module's wall
-    time otherwise."""
+    time otherwise.  kv_dtype variants of one geometry share the SAME
+    parameter values (the fp32 entry is built first) so quantization
+    tests compare pools, not models."""
     from paddle_tpu.models.transformer import build_lm_paged_decoder
 
-    key = (block_size, max_blocks, d_model, n_heads, n_layers)
+    key = (block_size, max_blocks, d_model, n_heads, n_layers,
+           kv_dtype)
     if key not in _DECODERS:
+        base_key = (block_size, max_blocks, d_model, n_heads, n_layers,
+                    None)
         fw.reset_unique_names()
         startup, dec = build_lm_paged_decoder(
             V, block_size, max_blocks, d_model=d_model, n_heads=n_heads,
-            n_layers=n_layers)
-        scope = fluid.Scope()
-        fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
-        states = {n: np.asarray(scope.find_var(n))
-                  for n in dec.state_names}
+            n_layers=n_layers, kv_dtype=kv_dtype)
+        if kv_dtype is not None and base_key in _DECODERS:
+            states = _DECODERS[base_key][1]
+        else:
+            scope = fluid.Scope()
+            fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+            states = {n: np.asarray(scope.find_var(n))
+                      for n in dec.state_names}
         _DECODERS[key] = (dec, states)
     return _DECODERS[key]
 
@@ -206,8 +220,11 @@ def test_admission_waits_for_kv_blocks():
                            place=fluid.CPUPlace())
     try:
         # each needs 3-4 blocks of the 4-block pool -> strictly serial
+        # (disjoint prompts: a shared [0..3] block would let prefix
+        # caching legitimately skip 4 prefill ticks — pinned separately
+        # in test_prefix_caching_skips_prefill_bit_identical)
         s1 = srv.submit(list(range(4)), 10)
-        s2 = srv.submit(list(range(5)), 10)
+        s2 = srv.submit(list(range(5, 10)), 10)
         o1 = s1.result(timeout=60)
         o2 = s2.result(timeout=60)
         assert len(o1) == 10 and len(o2) == 10
@@ -297,7 +314,12 @@ def test_streaming_tokens_and_prometheus_series():
                        "paddle_tpu_serving_generation_seconds",
                        "paddle_tpu_serving_first_token_seconds",
                        "paddle_tpu_serving_kv_pool_utilization",
-                       "paddle_tpu_serving_kv_blocks_in_use"):
+                       "paddle_tpu_serving_kv_blocks_in_use",
+                       "paddle_tpu_serving_prefix_hits_total",
+                       "paddle_tpu_serving_prefix_misses_total",
+                       "paddle_tpu_serving_draft_proposed_total",
+                       "paddle_tpu_serving_draft_accepted_total",
+                       "paddle_tpu_serving_kv_bytes_resident"):
             assert series in text, f"missing {series}"
     finally:
         srv.close()
@@ -331,6 +353,428 @@ def test_hot_swap_drains_then_swaps():
             ref.close()
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# prefix caching: refcount/CoW accounting + prefill skip bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_refcount_cow_accounting():
+    """Host-side goldens: hash-cons on commit, refcounted sharing,
+    release-with-shared-blocks, LRU parking/resurrection, eviction."""
+    cache = PagedKVCache(8, 4, 8, prefix_cache=True)
+    prompt = list(range(10))            # 2 full blocks + 2-token tail
+    t1, cached = cache.allocate_prefix("a", 13, prompt_tokens=prompt)
+    assert cached == 0                  # cold pool: nothing shareable
+    # blocks become shareable only when the cursor passes their end
+    cache.commit_prefix("a", 7)         # block 1 not filled yet
+    t_mid, c_mid = cache.allocate_prefix("m", 13, prompt_tokens=prompt)
+    assert c_mid == 4 and t_mid[0] == t1[0] and t_mid[1] != t1[1]
+    cache.release("m")
+    cache.commit_prefix("a", 9)         # cursor passed both full blocks
+    t2, cached2 = cache.allocate_prefix("b", 13, prompt_tokens=prompt)
+    assert cached2 == 8
+    assert (t2[:2] == t1[:2]).all() and t2[2] != t1[2]
+    assert cache.refcount(int(t1[0])) == 2
+    # release with shared blocks: b keeps the pair alive
+    cache.release("a")
+    assert cache.refcount(int(t1[0])) == 1
+    cache.release("b")
+    # unreferenced cached blocks PARK in the LRU: still allocatable
+    # (free) and still cached, so the next same-prefix admission
+    # resurrects them
+    assert cache.free_blocks == 8 and cache.cached_blocks == 2
+    t3, cached3 = cache.allocate_prefix("c", 13, prompt_tokens=prompt)
+    assert cached3 == 8 and (t3[:2] == t1[:2]).all()
+    cache.release("c")
+    # demand for fresh blocks evicts parked cached blocks
+    # (refcount-aware LRU) and unregisters their hashes
+    cache.allocate_prefix("d", 32)      # all 8 blocks, no prompt
+    assert cache.cached_blocks == 0 and cache.free_blocks == 0
+    cache.release("d")
+    assert cache.free_blocks == 8
+    cache.close()
+
+
+def test_prefix_lru_hits_not_double_counted_as_free():
+    """Review regression: a hit block parked in the LRU is resurrected
+    by the allocation, not consumed as fresh supply — counting it on
+    both sides of can_admit would admit a request allocate_prefix
+    cannot serve (KVPoolExhausted after dequeue = dead scheduler)."""
+    cache = PagedKVCache(2, 4, 4, prefix_cache=True)
+    prompt = list(range(8))
+    cache.allocate_prefix("x", 8, prompt_tokens=prompt)
+    cache.commit_prefix("x", 8)
+    cache.release("x")                      # both blocks park in LRU
+    assert cache.free_blocks == 2
+    # 4 blocks wanted: 2 hits (both in the LRU) + 2 fresh — but the
+    # pool only HAS the 2 hit blocks.  Must refuse, not over-admit.
+    assert not cache.can_admit(16, prompt_tokens=prompt)
+    # and the reduced request that truly fits is still admitted
+    assert cache.can_admit(8, prompt_tokens=prompt)
+    cache.close()
+
+
+def test_hot_swap_flushes_prefix_cache():
+    """Cached prefix K/V belongs to ONE parameter version: after a
+    checkpoint hot swap the same prompt must decode cold under the new
+    weights, not resume from the old checkpoint's blocks."""
+    dec, states = _decoder()
+    states2 = {n: v * 0.5 for n, v in states.items()}
+    prompt = [7, 3, 9, 1, 4, 2, 8, 5]       # 2 full blocks: cacheable
+    ref2 = GenerationServer(dec, states2, slots=2, kv_blocks=8,
+                            place=fluid.CPUPlace())
+    try:
+        want2 = ref2.submit(prompt, 5).result(timeout=60)
+    finally:
+        ref2.close()
+    srv = GenerationServer(dec, states, slots=2, kv_blocks=8,
+                           place=fluid.CPUPlace())
+    try:
+        srv.submit(prompt, 5).result(timeout=60)   # commits blocks
+        assert srv.swap_states(states2, wait=True, timeout=60)
+        assert srv.stats()["kv_blocks_cached"] == 0    # flushed
+        assert srv.submit(prompt, 5).result(timeout=60) == want2
+    finally:
+        srv.close()
+
+
+def test_quantized_pool_never_shares_final_prompt_block():
+    """int8 writes re-quantize their whole block, so a block-aligned
+    full-prompt hit would mutate a SHARED block other live sequences
+    attend to — quantized servers exclude the final prompt block from
+    sharing (keys drop the last token) and stay self-consistent."""
+    dec8, _ = _decoder(block_size=4, max_blocks=5, kv_dtype="int8")
+    _, states = _decoder(block_size=4, max_blocks=5)
+    prompt = [7, 3, 9, 1, 4, 2, 8, 5]       # exactly 2 full blocks
+    srv = GenerationServer(dec8, states, slots=2, kv_blocks=10,
+                           place=fluid.CPUPlace())
+    try:
+        a = srv.submit(prompt, 5).result(timeout=60)
+        b = srv.submit(prompt, 5).result(timeout=60)
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert a == b
+    # only the FIRST block is shareable: the aligned final block is
+    # excluded, so the repeat admission hits exactly once
+    assert st["prefix_hits"] == 1 and st["kv_blocks_cached"] == 1
+    # bf16 writes are single-slot and byte-identical (like fp32), so
+    # bf16 keeps FULL sharing — both aligned blocks hit
+    decb, _ = _decoder(block_size=4, max_blocks=5, kv_dtype="bf16")
+    srvb = GenerationServer(decb, states, slots=2, kv_blocks=10,
+                            place=fluid.CPUPlace())
+    try:
+        x = srvb.submit(prompt, 5).result(timeout=60)
+        y = srvb.submit(prompt, 5).result(timeout=60)
+        stb = srvb.stats()
+    finally:
+        srvb.close()
+    assert x == y and stb["prefix_hits"] == 2
+
+
+def test_hot_swap_refreshes_draft_states():
+    """A swap that carries draft params installs them with the target:
+    the draft keeps agreeing with the NEW checkpoint (a stale draft
+    would stay correct but collapse the accept rate)."""
+    dec, states = _decoder(block_size=4, max_blocks=4)
+    states2 = {n: v * 0.5 for n, v in states.items()}
+    ref2 = GenerationServer(dec, states2, slots=2, kv_blocks=12,
+                            place=fluid.CPUPlace())
+    try:
+        want2 = ref2.submit([7, 3, 9], 8).result(timeout=60)
+    finally:
+        ref2.close()
+    srv = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                           place=fluid.CPUPlace(), draft_decoder=dec,
+                           draft_states=states, spec_k=3)
+    try:
+        srv.submit([7, 3, 9], 8).result(timeout=60)
+        before = srv.stats()
+        assert srv.swap_states(states2, draft_states=states2,
+                               wait=True, timeout=60)
+        got2 = srv.submit([7, 3, 9], 8).result(timeout=60)
+        after = srv.stats()
+    finally:
+        srv.close()
+    assert got2 == want2
+    # refreshed draft == new target: proposals keep being accepted
+    d_prop = after["draft_proposed"] - before["draft_proposed"]
+    d_acc = after["draft_accepted"] - before["draft_accepted"]
+    assert d_prop > 0 and d_acc / d_prop > 0.8, (d_acc, d_prop)
+    # draft_states on a draft-less server is a caller error
+    plain = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                             place=fluid.CPUPlace())
+    try:
+        with pytest.raises(ValueError, match="no draft"):
+            plain.swap_states(states2, draft_states=states2)
+    finally:
+        plain.close()
+
+
+def test_prefix_exhaustion_rolls_back_shared_refs():
+    """Backpressure mid-allocation must undo the hit refcounts it
+    already took, or retried admissions leak references."""
+    cache = PagedKVCache(2, 4, 4, prefix_cache=True)
+    cache.allocate_prefix("x", 8, prompt_tokens=list(range(8)))
+    cache.commit_prefix("x", 8)
+    with pytest.raises(KVPoolExhausted):
+        cache.allocate_prefix("y", 16, prompt_tokens=list(range(8)))
+    cache.release("x")
+    assert cache.free_blocks == 2       # rollback left nothing pinned
+    cache.close()
+
+
+def test_prefix_caching_skips_prefill_bit_identical():
+    """Shared-prefix admissions skip prefill ticks (cursor starts past
+    the hit blocks) and stay bit-identical to a cold server — incl.
+    the block-ALIGNED full-prompt hit, whose first step re-writes the
+    last shared position with identical values (zero-copy CoW)."""
+    dec, states = _decoder(block_size=4, max_blocks=4)
+    shared = [7, 3, 9, 1, 4, 2, 8, 5]   # exactly 2 full blocks
+    prompts = ([shared]                 # cold fill
+               + [shared]               # aligned full-prompt hit
+               + [shared + [t] for t in (11, 12)]   # prefix + suffix
+               + [[5, 2, 1]])           # unrelated
+    cold = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                            place=fluid.CPUPlace(), prefix_cache=False)
+    try:
+        want = [cold.submit(p, 5).result(timeout=60) for p in prompts]
+        ticks_cold = cold.stats()["ticks"]
+    finally:
+        cold.close()
+    srv = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                           place=fluid.CPUPlace())   # prefix on: default
+    try:
+        got = [srv.submit(p, 5).result(timeout=60) for p in prompts]
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert got == want
+    # 3 follow-ups x 2 shared blocks each
+    assert st["prefix_hits"] >= 6
+    assert st["kv_blocks_cached"] >= 2
+    # skipped prefill shows up as strictly fewer decode ticks
+    assert st["ticks"] <= ticks_cold - 3 * 8 + 3
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: bit-identity + tick reduction
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_bit_identical_mixed_admissions():
+    """The PR 8 equivalence harness with a (random-init, mostly
+    rejected) draft armed: staggered admissions, mixed lengths, a
+    sampled request in the mix — every stream equals the plain
+    server's output, which itself equals solo decode."""
+    dec, states = _decoder(block_size=4, max_blocks=4)
+    draft, dstates = _decoder(block_size=4, max_blocks=4, d_model=16,
+                              n_layers=1)
+    r = np.random.RandomState(2)
+    prompts = [list(r.randint(0, V, n)) for n in (3, 6, 2, 5, 4, 3)]
+    max_news = [6, 9, 12, 4, 8, 5]
+
+    plain = GenerationServer(dec, states, slots=3, kv_blocks=12,
+                             place=fluid.CPUPlace())
+    try:
+        want = [plain.submit(p, m).result(timeout=60)
+                for p, m in zip(prompts, max_news)]
+        want_sampled = plain.submit(prompts[0], 6, temperature=0.7,
+                                    seed=11).result(timeout=60)
+    finally:
+        plain.close()
+
+    srv = GenerationServer(dec, states, slots=3, kv_blocks=12,
+                           place=fluid.CPUPlace(), draft_decoder=draft,
+                           draft_states=dstates, spec_k=3)
+    try:
+        first = [srv.submit(p, m)
+                 for p, m in zip(prompts[:3], max_news[:3])]
+        while srv.stats()["generated_tokens"] == 0:
+            time.sleep(0.002)
+        rest = [srv.submit(p, m)
+                for p, m in zip(prompts[3:], max_news[3:])]
+        got = [s.result(timeout=60) for s in first + rest]
+        # sampled requests ride the same windowed step, one position
+        # per tick, with the untouched (seed, position) PRNG
+        got_sampled = srv.submit(prompts[0], 6, temperature=0.7,
+                                 seed=11).result(timeout=60)
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert got == want
+    assert got_sampled == want_sampled
+    assert st["draft_proposed"] > 0
+    assert st["kv_blocks_free"] == 12
+
+
+def test_speculative_perfect_draft_cuts_ticks():
+    """With the target as its own draft the accept rate is ~1, so a
+    spec_k=3 server must finish in well under half the plain server's
+    ticks while emitting identical tokens — the structural form of the
+    speculative win (k+1 tokens per verified window)."""
+    dec, states = _decoder(block_size=4, max_blocks=4)
+    prompts = [[7, 3, 9], [1, 4, 2, 8]]
+    plain = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                             place=fluid.CPUPlace(),
+                             prefix_cache=False)
+    try:
+        want = [plain.submit(p, 10).result(timeout=60) for p in prompts]
+        ticks_plain = plain.stats()["ticks"]
+    finally:
+        plain.close()
+    srv = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                           place=fluid.CPUPlace(), prefix_cache=False,
+                           draft_decoder=dec, draft_states=states,
+                           spec_k=3)
+    try:
+        got = [srv.submit(p, 10).result(timeout=60) for p in prompts]
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert got == want
+    assert st["draft_accepted"] > 0
+    accept = st["draft_accepted"] / st["draft_proposed"]
+    assert accept > 0.8, (accept, st)
+    assert st["ticks"] * 2 <= ticks_plain, (st["ticks"], ticks_plain)
+
+
+def test_prefix_plus_spec_combined_bit_identical():
+    """Acceptance: BOTH tentpole optimizations stacked — shared-prefix
+    admissions through a speculative server — still emit the plain
+    server's exact greedy tokens, with hits and accepts both
+    registering and fewer ticks than the cold non-speculative run."""
+    dec, states = _decoder(block_size=4, max_blocks=4)
+    shared = [7, 3, 9, 1, 4, 2, 8, 5]   # 2 full blocks
+    prompts = [shared, shared, shared + [11], [5, 2, 1]]
+    plain = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                             place=fluid.CPUPlace(),
+                             prefix_cache=False)
+    try:
+        want = [plain.submit(p, 6).result(timeout=60) for p in prompts]
+        ticks_plain = plain.stats()["ticks"]
+    finally:
+        plain.close()
+    srv = GenerationServer(dec, states, slots=2, kv_blocks=12,
+                           place=fluid.CPUPlace(),   # prefix default on
+                           draft_decoder=dec, draft_states=states,
+                           spec_k=3)
+    try:
+        got = [srv.submit(p, 6).result(timeout=60) for p in prompts]
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert got == want
+    assert st["prefix_hits"] > 0 and st["draft_accepted"] > 0
+    assert st["ticks"] < ticks_plain, (st["ticks"], ticks_plain)
+
+
+def test_model_dir_draft_and_kv_dtype_roundtrip(tmp_path):
+    """save/load_generation_model carry optional draft params and
+    kv_dtype; server_from_model_dir arms speculation and the
+    quantized pool from the spec alone."""
+    from paddle_tpu.serving import server_from_model_dir
+
+    dec, states = _decoder(block_size=4, max_blocks=5)
+    draft, dstates = _decoder(block_size=4, max_blocks=5, d_model=16,
+                              n_layers=1)
+    d = str(tmp_path / "m")
+    save_generation_model(d, states, {
+        "vocab_size": V, "d_model": 32, "n_heads": 2, "n_layers": 2,
+        "block_size": 4, "max_blocks_per_seq": 5, "kv_dtype": "bf16",
+        "spec_k": 2, "slots": 2, "kv_blocks": 8,
+        "draft": {"d_model": 16, "n_heads": 2, "n_layers": 1}},
+        draft_states=dstates)
+    srv = server_from_model_dir(d, place=fluid.CPUPlace())
+    try:
+        st = srv.stats()
+        assert st["spec_k"] == 2 and st["kv_dtype"] == "bf16"
+        out = srv.generate([1, 2, 3], 5, timeout=60)
+        assert len(out) == 5 and all(0 <= t < V for t in out)
+    finally:
+        srv.close()
+    # draft params are optional: use_draft=False serves plain
+    srv2 = server_from_model_dir(d, place=fluid.CPUPlace(),
+                                 use_draft=False, kv_dtype="fp32")
+    try:
+        assert srv2.stats()["spec_k"] == 0
+        assert srv2.generate([1, 2, 3], 5, timeout=60)
+    finally:
+        srv2.close()
+    # a draft_states save without the draft architecture must fail
+    with pytest.raises(ValueError, match="draft"):
+        save_generation_model(str(tmp_path / "bad"), states, {
+            "vocab_size": V, "d_model": 32, "n_heads": 2,
+            "n_layers": 2}, draft_states=dstates)
+
+
+# ---------------------------------------------------------------------------
+# KV quantization: tolerance + residency
+# ---------------------------------------------------------------------------
+
+
+def test_kv_quantization_tolerance_vs_fp32():
+    """bf16/int8 pools decode the same greedy tokens as fp32 within a
+    pinned agreement floor.  Measured 1.00 on this model family (the
+    argmax margin dwarfs the quantization noise); the 0.9 floor keeps
+    the pin honest against platform rounding differences."""
+    dec32, states = _decoder(block_size=4, max_blocks=5)
+    r = np.random.RandomState(5)
+    prompts = [list(r.randint(0, V, n)) for n in (3, 5, 4)]
+
+    def run(dec):
+        srv = GenerationServer(dec, states, slots=2, kv_blocks=8,
+                               place=fluid.CPUPlace())
+        try:
+            return [srv.submit(p, 8).result(timeout=60)
+                    for p in prompts]
+        finally:
+            srv.close()
+
+    want = run(dec32)
+    for kv_dtype in ("bf16", "int8"):
+        dec_q, _ = _decoder(block_size=4, max_blocks=5,
+                            kv_dtype=kv_dtype)
+        got = run(dec_q)
+        agree = np.mean([a == b for o1, o2 in zip(want, got)
+                         for a, b in zip(o1, o2)])
+        assert agree >= 0.9, (kv_dtype, agree, want, got)
+
+
+def test_quantized_pool_admits_2x_resident_sequences():
+    """Same device byte budget, blocks re-derived per dtype: the int8
+    pool must hold >= 1.8x (here: >= 3x) the fp32 pool's concurrent
+    sequences.  Structural: bytes_per_block drops ~4x, so the same
+    budget buys ~4x the blocks."""
+    dec32, states = _decoder(block_size=4, max_blocks=4)
+    dec8, _ = _decoder(block_size=4, max_blocks=4, kv_dtype="int8")
+    assert dec32.bytes_per_block >= 3.5 * dec8.bytes_per_block
+    budget = 4 * dec32.bytes_per_block
+    peaks = {}
+    for dec in (dec32, dec8):
+        kv_blocks = max(1, budget // dec.bytes_per_block)
+        srv = GenerationServer(dec, states, slots=6,
+                               kv_blocks=int(kv_blocks),
+                               place=fluid.CPUPlace())
+        try:
+            # every request needs 3 blocks (2 + 10 - 1 positions)
+            streams = [srv.submit([3, 1], 10) for _ in range(8)]
+            peak = 0
+            deadline = time.monotonic() + 60
+            while (any(not s.done for s in streams)
+                   and time.monotonic() < deadline):
+                peak = max(peak, srv.stats()["active_sequences"])
+                time.sleep(0.001)
+            for s in streams:
+                assert len(s.result(timeout=60)) == 10
+        finally:
+            srv.close()
+        peaks[dec.kv_dtype] = peak
+    # fp32: 4 blocks -> 1 resident; int8: ~15 blocks -> >=3 resident
+    assert peaks["fp32"] >= 1
+    assert peaks["int8"] >= 1.8 * peaks["fp32"], peaks
 
 
 # ---------------------------------------------------------------------------

@@ -242,13 +242,19 @@ def test_translate_generator_copy_task():
         l2 = fluid.layers.reshape(lbl, shape=[-1, 1])
         loss = fluid.layers.mean(
             fluid.layers.cross_entropy(input=p2, label=l2))
-        fluid.Adam(learning_rate=1e-2).minimize(loss)
+        # lr 3e-3 x 400 iters (was 1e-2 x 200): at this jax version the
+        # old recipe deterministically plateaus at loss ~1.17 / copy
+        # accuracy 0.30 (environment drift in init/numerics, present at
+        # clean HEAD) while the gentler rate reconverges to loss ~0.001
+        # and copy accuracy 1.00 — retuned rather than re-pinned, the
+        # model genuinely learns the task again
+        fluid.Adam(learning_rate=3e-3).minimize(loss)
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup, scope=scope)
     r = np.random.RandomState(0)
     last = None
-    for _ in range(200):
+    for _ in range(400):
         s = r.randint(2, V, (16, S))
         # teacher forcing: tgt_in = [bos, y..., eos-pad], label = [y...,
         # eos, eos-pad], y = src (copy task), decoder width T > S

@@ -120,9 +120,17 @@ startup, dec = build_lm_paged_decoder(23, 4, 4, d_model=16, n_heads=2,
 scope = fluid.Scope()
 fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
 states = {n: np.asarray(scope.find_var(n)) for n in dec.state_names}
+# target doubles as its own draft: the speculative + prefix-cache
+# paths run for real (proposals verified, prompt blocks hash-consed)
 srv = GenerationServer(dec, states, slots=2, kv_blocks=8,
-                       place=fluid.CPUPlace())
-assert srv.generate([1, 2, 3], 4, timeout=60)
+                       place=fluid.CPUPlace(),
+                       draft_decoder=dec, draft_states=states,
+                       spec_k=2)
+assert srv.generate([1, 2, 3, 4], 6, timeout=60)
+assert srv.generate([1, 2, 3, 4], 6, timeout=60)
+st = srv.stats()
+assert st["draft_proposed"] > 0, st
+assert st["prefix_hits"] > 0, st
 text = exporters.prometheus_text()
 for series in ("paddle_tpu_serving_generation_requests_total",
                "paddle_tpu_serving_generated_tokens_total",
@@ -131,10 +139,16 @@ for series in ("paddle_tpu_serving_generation_requests_total",
                "paddle_tpu_serving_generation_seconds",
                "paddle_tpu_serving_first_token_seconds",
                "paddle_tpu_serving_kv_blocks_in_use",
-               "paddle_tpu_serving_kv_pool_utilization"):
+               "paddle_tpu_serving_kv_pool_utilization",
+               "paddle_tpu_serving_prefix_hits_total",
+               "paddle_tpu_serving_prefix_misses_total",
+               "paddle_tpu_serving_draft_proposed_total",
+               "paddle_tpu_serving_draft_accepted_total",
+               "paddle_tpu_serving_kv_bytes_resident"):
     assert series in text, f"missing {series} in Prometheus dump"
 srv.close()
-print("generation serving series visible in Prometheus dump")
+print("generation serving series visible in Prometheus dump "
+      "(incl. prefix-cache + speculative-decoding series)")
 EOF
 
 echo "== [8/8] multichip sharding: spmd transpiler on the 8-device virtual mesh =="
