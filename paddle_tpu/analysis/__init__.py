@@ -41,6 +41,20 @@ from .registry import (  # noqa: F401
     verify_program,
 )
 from . import passes as _builtin_passes  # noqa: F401  (registers built-ins)
+from . import cost_model  # noqa: F401  (registers cost/comm passes)
+from .cost_model import (  # noqa: F401
+    CommEstimate,
+    OpCost,
+    ProgramCostEstimate,
+    analyze_generation_spec,
+    check_budget,
+    estimate_comm,
+    estimate_op,
+    estimate_peak_hbm,
+    estimate_program,
+    ridge_point,
+    serving_kernel_cost,
+)
 
 __all__ = [
     "Diagnostic",
@@ -55,6 +69,17 @@ __all__ = [
     "preflight",
     "PassContext",
     "AnalysisPass",
+    "OpCost",
+    "ProgramCostEstimate",
+    "CommEstimate",
+    "estimate_op",
+    "estimate_program",
+    "estimate_peak_hbm",
+    "estimate_comm",
+    "ridge_point",
+    "analyze_generation_spec",
+    "serving_kernel_cost",
+    "check_budget",
 ]
 
 

@@ -57,6 +57,22 @@ class Diagnostic:
     def __post_init__(self):
         severity_rank(self.severity)  # validate
 
+    def to_dict(self) -> dict:
+        """Machine-readable form (cli verify/analyze --json): severity +
+        pass id, a structured location, the message, and the fix hint —
+        stable keys for CI annotations and editor integrations."""
+        return {
+            "pass": self.pass_id,
+            "severity": self.severity,
+            "message": self.message,
+            "location": {
+                "block": self.block_idx,
+                "op": self.op_idx,
+                "op_type": self.op_type,
+            },
+            "hint": self.hint or None,
+        }
+
     def location(self) -> str:
         loc = f"block {self.block_idx}"
         if self.op_idx is not None:

@@ -710,6 +710,185 @@ def check_distributed(ctx):
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# 7b. collective safety (deadlock class of the mesh lowerings)
+# ---------------------------------------------------------------------------
+
+# the pipeline schedule's own hop primitive — the one legitimate user of
+# the stage axis's ring from inside a staged region
+_PIPELINE_HOPS = ("c_ppermute",)
+_STAGE_AXIS = "pp"
+# control-flow ops whose sub-block executes on a data-dependent subset
+# of ranks (branch predicates can differ per rank)
+_BRANCH_OPS = ("cond", "conditional_block")
+_LOOP_OPS = ("while", "recurrent", "dynamic_rnn")
+
+
+@register_pass("collective-safety", order=73)
+def check_collective_safety(ctx):
+    """Static deadlock analysis of the program's collective structure —
+    the class of bug the ep x dp x tp composition hits at RUNTIME as a
+    silent all-rank hang, caught here from the op descs.
+
+    Rank model: `ring_id` names one communicator spanning every rank
+    that references it; ops carrying a `pipeline_stage` attr are issued
+    only by that stage's ranks, unstaged ops by all ranks.  Under SPMD
+    every participating rank must issue an IDENTICAL sequence of
+    collectives per ring, so the pass errors on:
+
+      * cross-rank ordering mismatch — two stages issue the same ring's
+        collectives in different orders (every rank blocks inside a
+        different collective; none completes);
+      * pipeline-stage collective imbalance — stages disagree on HOW
+        MANY collectives they issue on one ring (some ranks wait at a
+        collective their peers never reach);
+      * ring_id reuse across overlapping groups — a staged collective
+        (other than the schedule's own `c_ppermute` hops) over the
+        stage axis's ring: the per-stage subgroup overlaps the
+        schedule's full-axis group on one communicator;
+      * a collective inside a data-dependent branch sub-block (cond):
+        ranks disagreeing on the predicate deadlock the ring; inside a
+        loop sub-block it is a warning (trip counts must match on every
+        rank, which the IR cannot prove).
+
+    Programs with no collective ops skip the pass entirely."""
+    program = ctx.program
+    n_blocks = len(program.blocks)
+
+    # who owns each sub-block (for the control-flow context of a ring)
+    owner: Dict[int, object] = {}
+    for block, idx, op in ctx.iter_ops():
+        for _attr, tidx in _block_refs(op):
+            if isinstance(tidx, int) and 0 <= tidx < n_blocks:
+                owner.setdefault(tidx, op)
+
+    def enclosing_control(block):
+        """Nearest control-flow op owning `block` or an ancestor, or
+        None for trunk blocks."""
+        b = block
+        seen = set()
+        while b is not None and b.idx not in seen:
+            seen.add(b.idx)
+            op = owner.get(b.idx)
+            if op is not None and op.type in _BRANCH_OPS + _LOOP_OPS:
+                return op
+            b = _safe_parent(program, b)
+        return None
+
+    any_collective = False
+    ring_scopes: Dict[str, Set[int]] = {}  # ring -> block idxs using it
+    staged: Dict[int, List] = {}   # stage -> [(op_idx, type, ring)]
+    all_stages: Set[int] = set()   # every stage any op runs under
+    for block, idx, op in ctx.iter_ops():
+        if "pipeline_stage" in op.attrs and not op.type.endswith(_GRAD):
+            all_stages.add(int(op.attrs["pipeline_stage"]))
+        if not op.type.startswith("c_"):
+            continue
+        any_collective = True
+        attrs = _effective_attrs(ctx, op)
+        ring = attrs.get("ring_id")
+        if not isinstance(ring, str) or not ring:
+            continue  # distributed-lint reports the malformed ring_id
+        ring_scopes.setdefault(ring, set()).add(block.idx)
+
+        ctl = enclosing_control(block)
+        if ctl is not None:
+            if ctl.type in _BRANCH_OPS:
+                yield ctx.diag(
+                    "error",
+                    f"collective {op.type!r} (ring {ring!r}) sits in "
+                    f"the sub-block of a {ctl.type!r} op — ranks taking "
+                    "different branches deadlock the ring",
+                    block, idx, op,
+                    hint="hoist the collective out of the branch, or "
+                         "make the predicate provably rank-uniform")
+            else:
+                yield ctx.diag(
+                    "warning",
+                    f"collective {op.type!r} (ring {ring!r}) sits in "
+                    f"the body of a {ctl.type!r} op — every rank must "
+                    "run the same trip count or the ring deadlocks",
+                    block, idx, op,
+                    hint="prefer a fixed trip count shared by all "
+                         "ranks")
+
+        stage = op.attrs.get("pipeline_stage")
+        if stage is not None:
+            stage = int(stage)
+            staged.setdefault(stage, []).append((idx, op.type, ring))
+            if ring == _STAGE_AXIS and op.type not in _PIPELINE_HOPS:
+                yield ctx.diag(
+                    "error",
+                    f"staged collective {op.type!r} at stage {stage} "
+                    f"reuses ring {_STAGE_AXIS!r} — the stage axis's "
+                    "communicator belongs to the pipeline schedule's "
+                    "permutes; a per-stage reduction over it overlaps "
+                    "the schedule's full-axis group",
+                    block, idx, op,
+                    hint="reduce over a dedicated axis (dp/tp) or "
+                         "after the pipeline epilogue")
+
+    if not any_collective:
+        return
+
+    # ring used from both the trunk and a control-flow sub-block: the
+    # scopes execute under different schedules on one communicator
+    for ring, scopes in sorted(ring_scopes.items()):
+        sub = sorted(i for i in scopes if i != 0)
+        if 0 in scopes and sub:
+            yield ctx.diag(
+                "warning",
+                f"ring {ring!r} is used from the global block AND from "
+                f"sub-block(s) {sub} — one communicator under two "
+                "control-flow scopes is an overlapping-group hazard",
+                program.blocks[0],
+                hint="give control-flow-scoped collectives their own "
+                     "ring (mesh axis)")
+
+    # per-rank sequences: every stage the program runs ops under (a
+    # stage with NO collectives on a shared ring is the imbalance case)
+    # must issue identical (type) sequences per ring
+    if not staged or len(all_stages) < 2:
+        return
+    stages = sorted(all_stages | set(staged))
+    per_ring: Dict[str, Dict[int, List[str]]] = {}
+    for s in stages:
+        for _idx, typ, ring in staged.get(s, ()):
+            per_ring.setdefault(ring, {}).setdefault(s, []).append(typ)
+    # unstaged collectives run on all ranks uniformly — no check needed
+    for ring, by_stage in sorted(per_ring.items()):
+        if ring == _STAGE_AXIS:
+            continue  # hop/reuse handled above
+        seqs = {s: tuple(by_stage.get(s, ())) for s in stages}
+        baseline_stage = min(s for s in stages if seqs[s])
+        base = seqs[baseline_stage]
+        for s in stages:
+            if seqs[s] == base:
+                continue
+            if len(seqs[s]) != len(base):
+                yield ctx.diag(
+                    "error",
+                    f"pipeline-stage collective imbalance on ring "
+                    f"{ring!r}: stage {baseline_stage} issues "
+                    f"{len(base)} collective(s) {list(base)} but stage "
+                    f"{s} issues {len(seqs[s])} {list(seqs[s])} — ranks "
+                    "wait at a collective their peers never reach",
+                    program.blocks[0],
+                    hint="every stage must issue the same collectives "
+                         "on a shared ring (SPMD discipline)")
+            else:
+                yield ctx.diag(
+                    "error",
+                    f"cross-rank collective ordering mismatch on ring "
+                    f"{ring!r}: stage {baseline_stage} issues "
+                    f"{list(base)} but stage {s} issues "
+                    f"{list(seqs[s])} — each rank blocks inside a "
+                    "different collective and none completes",
+                    program.blocks[0],
+                    hint="issue collectives in one canonical order on "
+                         "every rank")
+
+
 @register_pass("sharding-consistency", order=72)
 def check_sharding_consistency(ctx):
     """Multichip sharding annotations (layers.shard /

@@ -150,6 +150,7 @@ def verify_program(
     `passes`: restrict to these pass ids (default: all registered).
     """
     from . import passes as _builtin  # noqa: F401  (registers built-ins)
+    from . import cost_model as _cost  # noqa: F401  (cost/comm passes)
 
     selected = (registered_passes() if passes is None
                 else [get_pass(p) for p in passes])

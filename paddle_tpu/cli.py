@@ -435,11 +435,29 @@ def _programs_from_target(path):
             yield label, prog, None, None
 
 
+def _diagnostics_json(diagnostics):
+    """The shared machine-readable diagnostics list (`cli verify --json`
+    and `cli analyze --json` emit the same shape): one dict per
+    Diagnostic with severity / pass / location / hint
+    (analysis.Diagnostic.to_dict), strongest severity first."""
+    from paddle_tpu.analysis import severity_rank
+
+    ordered = sorted(
+        diagnostics,
+        key=lambda d: (-severity_rank(d.severity), d.block_idx,
+                       -1 if d.op_idx is None else d.op_idx))
+    return [d.to_dict() for d in ordered]
+
+
 def cmd_verify(argv):
-    """`python -m paddle_tpu.cli verify TARGET... [--level error]` —
-    run the static analyzer (paddle_tpu.analysis) over programs saved by
-    io.py or built by config/example files; exit non-zero when any
-    diagnostic reaches --level."""
+    """`python -m paddle_tpu.cli verify TARGET... [--level error]
+    [--json]` — run the static analyzer (paddle_tpu.analysis) over
+    programs saved by io.py or built by config/example files; exit
+    non-zero when any diagnostic reaches --level.  `--json` replaces the
+    human report with one JSON document (diagnostics as a structured
+    list) for CI and editor consumers."""
+    import json
+
     from paddle_tpu.analysis import format_diagnostics, severity_rank
 
     ap = argparse.ArgumentParser(
@@ -456,6 +474,9 @@ def cmd_verify(argv):
     ap.add_argument("--show", default="warning",
                     choices=["error", "warning", "info"],
                     help="minimum severity to print")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of the human "
+                    "report (machine-readable diagnostics)")
     args = ap.parse_args(argv)
 
     passes = [p for p in args.passes.split(",") if p] or None
@@ -463,41 +484,291 @@ def cmd_verify(argv):
         "warning" if args.level == "warn" else args.level)
     n_programs = 0
     failed = False
+    results = []
     for target in args.targets:
         for label, prog, feeds, fetches in _programs_from_target(target):
             n_programs += 1
             diagnostics = prog.verify(level=None, passes=passes,
                                       feed_names=feeds,
                                       fetch_names=fetches)
+            bad = [d for d in diagnostics
+                   if severity_rank(d.severity) >= fail_rank]
+            failed = failed or bool(bad)
+            if args.json:
+                results.append({
+                    "target": target,
+                    "label": label,
+                    "status": "fail" if bad else "ok",
+                    "diagnostics": _diagnostics_json(diagnostics),
+                })
+                continue
             shown = [d for d in diagnostics
                      if severity_rank(d.severity)
                      >= severity_rank(args.show)]
-            bad = [d for d in diagnostics
-                   if severity_rank(d.severity) >= fail_rank]
             status = "FAIL" if bad else "ok"
             print(f"[{status}] {label}: {len(diagnostics)} diagnostic(s)")
             if shown:
                 print(format_diagnostics(shown))
-            failed = failed or bool(bad)
     if not n_programs:
         raise SystemExit("verify: no programs found in the given targets")
-    print(f"verify: {n_programs} program(s) checked — "
-          + ("FAILED" if failed else "all clean at level "
-             + args.level))
+    if args.json:
+        print(json.dumps({"level": args.level, "failed": failed,
+                          "programs": results}, indent=1))
+    else:
+        print(f"verify: {n_programs} program(s) checked — "
+              + ("FAILED" if failed else "all clean at level "
+                 + args.level))
     return 1 if failed else 0
+
+
+# ---------------------------------------------------------------------------
+# `analyze` subcommand: static cost / roofline / comm / budget gate
+# ---------------------------------------------------------------------------
+
+
+def _load_budgets(path):
+    import json
+
+    with open(path) as f:
+        budgets = json.load(f)
+    if not isinstance(budgets.get("models", None), dict):
+        raise SystemExit(
+            f"budget file {path!r} must be "
+            "{'defaults': {...}, 'models': {target: {...}}} "
+            "(docs/analysis.md 'Budget gate')")
+    return budgets
+
+
+def _budget_for(budgets, target):
+    """Budget entry for one analyze target: exact key match on the
+    target as given, else on its basename — overlaid on 'defaults'."""
+    models = budgets.get("models", {})
+    entry = models.get(target)
+    if entry is None:
+        entry = models.get(os.path.basename(target))
+    if entry is None:
+        return None
+    return {**budgets.get("defaults", {}), **entry}
+
+
+def cmd_analyze(argv):
+    """`python -m paddle_tpu.cli analyze TARGET... [--json]
+    [--budget budgets.json] [--batch N]` — the compile-free cost
+    report: static roofline (FLOPs, HBM traffic, arithmetic intensity
+    vs the device ridge point, memory/compute-bound verdict), the
+    liveness-based peak-HBM estimate, per-mesh-axis comm volume, and
+    the cost/collective diagnostics, for every program a target builds
+    — plus generation model dirs (generation.json), costed from the
+    serving-kernel entries without building a decoder.
+
+    With `--budget`, each target's headline program is gated against
+    its checked-in budget entry and the exit status is non-zero on any
+    violation — a perf-regression gate that never invokes XLA
+    (docs/analysis.md 'Budget gate')."""
+    import json as _json
+
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import cost_model
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli analyze",
+        description="static cost analysis of Program IR / generation "
+        "model dirs (docs/analysis.md)")
+    ap.add_argument("targets", nargs="+",
+                    help="config/example file defining build(), model "
+                    "dir (save_inference_model output), or generation "
+                    "model dir (save_generation_model output)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document (shares the verify "
+                    "--json diagnostics shape)")
+    ap.add_argument("--budget", default="",
+                    help="budgets.json path: exit non-zero when an "
+                    "estimate exceeds its checked-in budget")
+    ap.add_argument("--batch", type=int,
+                    default=cost_model.DEFAULT_BATCH,
+                    help="batch size substituted for -1 dims "
+                    f"(default {cost_model.DEFAULT_BATCH})")
+    ap.add_argument("--device", default=cost_model.DEFAULT_DEVICE,
+                    choices=sorted(cost_model.DEVICE_SPECS),
+                    help="ridge-point device (default: the bench chip)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="top-N traffic-heavy ops to list per program")
+    args = ap.parse_args(argv)
+
+    budgets = _load_budgets(args.budget) if args.budget else None
+    out = {"programs": [], "violations": []}
+    n_targets = 0
+
+    for target in args.targets:
+        spec_path = os.path.join(target, "generation.json") \
+            if os.path.isdir(target) else ""
+        if spec_path and os.path.exists(spec_path):
+            n_targets += 1
+            with open(spec_path) as f:
+                spec = _json.load(f)
+            rep = analysis.analyze_generation_spec(spec,
+                                                   device=args.device)
+            out["programs"].append({"target": target,
+                                    "kind": "generation",
+                                    "report": rep})
+            if not args.json:
+                _print_generation_report(target, rep)
+            if budgets is not None and _budget_for(budgets,
+                                                  target) is not None:
+                # fail loudly rather than silently skipping a budget
+                # the operator checked in
+                out["violations"].append(
+                    f"{target}: budget entries for generation model "
+                    "dirs are not supported (budgets gate Program "
+                    "targets)")
+            continue
+
+        headline = None
+        target_unknown: dict = {}
+        for label, prog, feeds, fetches in _programs_from_target(target):
+            n_targets += 1
+            est = analysis.estimate_program(
+                prog, batch_size=args.batch, feed_names=feeds,
+                fetch_names=fetches, device=args.device)
+            if (not est.total_flops and not est.total_bytes
+                    and not est.unknown_types):
+                continue  # empty program: no roofline signal.  A
+                # program whose ops are all cost-UNKNOWN must NOT be
+                # skipped — that is the coverage regression the
+                # max_unknown_ops budget floor exists to catch
+            comm = analysis.estimate_comm(
+                prog, batch_size=args.batch,
+                fetch_names=fetches).by_axis()
+            # collective-safety only: the cost-model/comm-volume pass
+            # output would just re-derive the `est`/`comm` tables this
+            # report already carries (and re-run the liveness walk)
+            diagnostics = prog.verify(
+                level=None, passes=["collective-safety"],
+                feed_names=feeds, fetch_names=fetches)
+            rep = {
+                "target": target,
+                "label": label,
+                "kind": "program",
+                "roofline": est.roofline(),
+                "comm": comm,
+                "top_traffic_ops": [
+                    {"block": b, "op": i, "type": t, "ai": ai,
+                     "bytes": by}
+                    for b, i, t, ai, by in est.top_memory_bound(args.top)
+                ],
+                "diagnostics": _diagnostics_json(diagnostics),
+            }
+            out["programs"].append(rep)
+            for t, c in est.unknown_types.items():
+                target_unknown[t] = target_unknown.get(t, 0) + c
+            if headline is None or (est.total_flops
+                                    > headline[1].total_flops):
+                headline = (rep, est)
+            if not args.json:
+                _print_program_report(rep)
+
+        if budgets is not None:
+            budget = _budget_for(budgets, target)
+            if headline is None:
+                if budget is not None:
+                    # a budgeted target with nothing analyzable is a
+                    # failure, not a silent pass (the config may have
+                    # stopped building, or every op lost its metadata)
+                    out["violations"].append(
+                        f"{target}: has a budget entry but produced no "
+                        "analyzable program")
+            elif budget is None:
+                out["violations"].append(
+                    f"{target}: no budget entry in {args.budget} "
+                    "(add one under 'models')")
+            else:
+                # flops/traffic/peak limits gate the headline program
+                # (budgets are seeded from it), but the COVERAGE floor
+                # is target-wide: an unknown-cost op in ANY program of
+                # the target is the regression max_unknown_ops catches
+                gated = dict(headline[0])
+                gated["roofline"] = {
+                    **headline[0]["roofline"],
+                    "unknown_ops": sum(target_unknown.values()),
+                    "unknown_types": sorted(target_unknown),
+                }
+                for v in analysis.check_budget(gated, budget):
+                    out["violations"].append(f"{target}: {v}")
+
+    if not n_targets:
+        raise SystemExit("analyze: no programs found in the given "
+                         "targets")
+    if args.json:
+        print(_json.dumps(out, indent=1, default=float))
+    else:
+        for v in out["violations"]:
+            print(f"BUDGET VIOLATION: {v}")
+        print(f"analyze: {n_targets} program(s)"
+              + (f", {len(out['violations'])} budget violation(s)"
+                 if budgets is not None else "")
+              + (" — FAILED" if out["violations"] else ""))
+    return 1 if out["violations"] else 0
+
+
+def _print_program_report(rep):
+    roof = rep["roofline"]
+    print(f"== {rep['label']} ==")
+    line = (f"  flops {roof['est_flops'] / 1e9:.2f} G"
+            f"  traffic {roof['est_hbm_traffic_gb']} GB")
+    if "ai_flop_per_byte" in roof:
+        line += (f"  AI {roof['ai_flop_per_byte']} vs ridge "
+                 f"{roof['ridge_flop_per_byte']} flop/B "
+                 f"({roof['device']}) -> {roof['bound']}-bound")
+    print(line)
+    print(f"  est peak HBM {roof['est_peak_hbm_gb']} GB  "
+          f"(batch {roof['batch_size']} assumed, {roof['n_ops']} ops)")
+    if roof["unknown_ops"]:
+        print(f"  coverage: {roof['unknown_ops']} op(s) without cost "
+              f"metadata: {roof['unknown_types']}")
+    for axis, kinds in sorted(rep["comm"].items()):
+        detail = ", ".join(f"{k} {b / 1e6:.3f} MB"
+                           for k, b in sorted(kinds.items()))
+        print(f"  comm[{axis}]: {detail}")
+    if rep["top_traffic_ops"]:
+        tops = ", ".join(
+            f"{t['type']}@{t['block']}:{t['op']} "
+            f"({t['bytes'] / 1e6:.1f} MB, AI {t['ai']})"
+            for t in rep["top_traffic_ops"][:3])
+        print(f"  heaviest traffic: {tops}")
+    errors = [d for d in rep["diagnostics"] if d["severity"] == "error"]
+    for d in errors:
+        print(f"  [error] {d['pass']}: {d['message']}")
+
+
+def _print_generation_report(target, rep):
+    print(f"== {target} (generation model dir) ==")
+    m = rep["model"]
+    print(f"  d_model {m['d_model']}  layers {m['n_layers']}  vocab "
+          f"{m['vocab_size']}  kv_dtype {m['kv_dtype']}  slots "
+          f"{m['slots']}")
+    print(f"  params {rep['param_bytes'] / 1e6:.1f} MB  KV "
+          f"{rep['bytes_per_block'] / 1e3:.1f} kB/block")
+    for k in rep["kernels"]:
+        line = (f"  {k['kernel']}: {k['flops'] / 1e6:.2f} MFLOP, "
+                f"{k['bytes'] / 1e6:.2f} MB/tick")
+        if "ai_flop_per_byte" in k:
+            line += (f", AI {k['ai_flop_per_byte']} vs ridge "
+                     f"{k['ridge_flop_per_byte']} -> {k['bound']}-bound")
+        print(line)
 
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
-    subcommands = {"verify": cmd_verify, "metrics": cmd_metrics,
-                   "trace": cmd_trace, "serve": cmd_serve}
+    subcommands = {"verify": cmd_verify, "analyze": cmd_analyze,
+                   "metrics": cmd_metrics, "trace": cmd_trace,
+                   "serve": cmd_serve}
     if argv and argv[0] in subcommands:
         sys.exit(subcommands[argv[0]](argv[1:]))
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli",
         description="legacy `paddle train` workflow over Program/Executor"
         " (plus subcommands: `python -m paddle_tpu.cli "
-        "verify|metrics|trace|serve --help`)")
+        "verify|analyze|metrics|trace|serve --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

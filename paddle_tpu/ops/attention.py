@@ -16,7 +16,8 @@ from ..kernels import flash_attention as _flash
 
 @register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
              attrs={"causal": False, "scale": 1.0, "default_scale": True,
-                    "min_seq_k": -1})
+                    "min_seq_k": -1},
+             cost="attention")
 def flash_attention_op(ctx, ins, attrs):
     """Q/K/V: [batch, seq, heads, head_dim].  default_scale=True ->
     1/sqrt(head_dim); otherwise the explicit `scale` attr (0.0 included).

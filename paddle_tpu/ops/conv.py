@@ -32,7 +32,8 @@ def _pair(v, n=2):
 @register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",),
              attrs={"strides": [1, 1], "paddings": [0, 0],
                     "dilations": [1, 1], "groups": 1, "use_cudnn": True,
-                    "data_format": "NCHW"})
+                    "data_format": "NCHW"},
+             cost="conv")
 def conv2d(ctx, ins, attrs):
     """data_format "NHWC" keeps activations channels-last — the TPU's
     native conv layout (vector lanes = channels); weights stay OIHW at the

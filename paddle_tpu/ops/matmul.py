@@ -22,7 +22,8 @@ def _flatten2d(x, num_col_dims):
 
 
 @register_op("mul", inputs=("X", "Y"), outputs=("Out",),
-             attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+             attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+             cost="matmul")
 def mul(ctx, ins, attrs):
     xv = one(ins, "X")
     x = data_of(xv)
@@ -39,7 +40,8 @@ def mul(ctx, ins, attrs):
 
 @register_op("matmul", inputs=("X", "Y"), outputs=("Out",),
              attrs={"transpose_X": False, "transpose_Y": False,
-                    "alpha": 1.0})
+                    "alpha": 1.0},
+             cost="matmul")
 def matmul(ctx, ins, attrs):
     """Reference matmul_op.h semantics: 1-D operands get vector treatment;
     leading batch dims broadcast."""

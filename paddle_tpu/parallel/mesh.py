@@ -179,3 +179,58 @@ def count_collectives(hlo_text: str) -> dict:
         if n:
             out[op] = n
     return out
+
+
+# dtype token -> bytes/element for HLO result shapes (collective_bytes)
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_HLO_SHAPE_RE = r"(?:pred|[suf]\d+|bf16|c\d+)\[[\d,]*\]"
+
+
+def collective_bytes(hlo_text: str) -> dict:
+    """Payload BYTES of cross-device collective instructions in
+    optimized HLO text: per collective type, the summed element bytes of
+    every instruction's result shape(s) — tuple-shaped and async
+    (`-start`) forms included.  This is the measured side of the static
+    `analysis.cost_model.estimate_comm` volume (same logical-payload
+    convention: an all-reduce's result shape IS its operand shape)."""
+    import re
+
+    def shape_bytes(tok: str) -> int:
+        dtype, dims = tok.split("[", 1)
+        dims = dims.rstrip("]")
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        return n * _HLO_DTYPE_BYTES.get(dtype, 4)
+
+    out = {}
+    for op in ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all"):
+        total = 0
+        # `%name = <shape> op(` and `%name = (<shape>, <shape>) op(`
+        for m in re.finditer(
+                rf"=\s*(\(?(?:{_HLO_SHAPE_RE}(?:\{{[\d,]*\}})?"
+                rf"(?:,\s*)?)+\)?)\s*{op}((?:-start)?)\(", hlo_text):
+            toks = re.findall(_HLO_SHAPE_RE, m.group(1))
+            if m.group(2) and len(toks) > 1:
+                # async `-start` result is a tuple of (operand, result
+                # [, context scalars]) — the logical payload is the
+                # RESULT shape only (for all-reduce/permute operand and
+                # result are identical; summing both would double-count
+                # vs the sync form).  Drop scalar context tokens (the
+                # u32[] pair some backends append to permute-start)
+                # BEFORE picking the result, or the payload reads as
+                # 4 bytes
+                tensors = [t for t in toks if "[]" not in t]
+                toks = (tensors or toks)[-1:]
+            for tok in toks:
+                total += shape_bytes(tok)
+        if total:
+            out[op] = total
+    return out

@@ -20,6 +20,19 @@ it checks against, so this lint enforces at the SOURCE level:
      observability registry/exporters (docs/observability.md) so
      production processes (pservers, serving workers) stay scrape-able
      instead of spraying stdout.
+  4. no blocking socket `send*`/`recv*` call (raw socket methods OR the
+     pserver wire helpers `_send_frame`/`_recv_frame`/`_read_exact`/
+     `_sendall_parts`) inside a `with <lock>:` body in
+     `paddle_tpu/parallel`, `paddle_tpu/cloud`, or `paddle_tpu/serving`
+     — a peer that stalls mid-frame then holds the lock for the
+     socket-timeout duration and every other thread (the serving
+     scheduler, the controller watch loop) convoys behind it; the PR 7/8
+     reviews repeatedly moved IO outside locks for exactly this.
+     Allowlist for the per-endpoint worker pattern (one worker thread
+     owns one socket and a PER-CONNECTION lock only serializes access
+     to that one endpoint): a `with` statement over a lock whose name
+     matches `*conn_lock`/`*ep_lock`/`*endpoint_lock`, or an explicit
+     `# lint: send-under-lock-ok` comment on the `with` line.
 
 Run: `python tools/lint.py [paths...]` (default: the paddle_tpu
 package).  Exits non-zero listing `file:line: message` per violation.
@@ -46,6 +59,25 @@ SILENT_EXCEPT_DIRS = (CORE_DIR,
 # processes (core + the pserver/parallel machinery)
 NO_PRINT_DIRS = (CORE_DIR, os.path.join(REPO_ROOT, "paddle_tpu",
                                         "parallel"))
+
+# rule 4 scope: every layer that mixes threading locks with sockets
+LOCKED_IO_DIRS = tuple(
+    os.path.join(REPO_ROOT, "paddle_tpu", d)
+    for d in ("parallel", "cloud", "serving"))
+
+# rule 4: blocking wire calls — raw socket methods plus this repo's
+# pserver frame helpers (parallel/pserver.py); calling any of these with
+# a lock held convoys every other thread behind one slow peer
+BLOCKING_IO_CALLS = frozenset(
+    "send sendall sendmsg sendto recv recv_into recvfrom recvmsg "
+    "_send_frame _send_frame_parts _recv_frame _read_exact "
+    "_sendall_parts".split())
+
+# rule 4 allowlist: per-connection locks of the per-endpoint worker
+# pattern (one thread owns one socket; the lock serializes only that
+# endpoint, so a slow peer cannot convoy unrelated work)
+_PER_ENDPOINT_LOCK = ("conn_lock", "ep_lock", "endpoint_lock")
+_ALLOW_COMMENT = "lint: send-under-lock-ok"
 
 
 def _is_register_op_call(node: ast.Call) -> bool:
@@ -105,6 +137,79 @@ def check_no_prints(tree: ast.AST, path: str):
                    "scrape-able")
 
 
+def _lock_names(expr: ast.AST):
+    """Identifier-ish names mentioned in a with-item's context expr."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            yield node.attr
+        elif isinstance(node, ast.Name):
+            yield node.id
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    # token-wise match: `_cond` / `view_lock` are locks, but a name
+    # merely CONTAINING the letters (`seconds`, `blockers`) is not
+    import re as _re
+
+    for n in _lock_names(expr):
+        parts = [p for p in _re.split(r"[^a-z]+", n.lower()) if p]
+        if any(p in ("lock", "cond", "cv", "mutex") for p in parts):
+            return True
+        if n.lower().endswith(("lock", "cond")):
+            return True
+    return False
+
+
+def _is_allowed_lock(expr: ast.AST) -> bool:
+    return any(n.lower().endswith(_PER_ENDPOINT_LOCK)
+               for n in _lock_names(expr))
+
+
+def _walk_executed(node: ast.AST):
+    """ast.walk, but not into nested def/lambda bodies — code merely
+    DEFINED under the lock runs later, after release."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def check_locked_io(tree: ast.AST, path: str, source_lines):
+    """Rule 4 (parallel/cloud/serving): no blocking socket send*/recv*
+    (or pserver frame helper) call while holding a lock."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        lockish = [i for i in node.items if _is_lock_expr(i.context_expr)]
+        if not lockish:
+            continue
+        if any(_is_allowed_lock(i.context_expr) for i in lockish):
+            continue  # per-endpoint worker pattern
+        line = ""
+        if 0 < node.lineno <= len(source_lines):
+            line = source_lines[node.lineno - 1]
+        if _ALLOW_COMMENT in line:
+            continue
+        for inner in _walk_executed(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            f = inner.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else "")
+            if name in BLOCKING_IO_CALLS:
+                yield (path, inner.lineno,
+                       f"blocking wire call {name}() inside the "
+                       f"`with` lock at line {node.lineno} — a stalled "
+                       "peer holds the lock for the socket timeout and "
+                       "every other thread convoys; move the IO outside "
+                       "the lock (snapshot under it, send after), use a "
+                       "per-endpoint `*_conn_lock`, or annotate the "
+                       f"with-line `# {_ALLOW_COMMENT}` with a reason")
+
+
 def iter_py_files(paths):
     for p in paths:
         if os.path.isfile(p):
@@ -122,7 +227,8 @@ def lint(paths) -> int:
     for path in iter_py_files(paths):
         try:
             with open(path) as f:
-                tree = ast.parse(f.read(), filename=path)
+                source = f.read()
+            tree = ast.parse(source, filename=path)
         except SyntaxError as e:
             violations.append((path, e.lineno or 0,
                                f"syntax error: {e.msg}"))
@@ -134,6 +240,9 @@ def lint(paths) -> int:
             violations.extend(check_silent_excepts(tree, path))
         if any(abspath.startswith(d + os.sep) for d in NO_PRINT_DIRS):
             violations.extend(check_no_prints(tree, path))
+        if any(abspath.startswith(d + os.sep) for d in LOCKED_IO_DIRS):
+            violations.extend(
+                check_locked_io(tree, path, source.splitlines()))
     for path, line, msg in sorted(violations):
         rel = os.path.relpath(path, REPO_ROOT)
         print(f"{rel}:{line}: {msg}")
