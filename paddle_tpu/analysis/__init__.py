@@ -42,6 +42,8 @@ from .registry import (  # noqa: F401
 )
 from . import passes as _builtin_passes  # noqa: F401  (registers built-ins)
 from . import cost_model  # noqa: F401  (registers cost/comm passes)
+from . import concurrency  # noqa: F401  (AST concurrency analyzer)
+from . import schedcheck  # noqa: F401  (deterministic-schedule checker)
 from .cost_model import (  # noqa: F401
     CommEstimate,
     OpCost,
@@ -80,6 +82,8 @@ __all__ = [
     "analyze_generation_spec",
     "serving_kernel_cost",
     "check_budget",
+    "concurrency",
+    "schedcheck",
 ]
 
 

@@ -53,27 +53,38 @@ class Diagnostic:
     op_type: Optional[str] = None
     op_repr: str = ""
     hint: str = ""
+    # source-level location (the concurrency analyzer locates findings
+    # in repo files, not Program blocks); when `file` is set it wins
+    # over the block/op rendering
+    file: Optional[str] = None
+    line: Optional[int] = None
 
     def __post_init__(self):
         severity_rank(self.severity)  # validate
 
     def to_dict(self) -> dict:
-        """Machine-readable form (cli verify/analyze --json): severity +
-        pass id, a structured location, the message, and the fix hint —
-        stable keys for CI annotations and editor integrations."""
+        """Machine-readable form (cli verify/analyze/concurrency
+        --json): severity + pass id, a structured location, the
+        message, and the fix hint — stable keys for CI annotations and
+        editor integrations."""
+        loc: dict = {
+            "block": self.block_idx,
+            "op": self.op_idx,
+            "op_type": self.op_type,
+        }
+        if self.file is not None:
+            loc = {"file": self.file, "line": self.line}
         return {
             "pass": self.pass_id,
             "severity": self.severity,
             "message": self.message,
-            "location": {
-                "block": self.block_idx,
-                "op": self.op_idx,
-                "op_type": self.op_type,
-            },
+            "location": loc,
             "hint": self.hint or None,
         }
 
     def location(self) -> str:
+        if self.file is not None:
+            return f"{self.file}:{self.line}"
         loc = f"block {self.block_idx}"
         if self.op_idx is not None:
             loc += f" op {self.op_idx}"

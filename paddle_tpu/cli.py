@@ -757,18 +757,128 @@ def _print_generation_report(target, rep):
         print(line)
 
 
+# ---------------------------------------------------------------------------
+# `concurrency` subcommand: lock-order/race lint + schedule checking
+# ---------------------------------------------------------------------------
+
+
+def cmd_concurrency(argv):
+    """`python -m paddle_tpu.cli concurrency [PATHS...] [--json]
+    [--sched] [--rules r1,r2]` — the whole-repo AST concurrency
+    analyzer (docs/analysis.md "Concurrency analysis"): lock inventory,
+    lock-order cycles, blocking-calls-under-lock, RacerD-style
+    unguarded-attribute races, thread hygiene.  Exit non-zero on any
+    UNSUPPRESSED error-severity finding (`# lint: <rule>-ok` comments
+    demote to info).
+
+    `--sched` additionally runs the fast deterministic-schedule-checker
+    protocol subset (analysis/schedmodels.py): FENCE->MIGRATE->COMMIT,
+    elastic_round replay, GenerationServer admit/finish/swap over the
+    real PagedKVCache, and CommPool.send_round ordering — each must
+    hold its invariant over every explored interleaving."""
+    import json
+
+    from paddle_tpu.analysis import concurrency as conc
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli concurrency",
+        description="AST concurrency lint + schedule checking "
+        "(docs/analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the whole "
+                    "paddle_tpu package)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document (shares the verify "
+                    "--json diagnostics shape)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset "
+                    f"(default: all of {', '.join(conc.RULES)})")
+    ap.add_argument("--sched", action="store_true",
+                    help="also run the schedule-checker protocol "
+                    "models (a few seconds)")
+    ap.add_argument("--sched-schedules", type=int, default=120,
+                    help="bounded-DFS schedule budget per protocol")
+    ap.add_argument("--show", default="warning",
+                    choices=["error", "warning", "info"],
+                    help="minimum severity to print (human mode)")
+    args = ap.parse_args(argv)
+
+    rules = [r for r in args.rules.split(",") if r] or None
+    if rules:
+        unknown = sorted(set(rules) - set(conc.RULES))
+        if unknown:
+            # a typo'd rule must not silently verify nothing
+            raise SystemExit(
+                f"concurrency: unknown rule(s) {unknown}; "
+                f"valid: {', '.join(conc.RULES)}")
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not silently verify nothing either
+        raise SystemExit(f"concurrency: no such path(s): {missing}")
+    findings = conc.analyze_paths(args.paths or None, rules=rules)
+    errors = [f for f in findings if f.severity == "error"]
+
+    sched_results = []
+    if args.sched:
+        from paddle_tpu.analysis import schedcheck, schedmodels
+
+        for name, (factory, inv) in schedmodels.PROTOCOLS.items():
+            res = schedcheck.explore(
+                factory(), inv,
+                max_schedules=args.sched_schedules,
+                random_schedules=30)
+            sched_results.append(
+                {"protocol": name, "schedules": res.schedules,
+                 "ok": res.ok,
+                 "violation": (str(res.violation)
+                               if res.violation else None)})
+
+    failed = bool(errors) or any(not r["ok"] for r in sched_results)
+    if args.json:
+        from paddle_tpu.analysis.concurrency import to_diagnostics
+
+        print(json.dumps({
+            "failed": failed,
+            "summary": conc.summarize(findings),
+            "diagnostics": [d.to_dict()
+                            for d in to_diagnostics(findings)],
+            "schedcheck": sched_results,
+        }, indent=1))
+        return 1 if failed else 0
+
+    order = {"error": 0, "warning": 1, "info": 2}
+    shown = [f for f in findings
+             if order[f.severity] <= order[args.show]]
+    for f in sorted(shown, key=lambda f: (order[f.severity], f.file,
+                                          f.line)):
+        print(f)
+        if f.hint:
+            print(f"    hint: {f.hint}")
+    for r in sched_results:
+        status = "ok" if r["ok"] else "FAIL"
+        print(f"schedcheck {r['protocol']}: [{status}] "
+              f"{r['schedules']} schedule(s) explored")
+        if r["violation"]:
+            print(f"    {r['violation']}")
+    print(f"concurrency: {conc.summarize(findings)}"
+          + (f"; {len(sched_results)} protocol(s) schedule-checked"
+             if sched_results else "")
+          + (" — FAILED" if failed else ""))
+    return 1 if failed else 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     subcommands = {"verify": cmd_verify, "analyze": cmd_analyze,
                    "metrics": cmd_metrics, "trace": cmd_trace,
-                   "serve": cmd_serve}
+                   "serve": cmd_serve, "concurrency": cmd_concurrency}
     if argv and argv[0] in subcommands:
         sys.exit(subcommands[argv[0]](argv[1:]))
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli",
         description="legacy `paddle train` workflow over Program/Executor"
         " (plus subcommands: `python -m paddle_tpu.cli "
-        "verify|analyze|metrics|trace|serve --help`)")
+        "verify|analyze|concurrency|metrics|trace|serve --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

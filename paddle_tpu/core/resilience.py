@@ -68,6 +68,7 @@ __all__ = [
     "FaultRule",
     "FaultError",
     "fault_injector",
+    "sched_fault_armed",
 ]
 
 
@@ -420,3 +421,16 @@ def fault_injector() -> FaultInjector:
                 inj.load_env()
                 _INJECTOR = inj
     return _INJECTOR
+
+
+def sched_fault_armed(name: str) -> bool:
+    """Schedule-checker regression-pin hook: True only inside a test
+    that reintroduces a historical race via
+    analysis.schedcheck.arm_fault (docs/analysis.md "Schedule
+    checking").  Guarded lazy import so runtime modules (pserver,
+    serving) never pay for — or cycle on — the analysis package."""
+    try:
+        from ..analysis.schedcheck import fault_armed
+    except Exception:
+        return False
+    return fault_armed(name)

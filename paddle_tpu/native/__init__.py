@@ -54,6 +54,9 @@ def lib() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if _needs_build():
+            # lint: blocking-under-lock-ok — the subprocess IS the
+            # critical section: one first-caller compiles the .so while
+            # every other thread must wait for exactly that build
             _build()
         l = ctypes.CDLL(_SO)
         _declare(l)

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import LoDTensor, SelectedRows
-from ..core.resilience import RetryPolicy, fault_injector
+from ..core.resilience import (RetryPolicy, fault_injector,
+                               sched_fault_armed as _sched_fault)
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 
@@ -520,10 +521,11 @@ class VariableServer:
                 # window where a stopped server accepts (and serves!)
                 # one more connection — fatal for crash simulations and
                 # wrong for real shutdown
-                try:
-                    self._sock.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
+                if not _sched_fault("pserver.accept-stop-race"):
+                    try:
+                        self._sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
                 self._sock.close()
         except OSError:
             pass
@@ -537,8 +539,13 @@ class VariableServer:
                 conn, addr = self._sock.accept()
             except OSError:
                 return
-            if self._stopping:
-                # accept raced stop(): a dead server must not answer
+            if self._stopping and not _sched_fault(
+                    "pserver.accept-stop-race"):
+                # accept raced stop(): a dead server must not answer.
+                # (The _sched_fault toggle reintroduces the pre-PR-7
+                # bug for the schedule checker's regression pin —
+                # tests/test_concurrency_analysis.py; always False
+                # otherwise.)
                 try:
                     conn.close()
                 except OSError:

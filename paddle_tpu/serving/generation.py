@@ -68,7 +68,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core.resilience import fault_injector
+from ..core.resilience import (fault_injector,
+                               sched_fault_armed as _sched_fault)
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from .batching import RequestDeadlineExceeded, ServerSaturated
@@ -181,6 +182,13 @@ class GenerationStream:
             return list(self._tokens)
 
     def __iter__(self):
+        if _sched_fault("stream.yield-under-lock"):
+            # the pre-PR-8 bug, reintroducible ONLY for the schedule
+            # checker's regression pin (tests/test_concurrency_
+            # analysis.py): yielding with the lock held lets a slow
+            # consumer stall the scheduler's _put
+            yield from self._iter_yield_under_lock()
+            return
         i = 0
         with self._cond:
             self._watchers += 1
@@ -205,6 +213,24 @@ class GenerationStream:
                     return
         finally:
             with self._cond:
+                self._watchers -= 1
+
+    def _iter_yield_under_lock(self):
+        i = 0
+        with self._cond:
+            self._watchers += 1
+            try:
+                while True:
+                    self._cond.wait_for(
+                        lambda: self._done or len(self._tokens) > i)
+                    while i < len(self._tokens):
+                        yield self._tokens[i]   # lock HELD across yield
+                        i += 1
+                    if self._done:
+                        if self._exc is not None:
+                            raise self._exc
+                        return
+            finally:
                 self._watchers -= 1
 
     def result(self, timeout: Optional[float] = None) -> List[int]:

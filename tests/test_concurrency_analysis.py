@@ -1,0 +1,885 @@
+"""Concurrency analyzer + deterministic-schedule checker (ISSUE 13):
+
+* golden diagnostics for every AST rule on seeded-bug fixtures, plus a
+  clean negative fixture per rule (lock-order cycle, blocking calls
+  under a lock incl. the rule-4 socket family and its allowlists,
+  RacerD-style unguarded attributes, thread hygiene);
+* the suppression convention (`# lint: <rule>-ok`) demotes to info;
+* repo-wide cleanliness: zero unsuppressed error findings;
+* schedcheck core: a classic AB/BA deadlock and a lost-wakeup are
+  FOUND within the bounded exploration, clean variants pass, and a
+  violation's trace replays deterministically;
+* the four protocol models (fence/migrate/commit, elastic_round,
+  generation admit/finish/swap over the REAL PagedKVCache,
+  CommPool.send_round ordering) hold their invariants at HEAD and
+  their `buggy=True` variants are caught;
+* regression pins: the PR 7 VariableServer accept-vs-stop race and the
+  PR 8 GenerationStream slow-consumer stall — the REAL code passes at
+  HEAD and fails deterministically when the historical bug is
+  reintroduced via schedcheck.arm_fault;
+* `cli concurrency` (human + --json) and the tools/lint.py rule-4
+  delegation.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import concurrency as conc
+from paddle_tpu.analysis import schedcheck as sched
+from paddle_tpu.analysis import schedmodels
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _findings(src, rules=None):
+    return conc.analyze_source(src, "fixture.py", rules=rules)
+
+
+def _by_rule(findings, rule, severity=None):
+    return [f for f in findings if f.rule == rule
+            and (severity is None or f.severity == severity)]
+
+
+# ---------------------------------------------------------------------------
+# rule goldens: seeded bug + clean negative per rule
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_is_error():
+    src = """
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+    def m2(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    errs = _by_rule(_findings(src), "lock-order", "error")
+    assert len(errs) == 1
+    assert "A._a" in errs[0].message and "A._b" in errs[0].message
+    assert "deadlock" in errs[0].message
+
+
+def test_lock_order_consistent_is_clean():
+    src = """
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+    def m2(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    assert _by_rule(_findings(src), "lock-order") == []
+
+
+def test_lock_order_cycle_through_call_chain():
+    """The acquisition-order graph follows intra-class calls: m2
+    acquires _a indirectly through helper()."""
+    src = """
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def helper(self):
+        with self._a:
+            pass
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+    def m2(self):
+        with self._b:
+            self.helper()
+"""
+    errs = _by_rule(_findings(src), "lock-order", "error")
+    assert len(errs) == 1, errs
+
+
+def test_nested_reacquire_of_plain_lock_is_error():
+    src = """
+import threading
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def m(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+    errs = _by_rule(_findings(src), "lock-order", "error")
+    assert len(errs) == 1 and "self-deadlock" in errs[0].message
+    # an RLock may re-enter
+    assert _by_rule(_findings(src.replace("Lock()", "RLock()")),
+                    "lock-order") == []
+
+
+def test_blocking_under_lock_goldens():
+    """Every generalized blocking family fires: socket (rule 4), a
+    known thread's join, a known queue's blocking get, time.sleep,
+    subprocess, and a condition wait while ANOTHER lock is held."""
+    src = """
+import threading, time, queue, subprocess
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+    def _run(self): pass
+    def bad_socket(self, sock):
+        with self._lock:
+            sock.sendall(b"x")
+    def bad_join(self):
+        with self._lock:
+            self._worker.join()
+    def bad_queue(self):
+        with self._lock:
+            self._q.get()
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(1)
+    def bad_sub(self):
+        with self._lock:
+            subprocess.run(["ls"])
+    def bad_wait(self):
+        with self._lock:
+            with self._cond:
+                self._cond.wait()
+"""
+    errs = _by_rule(_findings(src), "blocking-under-lock", "error")
+    kinds = sorted(e.message.split("blocking ")[1].split()[0]
+                   for e in errs)
+    assert kinds == ["join", "queue", "sleep", "socket",
+                     "subprocess", "wait"], kinds
+
+
+def test_blocking_under_lock_negatives():
+    """The disciplined variants stay clean: IO outside the lock,
+    nonblocking queue ops, waiting on the ONE condition you hold, the
+    per-endpoint `*_conn_lock` allowlist, and nested-def bodies that
+    merely CLOSE OVER the lock scope."""
+    src = """
+import threading, queue
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._conn_lock = threading.Lock()
+        self._q = queue.Queue()
+    def io_outside(self, sock, data):
+        with self._lock:
+            payload = bytes(data)
+        sock.sendall(payload)
+    def nonblocking(self):
+        with self._lock:
+            self._q.get(block=False)
+            self._q.put(1, timeout=0.1)
+    def proper_wait(self):
+        with self._cond:
+            self._cond.wait()
+            self._cond.wait_for(lambda: True)
+    def per_endpoint(self, sock, data):
+        with self._conn_lock:
+            sock.sendall(data)
+    def deferred(self, sock):
+        with self._lock:
+            self._flush = lambda: sock.sendall(b"x")
+            def later():
+                return sock.recv(4)
+            self._later = later
+"""
+    assert _by_rule(_findings(src), "blocking-under-lock", "error") == []
+
+
+def test_defining_blocking_callback_under_lock_is_clean():
+    """A factory that merely DEFINES a blocking callback must not read
+    as a blocking helper: the callback body runs later, unlocked."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def make_cb(self, sock):
+        def cb():
+            return sock.recv(1024)
+        return cb
+    def register(self, sock):
+        with self._lock:
+            self._cb = self.make_cb(sock)
+"""
+    assert _by_rule(_findings(src), "blocking-under-lock") == []
+
+
+def test_analyze_file_syntax_error_finding(tmp_path):
+    """An unanalyzable file is an error under `syntax-error`, never
+    filtered out by a rules subset."""
+    f = tmp_path / "broken.py"
+    f.write_text("def broken(:\n")
+    fs = conc.analyze_file(str(f), rules=["thread-join"])
+    assert len(fs) == 1 and fs[0].rule == "syntax-error" \
+        and fs[0].severity == "error", fs
+
+
+def test_transitive_blocking_is_warning():
+    src = """
+import threading, subprocess
+_lock = threading.Lock()
+def _build():
+    subprocess.run(["make"])
+def lib():
+    with _lock:
+        _build()
+"""
+    warns = _by_rule(_findings(src), "blocking-under-lock", "warning")
+    assert len(warns) == 1 and "_build" in warns[0].message
+
+
+def test_unguarded_attr_race_and_negatives():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._stop = False
+        self._t = threading.Thread(target=self._work, daemon=True)
+        self._t.start()
+    def _work(self):
+        self._n += 1
+        if self._stop:
+            return
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def close(self):
+        with self._lock:
+            self._stop = True
+"""
+    fs = _findings(src)
+    warns = _by_rule(fs, "unguarded-attr", "warning")
+    assert len(warns) == 1, warns
+    assert "C._n" in warns[0].message
+    # the bool flag read demotes to info (atomic store, idiomatic)
+    infos = _by_rule(fs, "unguarded-attr", "info")
+    assert any("_stop" in f.message for f in infos)
+    assert not any("_stop" in f.message for f in warns)
+
+
+def test_unguarded_attr_clean_patterns():
+    """Clean: always-locked access, `*_locked` helper convention
+    (caller holds the lock), and init-only warmup methods
+    (pre-publication)."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._warmup()
+        self._t = threading.Thread(target=self._work, daemon=True)
+        self._t.start()
+    def _warmup(self):
+        self._n = -1
+    def _work(self):
+        with self._lock:
+            self._bump_locked()
+    def _bump_locked(self):
+        self._n += 1
+    def bump(self):
+        with self._lock:
+            self._n += 1
+"""
+    assert _by_rule(_findings(src), "unguarded-attr") == []
+
+
+def test_thread_hygiene_goldens():
+    src = """
+import threading
+class D:
+    def __init__(self):
+        self._t = threading.Thread(target=self._work)
+        self._t.start()
+        self.config = {"a": 1}
+    def _work(self):
+        return self.config
+"""
+    fs = _findings(src)
+    assert len(_by_rule(fs, "thread-join", "error")) == 1
+    order = _by_rule(fs, "thread-start-order", "error")
+    assert len(order) == 1 and "self.config" in order[0].message
+
+
+def test_thread_hygiene_negatives():
+    """daemon=True, joined non-daemon threads, and state assigned
+    before start() are all clean."""
+    src = """
+import threading
+class D:
+    def __init__(self):
+        self.config = {"a": 1}
+        self._d = threading.Thread(target=self._work, daemon=True)
+        self._d.start()
+        self._j = threading.Thread(target=self._work)
+        self._j.start()
+    def _work(self):
+        return self.config
+    def close(self):
+        self._j.join(timeout=5)
+"""
+    fs = _findings(src)
+    assert _by_rule(fs, "thread-join") == []
+    assert _by_rule(fs, "thread-start-order") == []
+
+
+def test_suppression_comment_demotes_to_info():
+    src = """
+import threading, time
+_lock = threading.Lock()
+def f():
+    with _lock:   # lint: blocking-under-lock-ok — startup only
+        time.sleep(1)
+def g():
+    with _lock:
+        # lint: blocking-under-lock-ok — comment-line form
+        time.sleep(1)
+"""
+    fs = _by_rule(_findings(src), "blocking-under-lock")
+    assert len(fs) == 2
+    assert all(f.severity == "info" and f.suppressed for f in fs)
+
+
+def test_legacy_send_under_lock_alias_still_honored():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def f(self, sock, data):
+        with self._lock:  # lint: send-under-lock-ok (single owner)
+            sock.sendall(data)
+"""
+    fs = _by_rule(_findings(src), "blocking-under-lock")
+    assert len(fs) == 1 and fs[0].suppressed
+
+
+def test_local_locks_are_scoped_per_function():
+    """Same-named LOCAL locks in different functions are different
+    objects: opposite nesting orders across functions must not forge a
+    lock-order cycle."""
+    src = """
+def f(a_lock, b_lock):
+    with a_lock:
+        with b_lock:
+            pass
+def g(a_lock, b_lock):
+    with b_lock:
+        with a_lock:
+            pass
+"""
+    assert _by_rule(_findings(src), "lock-order", "error") == []
+    # ...but within ONE function the objects are the same: still flagged
+    src_one = """
+def f(a_lock, b_lock, flip):
+    if flip:
+        with a_lock:
+            with b_lock:
+                pass
+    else:
+        with b_lock:
+            with a_lock:
+                pass
+"""
+    assert len(_by_rule(_findings(src_one), "lock-order",
+                        "error")) == 1
+
+
+def test_container_mutation_counts_as_write():
+    """`self._m[k] = v` under a lock + a bare read in a thread target
+    is the same race as a plain attribute write."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._m = {}
+        self._t = threading.Thread(target=self._work, daemon=True)
+        self._t.start()
+    def _work(self):
+        return self._m["k"]
+    def put(self, v):
+        with self._lock:
+            self._m["k"] = v
+"""
+    warns = _by_rule(_findings(src), "unguarded-attr", "warning")
+    assert len(warns) == 1 and "C._m" in warns[0].message, warns
+
+
+def test_with_item_context_expr_calls_are_analyzed():
+    """A blocking helper called INSIDE a with-item expression (while an
+    outer lock is held) is not invisible."""
+    src = """
+import threading, subprocess
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def _conn(self):
+        subprocess.run(["ssh"])
+        return open("/dev/null")
+    def f(self):
+        with self._lock:
+            with self._conn():
+                pass
+"""
+    warns = _by_rule(_findings(src), "blocking-under-lock", "warning")
+    assert len(warns) == 1 and "_conn" in warns[0].message, warns
+
+
+def test_cli_concurrency_rejects_unknown_rule(tmp_path):
+    from paddle_tpu.cli import cmd_concurrency
+
+    f = tmp_path / "m.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(SystemExit, match="unknown rule"):
+        cmd_concurrency([str(f), "--rules", "lock_order"])
+
+
+def test_cli_concurrency_rejects_missing_path(tmp_path):
+    """A typo'd path must not read as a clean verification."""
+    from paddle_tpu.cli import cmd_concurrency
+
+    with pytest.raises(SystemExit, match="no such path"):
+        cmd_concurrency([str(tmp_path / "nope.py")])
+
+
+def test_explicit_acquire_release_contributes_ordering_edges():
+    """Manually-managed locks (x.acquire()/x.release()) feed the same
+    lock-order graph as `with` statements."""
+    src = """
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def m1(self):
+        self._a.acquire()
+        with self._b:
+            pass
+        self._a.release()
+    def m2(self):
+        with self._b:
+            self._a.acquire()
+            self._a.release()
+"""
+    errs = _by_rule(_findings(src), "lock-order", "error")
+    assert len(errs) == 1 and "A._a" in errs[0].message, errs
+
+
+def test_queue_timeout_none_is_still_blocking():
+    """`q.get(timeout=None)` is the infinite default spelled out —
+    only a BOUNDED timeout exempts the call."""
+    src = """
+import threading, queue
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+    def bad(self):
+        with self._lock:
+            self._q.get(timeout=None)
+    def ok(self):
+        with self._lock:
+            self._q.get(timeout=0.5)
+"""
+    errs = _by_rule(_findings(src), "blocking-under-lock", "error")
+    assert len(errs) == 1 and "timeout" not in errs[0].message \
+        and errs[0].line == 9, errs
+
+
+def test_queue_put_positional_block_flag_position():
+    """Queue.put's first positional is the ITEM; its block flag is the
+    second — `q.put(item, False)` is non-blocking while `q.put(False)`
+    is a blocking put of the value False."""
+    src = """
+import threading, queue
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+    def ok(self, item):
+        with self._lock:
+            self._q.put(item, False)
+    def bad(self):
+        with self._lock:
+            self._q.put(False)
+"""
+    errs = _by_rule(_findings(src), "blocking-under-lock", "error")
+    assert len(errs) == 1 and errs[0].line == 12, errs
+
+
+def test_repo_is_clean_of_unsuppressed_errors():
+    """The acceptance gate: a repo-wide run reports ZERO unsuppressed
+    error-severity findings (fixes/allowlists landed with the
+    analyzer)."""
+    findings = conc.analyze_paths()
+    errs = [f for f in findings if f.severity == "error"]
+    assert errs == [], "\n".join(str(f) for f in errs)
+
+
+def test_findings_render_as_diagnostics_with_source_location():
+    src = """
+import threading, time
+_lock = threading.Lock()
+def f():
+    with _lock:
+        time.sleep(1)
+"""
+    fs = _findings(src)
+    diags = conc.to_diagnostics(fs)
+    assert diags and diags[0].pass_id.startswith("concurrency/")
+    d = diags[0].to_dict()
+    assert d["location"]["file"].endswith("fixture.py")
+    assert isinstance(d["location"]["line"], int)
+    assert "fixture.py" in diags[0].location()
+
+
+# ---------------------------------------------------------------------------
+# schedcheck core
+# ---------------------------------------------------------------------------
+
+
+def _abba(consistent):
+    def model():
+        a, b = threading.Lock(), threading.Lock()
+        out = []
+
+        def t1():
+            with a:
+                with b:
+                    out.append(1)
+
+        def t2():
+            first, second = (a, b) if consistent else (b, a)
+            with first:
+                with second:
+                    out.append(2)
+
+        x = threading.Thread(target=t1)
+        y = threading.Thread(target=t2)
+        x.start()
+        y.start()
+        x.join()
+        y.join()
+        return out
+
+    return model
+
+
+def test_schedcheck_finds_abba_deadlock():
+    res = sched.explore(_abba(consistent=False), max_schedules=100)
+    assert res.violation is not None
+    assert "deadlock" in str(res.violation)
+
+
+def test_schedcheck_consistent_order_is_clean():
+    res = sched.explore(_abba(consistent=True), max_schedules=100,
+                        random_schedules=20)
+    assert res.ok, res.violation
+
+
+def test_schedcheck_replay_is_deterministic():
+    res = sched.explore(_abba(consistent=False), max_schedules=100)
+    trace = res.violation.trace
+    for _ in range(3):
+        replay = sched.run_schedule(_abba(consistent=False),
+                                    prefix=trace)
+        assert replay.deadlock is not None
+
+
+def test_schedcheck_check_raises():
+    with pytest.raises(sched.ScheduleViolation):
+        sched.check(_abba(consistent=False), max_schedules=100)
+
+
+# ---------------------------------------------------------------------------
+# protocol models: clean at HEAD, buggy variants caught
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", sorted(schedmodels.PROTOCOLS))
+def test_protocol_model_holds_at_head(protocol):
+    factory, invariant = schedmodels.PROTOCOLS[protocol]
+    res = sched.explore(factory(), invariant, max_schedules=120,
+                        random_schedules=30)
+    assert res.ok, f"{protocol}: {res.violation}"
+
+
+@pytest.mark.parametrize("protocol", sorted(schedmodels.PROTOCOLS))
+def test_protocol_model_buggy_variant_is_caught(protocol):
+    factory, invariant = schedmodels.PROTOCOLS[protocol]
+    res = sched.explore(factory(buggy=True), invariant,
+                        max_schedules=120, random_schedules=30)
+    assert res.violation is not None, \
+        f"{protocol}: seeded bug not found"
+
+
+# ---------------------------------------------------------------------------
+# regression pins: previously hand-fixed races, re-runnable forever
+# ---------------------------------------------------------------------------
+
+
+def _stream_model():
+    """PR 8: a slow consumer iterating a GenerationStream must never
+    block the scheduler's _put.  The consumer parks on an Event only
+    the producer's LATER progress sets — with the historical
+    yield-under-lock bug, the parked consumer holds the stream lock
+    and the producer deadlocks against it."""
+    from paddle_tpu.serving.generation import GenerationStream
+
+    stream = GenerationStream([1], 3)
+    resume = threading.Event()
+    got = []
+
+    def producer():
+        stream._put(0)
+        stream._put(1)
+        resume.set()
+        stream._put(2)
+        stream._finish()
+
+    def consumer():
+        for tok in stream:
+            got.append(tok)
+            resume.wait()
+
+    p = threading.Thread(target=producer)
+    c = threading.Thread(target=consumer)
+    p.start()
+    c.start()
+    p.join()
+    c.join()
+    return got
+
+
+def _stream_invariant(got):
+    assert got == [0, 1, 2], got
+
+
+def test_pin_generation_stream_slow_consumer_head():
+    res = sched.explore(_stream_model, _stream_invariant,
+                        max_schedules=150, random_schedules=20)
+    assert res.ok, res.violation
+
+
+def test_pin_generation_stream_slow_consumer_bug_reintroduced():
+    with sched.arm_fault("stream.yield-under-lock"):
+        res = sched.explore(_stream_model, _stream_invariant,
+                            max_schedules=150, random_schedules=20)
+    assert res.violation is not None, \
+        "yield-under-lock stall not found"
+    assert "deadlock" in str(res.violation)
+
+
+class _FakeConn:
+    """Scripted connection: feeds one HELLO frame, records replies."""
+
+    def __init__(self, frames: bytes):
+        self._buf = bytearray(frames)
+        self.replied = False
+        self.accepted_stopping = False
+
+    def recv_into(self, view):
+        sched.yield_point("conn-recv")
+        if not self._buf:
+            return 0   # peer closed -> graceful ConnectionError
+        n = min(len(view), len(self._buf))
+        view[:n] = self._buf[:n]
+        del self._buf[:n]
+        return n
+
+    def sendall(self, data):
+        sched.yield_point("conn-send")
+        if data:
+            self.replied = True
+
+    def close(self):
+        pass
+
+
+class _FakeListenSocket:
+    """accept() semantics under the schedule checker: shutdown() aborts
+    a blocked accept immediately; close() ALONE leaves a backlogged
+    connection acceptable — the kernel grace window the PR 7 fix's
+    shutdown-before-close exists for."""
+
+    def __init__(self, stopping_getter):
+        self._cond = threading.Condition()
+        self._pending = []
+        self._closed = False
+        self._shut = False
+        self._stopping = stopping_getter
+
+    def deliver(self, conn):
+        with self._cond:
+            self._pending.append(conn)
+            self._cond.notify_all()
+
+    def accept(self):
+        with self._cond:
+            while not (self._pending or self._closed or self._shut):
+                self._cond.wait()
+            if self._shut:
+                raise OSError("accept aborted by shutdown")
+            if self._pending:
+                conn = self._pending.pop(0)
+                conn.accepted_stopping = self._stopping()
+                return conn, ("127.0.0.1", 0)
+            raise OSError("socket closed")
+
+    def shutdown(self, how):
+        with self._cond:
+            self._shut = True
+            self._cond.notify_all()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+def _accept_stop_model():
+    """PR 7: the REAL VariableServer accept loop + stop(), on fake
+    sockets.  A connection that lands after stop() set _stopping must
+    never be served."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import pserver as ps
+
+    srv = ps.VariableServer(None, fluid.Scope(), None)
+    fake = _FakeListenSocket(lambda: srv._stopping)
+    srv._sock = fake
+    conn = _FakeConn(bytes(ps._frame_bytes("HELLO", "peer")))
+
+    acceptor = threading.Thread(target=srv._accept_loop, daemon=True)
+    client = threading.Thread(target=lambda: fake.deliver(conn))
+    stopper = threading.Thread(target=srv.stop)
+    acceptor.start()
+    client.start()
+    stopper.start()
+    client.join()
+    stopper.join()
+    acceptor.join(timeout=1)
+    return conn
+
+
+def _accept_stop_invariant(conn):
+    assert not (conn.accepted_stopping and conn.replied), \
+        "stopped VariableServer served a connection"
+
+
+def test_pin_pserver_accept_stop_race_head():
+    res = sched.explore(_accept_stop_model, _accept_stop_invariant,
+                        max_schedules=200, random_schedules=20)
+    assert res.ok, res.violation
+
+
+def test_pin_pserver_accept_stop_race_bug_reintroduced():
+    with sched.arm_fault("pserver.accept-stop-race"):
+        res = sched.explore(_accept_stop_model, _accept_stop_invariant,
+                            max_schedules=200, random_schedules=20)
+    assert res.violation is not None, "accept-vs-stop race not found"
+    assert "served a connection" in str(res.violation)
+
+
+def test_sched_faults_never_armed_outside_context():
+    assert not sched.fault_armed("pserver.accept-stop-race")
+    assert not sched.fault_armed("stream.yield-under-lock")
+
+
+# ---------------------------------------------------------------------------
+# CLI + lint delegation
+# ---------------------------------------------------------------------------
+
+
+def test_cli_concurrency_repo_clean(capsys):
+    from paddle_tpu.cli import cmd_concurrency
+
+    rc = cmd_concurrency([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "concurrency:" in out and "FAILED" not in out
+
+
+def test_cli_concurrency_json_shape(tmp_path, capsys):
+    from paddle_tpu.cli import cmd_concurrency
+
+    bad = tmp_path / "mod.py"
+    bad.write_text("""
+import threading
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+    def m2(self):
+        with self._b:
+            with self._a:
+                pass
+""")
+    rc = cmd_concurrency([str(bad), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["failed"]
+    d = doc["diagnostics"][0]
+    assert d["pass"] == "concurrency/lock-order"
+    assert d["severity"] == "error"
+    assert d["location"]["line"] > 0
+
+
+def test_lint_rule4_delegates_to_analyzer(tmp_path):
+    """tools/lint.py's locked-IO rule now runs through the analyzer:
+    the socket family still fires, AND the generalized families (join
+    under lock) fire through the same delegation."""
+    import ast as _ast
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint_conc", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+    def _run(self): pass
+    def f(self, sock, data):
+        with self._lock:
+            sock.sendall(data)
+    def g(self):
+        with self._lock:
+            self._worker.join()
+"""
+    hits = list(lint.check_locked_io(_ast.parse(src), "x.py",
+                                     src.splitlines()))
+    assert len(hits) == 2
+    assert any("socket" in h[2] for h in hits)
+    assert any("join" in h[2] for h in hits)

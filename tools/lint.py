@@ -20,19 +20,23 @@ it checks against, so this lint enforces at the SOURCE level:
      observability registry/exporters (docs/observability.md) so
      production processes (pservers, serving workers) stay scrape-able
      instead of spraying stdout.
-  4. no blocking socket `send*`/`recv*` call (raw socket methods OR the
-     pserver wire helpers `_send_frame`/`_recv_frame`/`_read_exact`/
-     `_sendall_parts`) inside a `with <lock>:` body in
+  4. no blocking call inside a `with <lock>:` body in
      `paddle_tpu/parallel`, `paddle_tpu/cloud`, or `paddle_tpu/serving`
      — a peer that stalls mid-frame then holds the lock for the
      socket-timeout duration and every other thread (the serving
      scheduler, the controller watch loop) convoys behind it; the PR 7/8
      reviews repeatedly moved IO outside locks for exactly this.
-     Allowlist for the per-endpoint worker pattern (one worker thread
-     owns one socket and a PER-CONNECTION lock only serializes access
-     to that one endpoint): a `with` statement over a lock whose name
-     matches `*conn_lock`/`*ep_lock`/`*endpoint_lock`, or an explicit
-     `# lint: send-under-lock-ok` comment on the `with` line.
+     This rule DELEGATES to the concurrency analyzer's
+     `blocking-under-lock` check (paddle_tpu/analysis/concurrency.py,
+     file-loaded standalone so lint stays import-light), which
+     generalizes the original socket-send/recv check to condition
+     waits, Thread.join, blocking queue ops, time.sleep, and
+     subprocess calls.  The per-endpoint worker allowlist
+     (`*conn_lock`/`*ep_lock`/`*endpoint_lock` lock names) and the
+     `# lint: send-under-lock-ok` comment still apply, plus the
+     analyzer's own `# lint: blocking-under-lock-ok`.  The full rule
+     set (lock-order cycles, unguarded attrs, thread hygiene) runs as
+     `python -m paddle_tpu.cli concurrency` in ci_check step 10.
 
 Run: `python tools/lint.py [paths...]` (default: the paddle_tpu
 package).  Exits non-zero listing `file:line: message` per violation.
@@ -65,19 +69,27 @@ LOCKED_IO_DIRS = tuple(
     os.path.join(REPO_ROOT, "paddle_tpu", d)
     for d in ("parallel", "cloud", "serving"))
 
-# rule 4: blocking wire calls — raw socket methods plus this repo's
-# pserver frame helpers (parallel/pserver.py); calling any of these with
-# a lock held convoys every other thread behind one slow peer
-BLOCKING_IO_CALLS = frozenset(
-    "send sendall sendmsg sendto recv recv_into recvfrom recvmsg "
-    "_send_frame _send_frame_parts _recv_frame _read_exact "
-    "_sendall_parts".split())
+_CONCURRENCY_PY = os.path.join(REPO_ROOT, "paddle_tpu", "analysis",
+                               "concurrency.py")
+_concurrency_mod = None
 
-# rule 4 allowlist: per-connection locks of the per-endpoint worker
-# pattern (one thread owns one socket; the lock serializes only that
-# endpoint, so a slow peer cannot convoy unrelated work)
-_PER_ENDPOINT_LOCK = ("conn_lock", "ep_lock", "endpoint_lock")
-_ALLOW_COMMENT = "lint: send-under-lock-ok"
+
+def _concurrency():
+    """File-load the concurrency analyzer WITHOUT importing the
+    paddle_tpu package (keeps lint dependency-free and fast); the
+    module is deliberately stdlib-only at module scope."""
+    global _concurrency_mod
+    if _concurrency_mod is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_lint_concurrency", _CONCURRENCY_PY)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses resolve string annotations through sys.modules
+        sys.modules["_lint_concurrency"] = mod
+        spec.loader.exec_module(mod)
+        _concurrency_mod = mod
+    return _concurrency_mod
 
 
 def _is_register_op_call(node: ast.Call) -> bool:
@@ -137,89 +149,25 @@ def check_no_prints(tree: ast.AST, path: str):
                    "scrape-able")
 
 
-def _lock_names(expr: ast.AST):
-    """Identifier-ish names mentioned in a with-item's context expr."""
-    for node in ast.walk(expr):
-        if isinstance(node, ast.Attribute):
-            yield node.attr
-        elif isinstance(node, ast.Name):
-            yield node.id
-
-
-def _is_lock_expr(expr: ast.AST) -> bool:
-    # token-wise match: `_cond` / `view_lock` are locks, but a name
-    # merely CONTAINING the letters (`seconds`, `blockers`) is not
-    import re as _re
-
-    for n in _lock_names(expr):
-        parts = [p for p in _re.split(r"[^a-z]+", n.lower()) if p]
-        if any(p in ("lock", "cond", "cv", "mutex") for p in parts):
-            return True
-        if n.lower().endswith(("lock", "cond")):
-            return True
-    return False
-
-
-def _is_allowed_lock(expr: ast.AST) -> bool:
-    return any(n.lower().endswith(_PER_ENDPOINT_LOCK)
-               for n in _lock_names(expr))
-
-
-def _walk_executed(node: ast.AST):
-    """ast.walk, but not into nested def/lambda bodies — code merely
-    DEFINED under the lock runs later, after release."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        n = stack.pop()
-        yield n
-        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-            stack.extend(ast.iter_child_nodes(n))
-
-
 def check_locked_io(tree: ast.AST, path: str, source_lines):
-    """Rule 4 (parallel/cloud/serving): no blocking socket send*/recv*
-    (or pserver frame helper) call while holding a lock."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.With):
-            continue
-        lockish = [i for i in node.items if _is_lock_expr(i.context_expr)]
-        if not lockish:
-            continue
-        if any(_is_allowed_lock(i.context_expr) for i in lockish):
-            continue  # per-endpoint worker pattern
-        line = ""
-        if 0 < node.lineno <= len(source_lines):
-            line = source_lines[node.lineno - 1]
-        if _ALLOW_COMMENT in line:
-            continue
-        for inner in _walk_executed(node):
-            if not isinstance(inner, ast.Call):
-                continue
-            f = inner.func
-            name = (f.attr if isinstance(f, ast.Attribute)
-                    else f.id if isinstance(f, ast.Name) else "")
-            if name in BLOCKING_IO_CALLS:
-                yield (path, inner.lineno,
-                       f"blocking wire call {name}() inside the "
-                       f"`with` lock at line {node.lineno} — a stalled "
-                       "peer holds the lock for the socket timeout and "
-                       "every other thread convoys; move the IO outside "
-                       "the lock (snapshot under it, send after), use a "
-                       "per-endpoint `*_conn_lock`, or annotate the "
-                       f"with-line `# {_ALLOW_COMMENT}` with a reason")
+    """Rule 4 (parallel/cloud/serving): no blocking call while holding
+    a lock — delegated to the concurrency analyzer so this lint and
+    `cli concurrency` share ONE lock-name heuristic, allowlist, and
+    blocking-call inventory instead of drifting apart."""
+    del tree  # the analyzer re-parses (shared machinery)
+    conc = _concurrency()
+    source = "\n".join(source_lines)
+    for f in conc.analyze_source(source, filename=path,
+                                 rules=["blocking-under-lock"]):
+        if f.severity != "error":
+            continue  # suppressed/transitive findings don't gate lint
+        yield (path, f.line, f.message + " — " + f.hint)
 
 
 def iter_py_files(paths):
-    for p in paths:
-        if os.path.isfile(p):
-            yield p
-            continue
-        for root, dirs, files in os.walk(p):
-            dirs[:] = [d for d in dirs if d != "__pycache__"]
-            for f in sorted(files):
-                if f.endswith(".py"):
-                    yield os.path.join(root, f)
+    # one walker, shared with `cli concurrency` — the lint and
+    # analyzer file sets must not silently drift apart
+    return _concurrency().iter_py_files(paths)
 
 
 def lint(paths) -> int:
