@@ -229,20 +229,91 @@ def job_merge(cfg, args):
 # ---------------------------------------------------------------------------
 
 
+def _snapshot_scalars(snap):
+    """{(name, label-items) -> (type, value)} for counters/gauges plus
+    histogram _count/_sum pseudo-series — the diffable subset of a
+    JSON snapshot."""
+    out = {}
+    for name, m in snap.get("metrics", {}).items():
+        for s in m["samples"]:
+            key_labels = tuple(sorted(s["labels"].items()))
+            if m["type"] == "histogram":
+                out[(name + "_count", key_labels)] = (
+                    "counter", float(s["value"]["count"]))
+                out[(name + "_sum", key_labels)] = (
+                    "counter", float(s["value"]["sum"]))
+            else:
+                out[(name, key_labels)] = (m["type"],
+                                           float(s["value"]))
+    return out
+
+
+def _print_metrics_diff(path_a, path_b, snap_a, snap_b):
+    """Counter deltas (and gauge before->after) between two snapshots
+    — the poor man's rate view over the atexit dumps."""
+    from paddle_tpu.observability.exporters import _fmt_labels
+
+    a = _snapshot_scalars(snap_a)
+    b = _snapshot_scalars(snap_b)
+    dt = float(snap_b.get("time", 0)) - float(snap_a.get("time", 0))
+    rows = []
+    for key in sorted(set(a) | set(b)):
+        name, labels = key
+        kind_a, va = a.get(key, (None, 0.0))
+        kind_b, vb = b.get(key, (None, 0.0))
+        kind = kind_b or kind_a
+        label = _fmt_labels(dict(labels))
+        if kind == "gauge":
+            if va != vb:
+                rows.append((f"{name}{label}", "gauge",
+                             f"{va:g} -> {vb:g}"))
+        else:
+            delta = vb - va
+            if delta:
+                per_s = f"  ({delta / dt:.6g}/s)" if dt > 0 else ""
+                rows.append((f"{name}{label}", kind or "counter",
+                             f"{delta:+g}{per_s}"))
+    print(f"{path_a} -> {path_b}"
+          + (f"  (dt {dt:.3f}s)" if dt > 0 else ""))
+    if not rows:
+        print("no series moved between the two snapshots")
+        return
+    name_w = max(len(r[0]) for r in rows)
+    print(f"{'Metric':<{name_w}}  {'Type':<9}  Delta")
+    for n, t, v in rows:
+        print(f"{n:<{name_w}}  {t:<9}  {v}")
+
+
 def cmd_metrics(argv):
     """`python -m paddle_tpu.cli metrics DUMP.json` — render a JSON
     metrics snapshot (observability.exporters.write_json, or the
-    --metrics_out of `cli trace`) as a table."""
+    --metrics_out of `cli trace`) as a table.  `--diff A.json B.json`
+    instead prints the counter deltas (and per-second rates, from the
+    snapshots' timestamps) between two dumps."""
     import json
 
     from paddle_tpu.observability.exporters import format_metrics_table
 
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli metrics",
-        description="render a metrics JSON snapshot as a table")
-    ap.add_argument("snapshot", help="JSON snapshot file written by "
+        description="render or diff metrics JSON snapshots")
+    ap.add_argument("snapshot", nargs="?", default="",
+                    help="JSON snapshot file written by "
                     "observability.exporters.write_json")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="print counter deltas between two snapshots "
+                    "(A = earlier, B = later)")
     args = ap.parse_args(argv)
+    if args.diff:
+        path_a, path_b = args.diff
+        with open(path_a) as f:
+            snap_a = json.load(f)
+        with open(path_b) as f:
+            snap_b = json.load(f)
+        _print_metrics_diff(path_a, path_b, snap_a, snap_b)
+        return 0
+    if not args.snapshot:
+        raise SystemExit("metrics: give a snapshot file or --diff A B")
     with open(args.snapshot) as f:
         snap = json.load(f)
     n = len(snap.get("metrics", {}))
@@ -305,6 +376,210 @@ def cmd_trace(argv):
 
 
 # ---------------------------------------------------------------------------
+# `top` / `slo` subcommands: the fleet telemetry plane
+# (docs/observability.md "Fleet telemetry")
+# ---------------------------------------------------------------------------
+
+# which series feed each fleet-table column, per member kind; the
+# fallback row renders "-" for kinds without a mapping
+_TOP_COLUMNS = {
+    "generation": {
+        "qps": "paddle_tpu_serving_generation_requests_total",
+        "latency": "paddle_tpu_serving_generation_seconds",
+        "queue": "paddle_tpu_serving_generation_queue_depth",
+        "util": "paddle_tpu_serving_kv_pool_utilization",
+    },
+    "serving": {
+        "qps": "paddle_tpu_serving_requests_total",
+        "latency": "paddle_tpu_serving_request_seconds",
+        "queue": "paddle_tpu_serving_queue_depth",
+    },
+    "pserver": {
+        "qps": "paddle_tpu_pserver_requests_total",
+        "latency": "paddle_tpu_pserver_optimize_seconds",
+    },
+    "trainer": {
+        "qps": "paddle_tpu_trainer_steps_total",
+        "latency": "paddle_tpu_trainer_step_seconds",
+    },
+    "router": {
+        "qps": "paddle_tpu_serving_router_requests_total",
+        "latency": "paddle_tpu_serving_router_request_seconds",
+        "queue": "paddle_tpu_serving_router_outstanding_tokens",
+    },
+}
+
+
+def _fmt_stat(v, fmt="{:.3g}"):
+    import math
+
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return "-"
+    return fmt.format(v)
+
+
+def format_fleet_table(coll, window_s: float = 60.0) -> str:
+    """The `cli top` table: one row per member with windowed qps /
+    p50 / p99 / queue depth / KV utilization from the collector's
+    fleet time-series."""
+    rows = []
+    for m in coll.members():
+        cols = _TOP_COLUMNS.get(m["kind"], {})
+        lbl = {"member": m["member"]}
+        qps = p50 = p99 = queue = util = None
+        if "qps" in cols:
+            qps = coll.series.rate(cols["qps"], window_s, labels=lbl)
+        if "latency" in cols:
+            p50 = coll.series.p50(cols["latency"], window_s,
+                                  labels=lbl)
+            p99 = coll.series.p99(cols["latency"], window_s,
+                                  labels=lbl)
+        if "queue" in cols:
+            queue = coll.series.latest(cols["queue"], labels=lbl)
+        if "util" in cols:
+            util = coll.series.latest(cols["util"], labels=lbl)
+        rows.append((m["member"], m["kind"],
+                     "up" if m["up"] else "DOWN",
+                     _fmt_stat(qps), _fmt_stat(p50, "{:.4g}"),
+                     _fmt_stat(p99, "{:.4g}"), _fmt_stat(queue),
+                     _fmt_stat(util, "{:.2f}")))
+    header = ("MEMBER", "KIND", "UP", "QPS", "P50", "P99", "QUEUE",
+              "KV_UTIL")
+    widths = [max([len(header[i])] + [len(r[i]) for r in rows])
+              for i in range(len(header))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    if not rows:
+        lines.append("(no members announced yet)")
+    return "\n".join(lines)
+
+
+def cmd_top(argv):
+    """`python -m paddle_tpu.cli top --registry HOST:PORT` — the live
+    fleet table: every announced member (trainers, pservers, serving
+    replicas, routers) with windowed qps, p50/p99 latency, queue depth
+    and KV-pool utilization from a TelemetryCollector scrape, plus the
+    SLO scoreboard when --slo points at a spec file.  One render after
+    --samples scrapes by default; --watch refreshes until ^C."""
+    import time as _time
+
+    from paddle_tpu.observability import slo as slo_mod
+    from paddle_tpu.observability.collector import TelemetryCollector
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli top",
+        description="live fleet telemetry table "
+        "(docs/observability.md 'Fleet telemetry')")
+    ap.add_argument("--registry", required=True,
+                    help="TTL-lease registry HOST:PORT the fleet's "
+                    "members announce() in")
+    ap.add_argument("--period", type=float, default=0.5,
+                    help="scrape period seconds")
+    ap.add_argument("--samples", type=int, default=4,
+                    help="scrapes before the (first) render — two or "
+                    "more make windowed rates/quantiles meaningful")
+    ap.add_argument("--window", type=float, default=60.0,
+                    help="window seconds for qps/p50/p99")
+    ap.add_argument("--slo", default="",
+                    help="SLO spec file (tools/slo.json) to score "
+                    "against the fleet series")
+    ap.add_argument("--watch", action="store_true",
+                    help="keep refreshing until interrupted")
+    args = ap.parse_args(argv)
+
+    coll = TelemetryCollector(registry_addr=args.registry,
+                              period_s=args.period)
+    specs = slo_mod.load_slos(args.slo) if args.slo else []
+    try:
+        while True:
+            for i in range(max(args.samples, 1)):
+                if i:  # sleep BETWEEN scrapes, never after the last
+                    _time.sleep(args.period)
+                coll.scrape_once()
+            print(format_fleet_table(coll, window_s=args.window))
+            if specs:
+                print()
+                print(slo_mod.format_slo_table(
+                    slo_mod.evaluate(specs, coll.series)))
+            if not args.watch:
+                break
+            print()
+            # --samples 1 never sleeps inside the scrape loop; without
+            # this the watch loop would hammer every member endpoint
+            _time.sleep(args.period)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        coll.close()
+    return 0
+
+
+def cmd_slo(argv):
+    """`python -m paddle_tpu.cli slo --check [--spec tools/slo.json]`
+    — evaluate the fleet SLOs and exit nonzero on violation.  Two
+    modes: `--registry HOST:PORT` samples a live fleet through a
+    TelemetryCollector and applies the full multiwindow burn-rate rule;
+    `--prom DUMP` gates a single Prometheus dump (federation output or
+    any scrape) on lifetime stats — the CI smoke mode."""
+    import time as _time
+
+    from paddle_tpu.observability import slo as slo_mod
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli slo",
+        description="evaluate SLO specs against fleet telemetry "
+        "(docs/observability.md 'Fleet telemetry')")
+    ap.add_argument("--spec", default="tools/slo.json",
+                    help="SLO spec file (grammar + dict forms)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero when any objective alerts")
+    ap.add_argument("--registry", default="",
+                    help="live mode: scrape this fleet registry")
+    ap.add_argument("--prom", default="",
+                    help="snapshot mode: gate this Prometheus text "
+                    "dump")
+    ap.add_argument("--period", type=float, default=0.5)
+    ap.add_argument("--samples", type=int, default=6,
+                    help="live mode: scrapes before evaluating")
+    args = ap.parse_args(argv)
+
+    specs = slo_mod.load_slos(args.spec)
+    if bool(args.registry) == bool(args.prom):
+        raise SystemExit(
+            "slo: give exactly one of --registry (live) or --prom "
+            "(snapshot)")
+    if args.prom:
+        from paddle_tpu.observability.collector import \
+            parse_prometheus_text
+
+        with open(args.prom) as f:
+            families = parse_prometheus_text(f.read())
+        statuses = slo_mod.evaluate_snapshot(specs, families)
+    else:
+        from paddle_tpu.observability.collector import \
+            TelemetryCollector
+
+        coll = TelemetryCollector(registry_addr=args.registry,
+                                  period_s=args.period)
+        try:
+            for i in range(max(args.samples, 2)):
+                if i:  # sleep BETWEEN scrapes, never after the last
+                    _time.sleep(args.period)
+                coll.scrape_once()
+            statuses = slo_mod.evaluate(specs, coll.series)
+        finally:
+            coll.close()
+    print(slo_mod.format_slo_table(statuses))
+    bad = slo_mod.failed(statuses)
+    print(f"slo: {len(statuses)} objective(s) — "
+          + ("FAILED" if bad else "all met"))
+    if args.check and bad:
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # `serve` subcommand: one generation replica (docs/serving.md)
 # ---------------------------------------------------------------------------
 
@@ -350,8 +625,18 @@ def cmd_serve(argv):
                     "with (kind 'generation')")
     ap.add_argument("--ttl", type=float, default=2.0,
                     help="registry lease TTL seconds")
+    ap.add_argument("--telemetry",
+                    default=os.environ.get(
+                        "PADDLE_TPU_TELEMETRY_REGISTRY", ""),
+                    help="fleet telemetry registry HOST:PORT — the "
+                    "replica announces its /metrics endpoint there "
+                    "for a TelemetryCollector (docs/observability.md "
+                    "'Fleet telemetry')")
     ap.add_argument("--use_tpu", type=int, default=1)
     args = ap.parse_args(argv)
+    if args.telemetry:
+        # ReplicaServer's env-gated maybe_announce() does the work
+        os.environ["PADDLE_TPU_TELEMETRY_REGISTRY"] = args.telemetry
 
     server = server_from_model_dir(
         args.model_dir, slots=args.slots or None,
@@ -871,14 +1156,16 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     subcommands = {"verify": cmd_verify, "analyze": cmd_analyze,
                    "metrics": cmd_metrics, "trace": cmd_trace,
-                   "serve": cmd_serve, "concurrency": cmd_concurrency}
+                   "serve": cmd_serve, "concurrency": cmd_concurrency,
+                   "top": cmd_top, "slo": cmd_slo}
     if argv and argv[0] in subcommands:
         sys.exit(subcommands[argv[0]](argv[1:]))
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.cli",
         description="legacy `paddle train` workflow over Program/Executor"
         " (plus subcommands: `python -m paddle_tpu.cli "
-        "verify|analyze|concurrency|metrics|trace|serve --help`)")
+        "verify|analyze|concurrency|metrics|trace|serve|top|slo "
+        "--help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

@@ -70,6 +70,17 @@ _M_SWAPS = obs_metrics.counter(
     "paddle_tpu_serving_router_swaps_total",
     "per-replica checkpoint hot swaps orchestrated", ("router",),
     always=True)
+# always=True like the request counters: signals() (the autoscaler
+# feed) is a stats()-style API whose contract must not depend on the
+# metrics switch
+_M_LATENCY = obs_metrics.histogram(
+    "paddle_tpu_serving_router_request_seconds",
+    "end-to-end front-door request latency (submit to last token, "
+    "retries included)", ("router",), always=True)
+_M_OUTSTANDING = obs_metrics.gauge(
+    "paddle_tpu_serving_router_outstanding_tokens",
+    "tokens reserved on replicas for in-flight requests", ("router",),
+    always=True)
 
 
 class NoReplicasAvailable(ConnectionError):
@@ -129,6 +140,13 @@ class ReplicaRouter:
         self._m_retries = _M_RETRIES.labels(router=rid)
         self._m_live = _M_LIVE.labels(router=rid)
         self._m_swaps = _M_SWAPS.labels(router=rid)
+        self._m_latency = _M_LATENCY.labels(router=rid)
+        self._m_outstanding = _M_OUTSTANDING.labels(router=rid)
+        # windowed self-observation (ROADMAP 4's autoscaler substrate):
+        # a TimeSeriesStore sampling this process's registry, started
+        # lazily by watch()/signals() — the router then consumes
+        # p99(window)/qps(window) instead of raw instantaneous gauges
+        self._series = None
 
     # -- routing table ------------------------------------------------------
     def _refresh(self, force: bool = False):
@@ -211,6 +229,7 @@ class ReplicaRouter:
     def _run_request(self, stream: GenerationStream, req: dict,
                      expires: Optional[float]):
         delivered = 0
+        t_start = time.monotonic()
         state = self.policy.begin()
         while True:
             if expires is not None and time.monotonic() >= expires:
@@ -224,6 +243,8 @@ class ReplicaRouter:
                 if replica is not None:
                     reserve = req["max_new"] - delivered
                     replica.outstanding += reserve
+            if replica is not None:
+                self._m_outstanding.inc(reserve)
             if replica is None:
                 try:
                     state.record(NoReplicasAvailable(
@@ -248,8 +269,10 @@ class ReplicaRouter:
                     with self._lock:
                         replica.outstanding -= 1
                         reserve -= 1
+                    self._m_outstanding.dec()
                     stream._put(tok)
                 self._m_ok.inc()
+                self._m_latency.observe(time.monotonic() - t_start)
                 stream._finish()
                 return
             except (ReplicaShed, ServerSaturated) as e:
@@ -269,6 +292,7 @@ class ReplicaRouter:
             finally:
                 with self._lock:
                     replica.outstanding -= max(reserve, 0)
+                self._m_outstanding.dec(max(reserve, 0))
             self._demote(addr)
             self._m_retries.inc()
             _LOG.warning("router: replica %s failed (%r), retrying "
@@ -325,6 +349,50 @@ class ReplicaRouter:
                          len(errors), errors)
         return swapped
 
+    # -- windowed self-observation (the autoscaler substrate) ---------------
+    def watch(self, period_s: float = 0.5, capacity: int = 720):
+        """Start (idempotently) the router's time-series sampler and
+        return the TimeSeriesStore.  This is the watchable
+        queue-depth/latency history ROADMAP item 4's autoscaler scales
+        on — windowed signals, not instantaneous gauge reads."""
+        from paddle_tpu.observability.timeseries import TimeSeriesStore
+
+        with self._lock:
+            if self._closed:
+                # a watch() racing close() must not resurrect a
+                # sampler thread nobody will ever stop
+                raise RuntimeError("router is closed")
+            if self._series is None:
+                self._series = TimeSeriesStore(period_s=period_s,
+                                               capacity=capacity)
+                self._series.start()
+            return self._series
+
+    def signals(self, window_s: float = 60.0) -> dict:
+        """The scaling signals over one window: request rate, windowed
+        p50/p99 latency (bucket-delta quantiles, NaN before traffic),
+        reserved-token backlog, live replica count.  A scale-out
+        policy reads `p99`/`qps`/`outstanding_tokens`; `replicas_live`
+        closes its feedback loop."""
+        series = self.watch()
+        lbl = {"router": self._rid}
+        return {
+            "window_s": float(window_s),
+            "qps": series.rate(
+                "paddle_tpu_serving_router_requests_total", window_s,
+                labels=lbl),
+            "p50": series.p50(
+                "paddle_tpu_serving_router_request_seconds", window_s,
+                labels=lbl),
+            "p99": series.p99(
+                "paddle_tpu_serving_router_request_seconds", window_s,
+                labels=lbl),
+            "outstanding_tokens": series.latest(
+                "paddle_tpu_serving_router_outstanding_tokens",
+                labels=lbl),
+            "replicas_live": len(self.live_replicas()),
+        }
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -340,11 +408,16 @@ class ReplicaRouter:
         if self._closed:
             return
         self._closed = True
+        with self._lock:
+            series, self._series = self._series, None
+        if series is not None:
+            series.stop()
         if self._owned_registry is not None:
             self._owned_registry.close()
         # reclaim this instance's registry series (router churn must
         # not grow dumps or bleed counts into later instances)
         for outcome in ("ok", "shed", "failed"):
             _M_REQUESTS.remove(router=self._rid, outcome=outcome)
-        for fam in (_M_RETRIES, _M_LIVE, _M_SWAPS):
+        for fam in (_M_RETRIES, _M_LIVE, _M_SWAPS, _M_LATENCY,
+                    _M_OUTSTANDING):
             fam.remove(router=self._rid)

@@ -372,6 +372,15 @@ class FaultInjector:
         _M_FAULTS.labels(site=site, kind=rule.kind).inc()
         _LOG.warning("fault injected at %s: kind=%s (rule %r)",
                      site, rule.kind, rule)
+        try:
+            # the moment a chaos fault fires is exactly the window a
+            # post-mortem wants preserved — dump the flight ring now
+            # (no-op when no recorder is armed)
+            from paddle_tpu.observability import flightrecorder
+            flightrecorder.on_fault(site, rule.kind)
+        except Exception as e:  # recorder trouble must not mask the
+            # injected fault the caller is about to raise
+            _LOG.debug("flight-recorder fault dump failed: %r", e)
 
     def fire(self, site: str):
         """Give error/delay rules a shot at this call site."""

@@ -22,7 +22,7 @@ See docs/observability.md for the full tour.
 """
 from __future__ import annotations
 
-from . import exporters, metrics, tracing  # noqa: F401
+from . import collector, exporters, flightrecorder, metrics, slo, timeseries, tracing  # noqa: F401,E501
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -33,12 +33,18 @@ from .metrics import (  # noqa: F401
     histogram,
     registry,
 )
+from .timeseries import TimeSeriesStore  # noqa: F401
 from .tracing import SpanContext, activate, current_context, span  # noqa: F401
 
 __all__ = [
     "metrics",
     "tracing",
     "exporters",
+    "timeseries",
+    "flightrecorder",
+    "slo",
+    "collector",
+    "TimeSeriesStore",
     "Counter",
     "Gauge",
     "Histogram",
@@ -82,3 +88,6 @@ def _wire_flags():
 
 
 _wire_flags()
+# PADDLE_TPU_FLIGHT_DIR / PADDLE_TPU_FLIGHT arm the always-on flight
+# recorder at import (docs/observability.md "Fleet telemetry")
+flightrecorder.maybe_install_from_env()

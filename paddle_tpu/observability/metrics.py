@@ -35,6 +35,7 @@ __all__ = [
     "histogram",
     "enabled",
     "set_enabled",
+    "quantile_from_buckets",
     "DEFAULT_LATENCY_BUCKETS",
 ]
 
@@ -170,10 +171,47 @@ class _HistogramChild:
         out.append((float("inf"), acc + counts[-1]))
         return out
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 <= q <= 1) by linear interpolation
+        inside the bucket that crosses rank q*count — the
+        histogram_quantile() estimator, resolved to the recording side
+        so the SLO layer and `cli top` need no PromQL engine.  NaN when
+        nothing was observed; samples past the top finite bucket clamp
+        to that bound (the +Inf bucket has no upper edge to interpolate
+        toward)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        return quantile_from_buckets(self._metric.buckets, counts,
+                                     total, q)
+
     def _sample(self):
         return {"sum": self.sum, "count": self.count,
                 "buckets": [[le, n] for le, n in
                             self.cumulative_buckets()]}
+
+
+def quantile_from_buckets(buckets: Sequence[float],
+                          counts: Sequence[int], total: int,
+                          q: float) -> float:
+    """Shared quantile math over per-bucket (non-cumulative) counts;
+    `counts` has one trailing overflow (+Inf) slot.  Used by the live
+    histogram children and by the time-series store's windowed bucket
+    deltas (timeseries.py)."""
+    if not (0.0 <= q <= 1.0):
+        raise ValueError(f"quantile q must be in [0, 1], got {q}")
+    if total <= 0:
+        return float("nan")
+    target = q * total
+    cum = 0.0
+    prev_le = 0.0
+    for le, c in zip(buckets, counts):
+        if c and cum + c >= target:
+            frac = (target - cum) / c
+            return prev_le + (le - prev_le) * min(max(frac, 0.0), 1.0)
+        cum += c
+        prev_le = le
+    return float(buckets[-1])
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +362,9 @@ class Histogram(_Metric):
     def count(self) -> int:
         return self._default_child().count
 
+    def quantile(self, q: float) -> float:
+        return self._default_child().quantile(q)
+
 
 # ---------------------------------------------------------------------------
 # registry
@@ -360,6 +401,36 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[Dict[str, str]] = None) -> float:
+        """q-quantile of one histogram series: the unlabeled child, or
+        the `labels` combination of a labeled family.  Raises KeyError
+        for an unknown metric and ValueError for a non-histogram —
+        a typo'd SLO must fail loudly, not read as 'no data'."""
+        m = self.get(name)
+        if m is None:
+            raise KeyError(f"no metric named {name!r} in the registry")
+        if not isinstance(m, Histogram):
+            raise ValueError(
+                f"metric {name!r} is a {m.kind}, not a histogram")
+        if labels:
+            # look up WITHOUT the get-or-create of .labels(): a read
+            # API with a typo'd label value must raise, not mint (and
+            # forever export) an empty child series
+            if set(labels) != set(m.labelnames):
+                raise ValueError(
+                    f"metric {name} has labels {m.labelnames}, "
+                    f"got {sorted(labels)}")
+            key = tuple(str(labels[n]) for n in m.labelnames)
+            with m._lock:
+                child = m._children.get(key)
+            if child is None:
+                raise KeyError(
+                    f"metric {name!r} has no series with labels "
+                    f"{labels}")
+            return child.quantile(q)
+        return m.quantile(q)
 
     def metrics(self) -> List[_Metric]:
         with self._lock:

@@ -47,6 +47,8 @@ __all__ = [
     "extract",
     "enabled",
     "set_enabled",
+    "add_span_listener",
+    "remove_span_listener",
     "trace_dir",
     "finished_spans",
     "clear",
@@ -67,6 +69,11 @@ _dropped = 0
 _lock = threading.Lock()
 _tls = threading.local()
 _rng = random.Random()
+# span listeners (the flight recorder's tap): when any is registered,
+# spans are CREATED and delivered to listeners even with full tracing
+# off — the recorder's always-on ring wants the last seconds of spans
+# without paying for (or growing) the 100k export buffer
+_listeners: List = []
 
 
 def _after_fork_in_child():
@@ -175,6 +182,20 @@ class Span:
         self.attrs[key] = value
 
 
+def add_span_listener(fn) -> None:
+    """Register `fn(rec_dict)` to receive every finished span.  While
+    any listener is registered, span() is live even when full tracing
+    is off — records then flow ONLY to listeners, not the export
+    buffer.  Listeners must be cheap and must not raise."""
+    if fn not in _listeners:
+        _listeners.append(fn)
+
+
+def remove_span_listener(fn) -> None:
+    if fn in _listeners:
+        _listeners.remove(fn)
+
+
 def _record(s: Span, duration: float) -> None:
     global _dropped
     rec = {
@@ -189,11 +210,14 @@ def _record(s: Span, duration: float) -> None:
         "thread": threading.current_thread().name,
         "attrs": dict(s.attrs),
     }
-    with _lock:
-        if len(_spans) >= _MAX_SPANS:
-            _dropped += 1
-            return
-        _spans.append(rec)
+    if _ENABLED:
+        with _lock:
+            if len(_spans) >= _MAX_SPANS:
+                _dropped += 1
+            else:
+                _spans.append(rec)
+    for fn in _listeners:
+        fn(rec)
 
 
 class _NoopCtx:
@@ -242,9 +266,9 @@ class _SpanCtx:
 
 def span(name: str, **attrs):
     """Open a trace span around the block.  No-op (yields None) when
-    tracing is off; otherwise the `with` target is the Span (set_attr
-    for values known only mid-block)."""
-    if not _ENABLED:
+    tracing is off and no listener is tapped; otherwise the `with`
+    target is the Span (set_attr for values known only mid-block)."""
+    if not (_ENABLED or _listeners):
         return _NOOP
     return _SpanCtx(name, attrs)
 
@@ -268,7 +292,7 @@ def activate(ctx: Optional[SpanContext]):
     """Install `ctx` as this thread's current context WITHOUT recording
     a span — the receiving half of a thread handoff or wire extract.
     `None` is a no-op so call sites need no conditional."""
-    if not _ENABLED or ctx is None:
+    if ctx is None or not (_ENABLED or _listeners):
         return _NOOP
     return _ActivateCtx(ctx)
 
@@ -284,7 +308,7 @@ def record_span(name: str, ts: float, dur: float,
     existing trace, else it starts its own.  Returns the recorded
     context (None when tracing is off)."""
     global _dropped
-    if not _ENABLED:
+    if not (_ENABLED or _listeners):
         return None
     ctx = SpanContext(
         parent.trace_id if parent is not None else _new_trace_id(),
@@ -301,11 +325,14 @@ def record_span(name: str, ts: float, dur: float,
         "thread": threading.current_thread().name,
         "attrs": dict(attrs),
     }
-    with _lock:
-        if len(_spans) >= _MAX_SPANS:
-            _dropped += 1
-            return ctx
-        _spans.append(rec)
+    if _ENABLED:
+        with _lock:
+            if len(_spans) >= _MAX_SPANS:
+                _dropped += 1
+            else:
+                _spans.append(rec)
+    for fn in _listeners:
+        fn(rec)
     return ctx
 
 

@@ -30,6 +30,7 @@ import numpy as np
 from ..core.lod import LoDTensor, SelectedRows
 from ..core.resilience import (RetryPolicy, fault_injector,
                                sched_fault_armed as _sched_fault)
+from ..observability import flightrecorder
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 
@@ -83,7 +84,11 @@ _KNOWN_VERBS = frozenset(
      # vars, HAVE probes which names a member holds (bootstrap-copy
      # consolidation), FENCE/COMMIT are the controller's two-phase
      # view change
-     "PUT_BATCH", "DROP", "HAVE", "FENCE", "COMMIT"})
+     "PUT_BATCH", "DROP", "HAVE", "FENCE", "COMMIT",
+     # FLIGHT returns the process flight-recorder ring on demand
+     # (observability/flightrecorder.py) — post-mortems of a LIVE but
+     # misbehaving pserver without attaching a debugger
+     "FLIGHT"})
 
 # frame-length sanity: a header larger than 1 MiB or a payload larger
 # than 2 GiB is protocol desync / corruption, not a real request —
@@ -493,6 +498,12 @@ class VariableServer:
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        # fleet telemetry: with PADDLE_TPU_TELEMETRY_REGISTRY set, the
+        # first server of this process publishes its /metrics endpoint
+        # for the TelemetryCollector (no-op otherwise)
+        from ..observability.collector import maybe_announce
+
+        maybe_announce("pserver")
         return self.port
 
     def register_with(self, registry, kind: str = "pserver",
@@ -584,6 +595,20 @@ class VariableServer:
                 _M_REQUESTS.labels(
                     verb=verb if verb in _KNOWN_VERBS else "other").inc()
                 try:
+                    # the handler BUFFERS its reply and sends it only
+                    # after the span context manager has exited: the
+                    # reply frame is the client's wake-up, so recording
+                    # first makes "client saw the reply => the server
+                    # span is in the buffer" an invariant.  (Sending
+                    # inside the span left a scheduling window where a
+                    # loaded host could park this thread between
+                    # sendall and the span record while the client — and
+                    # a test/collector behind it — already read the
+                    # trace: the 1-in-4 wire-propagation flake PRs 11
+                    # and 12 logged.)
+                    reply = None        # (verb, name, payload_bytes)
+                    reply_parts = None  # (verb, name, iovec parts)
+                    stop_after = False
                     # the propagated trace context (when the frame has
                     # one) parents this server-side span under the
                     # remote caller's span: one trace id across the wire
@@ -593,7 +618,7 @@ class VariableServer:
                                 var=name):
                         if verb == "HELLO":
                             peer = name
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "SEND":
                             tid = self._trainer_id(peer or "anon")
                             value = deserialize_var(payload, copy=False)
@@ -605,7 +630,7 @@ class VariableServer:
                                         f"{name}.trainer_{tid}", value)
                             else:
                                 self._apply_async(name, value)
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "SEND_BATCH" and self.enable_batch:
                             tid = self._trainer_id(peer or "anon")
                             # deserialize the whole bucket OUTSIDE the
@@ -620,7 +645,7 @@ class VariableServer:
                                             f"{n}.trainer_{tid}", v)
                             else:
                                 self._apply_async_bucket(pairs)
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "GET_BATCH" and self.enable_batch:
                             names = json.loads(bytes(payload))
                             vals = self._blocking_get_many(names)
@@ -633,13 +658,13 @@ class VariableServer:
                                 # tell the client to fetch this chunk
                                 # per-var instead of shipping a frame
                                 # its parser must reject
-                                _send_frame(
-                                    conn, "ERR",
+                                reply = (
+                                    "ERR",
                                     f"batch too large: {len(names)} "
-                                    "vars exceed the frame payload cap")
+                                    "vars exceed the frame payload cap",
+                                    b"")
                             else:
-                                _send_frame_parts(conn, "VARS", "",
-                                                  parts)
+                                reply_parts = ("VARS", "", parts)
                         elif verb == "PUT_BATCH":
                             # shard migration / recovery install: values
                             # land under their CANONICAL names (vs
@@ -655,7 +680,7 @@ class VariableServer:
                             with self._lock:
                                 for n, v in pairs:
                                     self.scope.set_var(n, v)
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "DROP":
                             names = json.loads(bytes(payload))
                             # the param, its canonical grad, and stale
@@ -679,7 +704,7 @@ class VariableServer:
                                             ".trainer_", 1)[0]
                                     if base in doomed:
                                         self.scope.erase(sn)
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "HAVE":
                             # bootstrap-copy probe: which of these
                             # names does this member hold?  Used by the
@@ -689,34 +714,47 @@ class VariableServer:
                             with self._lock:
                                 held = [n for n in names
                                         if self.scope.has_var(n)]
-                            _send_frame(conn, "OK", "",
-                                        json.dumps(held).encode())
+                            reply = ("OK", "",
+                                     json.dumps(held).encode())
                         elif verb == "FENCE":
                             self._apply_fence(int(name))
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "COMMIT":
                             attrs = (json.loads(bytes(payload))
                                      if payload else {})
                             self._apply_commit(int(name),
                                                attrs.get("fan_in"))
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "BARRIER":
                             if self.sync:
                                 self._barrier()
-                            _send_frame(conn, "OK")
+                            reply = ("OK", "", b"")
                         elif verb == "GET":
                             val = self._blocking_get(name)
-                            _send_frame_parts(
-                                conn, "VAR", name,
+                            reply_parts = (
+                                "VAR", name,
                                 _var_payload_parts(
                                     *serialize_var_parts(val)))
+                        elif verb == "FLIGHT":
+                            # on-demand flight-recorder dump (the ring
+                            # of recent spans/events/metric snapshots)
+                            reply = ("OK", "", json.dumps(
+                                flightrecorder.dump_dict(
+                                    reason="wire"),
+                                default=str).encode())
                         elif verb == "STOP":
-                            _send_frame(conn, "OK")
-                            self.stop()
-                            return
+                            reply = ("OK", "", b"")
+                            stop_after = True
                         else:
-                            _send_frame(conn, "ERR",
-                                        f"unknown verb {verb}")
+                            reply = ("ERR", f"unknown verb {verb}",
+                                     b"")
+                    if reply_parts is not None:
+                        _send_frame_parts(conn, *reply_parts)
+                    elif reply is not None:
+                        _send_frame(conn, *reply)
+                    if stop_after:
+                        self.stop()
+                        return
                 except (ConnectionError, OSError):
                     raise
                 except Exception as e:
@@ -955,7 +993,12 @@ class VariableServer:
         t0 = _time.perf_counter()
         with obs_tracing.span("pserver.optimize", round=self._round):
             self._run_optimize_inner()
-        _M_OPTIMIZE_SECONDS.observe(_time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        _M_OPTIMIZE_SECONDS.observe(dt)
+        # flight ring: the optimize cadence is the first thing a
+        # post-mortem of a killed pserver reads (no-op unless armed)
+        flightrecorder.note("pserver.optimize", round=self._round,
+                            seconds=dt)
 
     def _run_optimize_inner(self):
         # sum per-trainer grads into the canonical grad var, then run the
@@ -1441,6 +1484,18 @@ class VariableClient:
             raise RuntimeError(f"pserver error fetching {name!r}: {rverb}")
         # the reply buffer is this frame's alone — a view is safe
         return deserialize_var(rpayload, copy=False)
+
+    def get_flight_record(self) -> dict:
+        """On-demand flight-recorder dump of the SERVER process
+        (observability/flightrecorder.py): its ring of recent spans,
+        structured events and metric snapshots.  Works against any
+        live server; one that never armed a recorder answers an honest
+        empty ring (``armed: false``)."""
+        rverb, rname, rpayload = self._request("FLIGHT")
+        if rverb != "OK":
+            raise RuntimeError(
+                f"pserver error on FLIGHT: {rname or rverb}")
+        return json.loads(bytes(rpayload))
 
     def stop_server(self):
         rverb, _, _ = self._request("STOP", idempotent=False)

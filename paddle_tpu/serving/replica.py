@@ -22,6 +22,9 @@ so a text protocol costs nothing measurable):
   {"op":"ping"}   -> {"ok":true,"outstanding":N,"free_blocks":F,
                       "draining":false}
   {"op":"stats"}  -> {"ok":true,"stats":{...}}
+  {"op":"flight"} -> {"ok":true,"dump":{...}}  (the process flight-
+                     recorder ring: recent spans/events/metric
+                     snapshots, observability/flightrecorder.py)
   {"op":"swap","dir":"..."} -> {"ok":true} after drain+swap+resume
   {"op":"stop"}   -> {"ok":true}, then the replica shuts down
 
@@ -83,6 +86,12 @@ class ReplicaServer:
         self._accept_thread = threading.Thread(target=self._accept,
                                                daemon=True)
         self._accept_thread.start()
+        # fleet telemetry: with PADDLE_TPU_TELEMETRY_REGISTRY set, the
+        # replica publishes its /metrics endpoint for the
+        # TelemetryCollector (no-op otherwise)
+        from ..observability.collector import maybe_announce
+
+        maybe_announce(kind)
 
     # -- server side --------------------------------------------------------
     def _accept(self):
@@ -120,7 +129,11 @@ class ReplicaServer:
 
     @staticmethod
     def _reply(f, obj) -> None:
-        f.write(json.dumps(obj, separators=(",", ":")) + "\n")
+        # default=str: flight dumps carry arbitrary span attrs / note
+        # payloads (numpy scalars, exceptions) — the post-mortem path
+        # must not die on an unserializable ring entry
+        f.write(json.dumps(obj, separators=(",", ":"), default=str)
+                + "\n")
         f.flush()
 
     def _dispatch(self, f, req) -> bool:
@@ -135,6 +148,12 @@ class ReplicaServer:
                 "draining": self._server._pending_states is not None})
         elif op == "stats":
             self._reply(f, {"ok": True, "stats": self._server.stats()})
+        elif op == "flight":
+            from ..observability import flightrecorder
+
+            self._reply(f, {"ok": True,
+                            "dump": flightrecorder.dump_dict(
+                                reason="wire")})
         elif op == "swap":
             try:
                 fault_injector().fire("serving.replica_swap")

@@ -21,6 +21,7 @@ from .core.framework import (
     default_startup_program,
 )
 from .data_feeder import DataFeeder
+from .observability import flightrecorder
 from .observability import metrics as obs_metrics
 from .observability import tracing as obs_tracing
 
@@ -373,6 +374,13 @@ class Trainer:
         event_handler = event_handler or (lambda e: None)
         feeder = feeder or self._feeder()
         fetches = [self.loss] + self.fetch_list
+        # fleet telemetry: with PADDLE_TPU_TELEMETRY_REGISTRY set, the
+        # trainer publishes its /metrics endpoint for the
+        # TelemetryCollector (no-op otherwise; lazy import keeps the
+        # cloud registry out of plain local runs)
+        from .observability.collector import maybe_announce
+
+        maybe_announce("trainer")
         if prefetch is None:
             prefetch = int(get_flag("prefetch_depth"))
         if sync_every_n is None:
@@ -462,6 +470,12 @@ class Trainer:
                         metrics = outs[1:]
                     pass_costs.append(cost)
                     self.step += 1
+                    if flightrecorder.armed():
+                        # the post-mortem ring wants the step cadence
+                        # (cost may still be device-lazy — not forced)
+                        flightrecorder.note(
+                            "trainer.step", step=self.step,
+                            pass_id=pass_id, batch_id=batch_id)
                     if obs_metrics.enabled():
                         _M_STEPS.inc()
                         _M_STEP_SECONDS.observe(

@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Mini-fleet telemetry smoke: 1 trainer x 1 pserver + 1 serving
+replica under a TelemetryCollector (tools/ci_check.sh step 11).
+
+The driver hosts the TTL-lease registry and the collector, then spawns
+three REAL processes with PADDLE_TPU_METRICS=on and
+PADDLE_TPU_TELEMETRY_REGISTRY pointed at the registry:
+
+  * a pserver (`--role pserver`): VariableServer + SGD optimize
+    program; its serve() auto-announces the /metrics endpoint;
+  * a trainer (`--role trainer`): VariableClient rounds
+    (send grad -> barrier -> get) under trainer.step spans, moving the
+    real trainer series;
+  * a generation replica: `python -m paddle_tpu.cli serve` over a tiny
+    saved model dir; the driver streams a few generate requests at it.
+
+While traffic flows the collector scrapes on a period; the driver then
+asserts the FEDERATED Prometheus dump carries member-labeled series
+from all three kinds, renders the `cli top` fleet table, SIGKILLs the
+pserver and asserts its flight-recorder dump (PADDLE_TPU_FLIGHT_DIR)
+survived on disk with the pserver's final spans.  The federation dump
+is written to --out for the `cli slo --check --prom` gate that follows
+in ci_check.
+
+Usage:  python tools/mini_fleet.py [--out /tmp/fleet.prom]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/mini_fleet.py` from anywhere
+    sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# member roles (run in child processes)
+# ---------------------------------------------------------------------------
+
+
+def role_pserver(args):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel.pserver import VariableServer
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        p = blk.create_var(name="w", shape=[8], dtype="float32",
+                           persistable=True)
+        g = blk.create_var(name="w@GRAD", shape=[8], dtype="float32",
+                           persistable=True)
+        lr = blk.create_var(name="pserver_lr", shape=[1],
+                            dtype="float32", persistable=True)
+        blk.append_op("sgd",
+                      {"Param": [p.name], "Grad": [g.name],
+                       "LearningRate": [lr.name]},
+                      {"ParamOut": [p.name]}, {})
+    scope = fluid.Scope()
+    scope.set_var("w", np.ones(8, np.float32))
+    scope.set_var("pserver_lr", np.array([0.1], np.float32))
+    exe = fluid.Executor(fluid.CPUPlace())
+    server = VariableServer(prog, scope, exe, fan_in=1)
+    port = server.serve(0)  # announces via PADDLE_TPU_TELEMETRY_REGISTRY
+    print(f"PSERVER_PORT {port}", flush=True)
+    time.sleep(args.run_s)  # serve until the driver kills us
+    server.stop()
+    return 0
+
+
+def role_trainer(args):
+    import numpy as np
+
+    import paddle_tpu as fluid  # noqa: F401 (registers the series)
+    from paddle_tpu.observability import metrics, tracing
+    from paddle_tpu.observability.collector import maybe_announce
+    from paddle_tpu.parallel.pserver import VariableClient
+
+    maybe_announce("trainer")
+    # get-or-create the REAL trainer series (paddle_tpu.trainer may
+    # not be imported yet; same names, so a real Trainer would share)
+    steps = metrics.counter("paddle_tpu_trainer_steps_total",
+                            "training steps completed")
+    step_s = metrics.histogram(
+        "paddle_tpu_trainer_step_seconds",
+        "train-loop iteration wall latency (feed ready -> dispatch "
+        "done)")
+    client = VariableClient(args.endpoint, client_id="mini-fleet")
+    for i in range(args.rounds):
+        t0 = time.perf_counter()
+        with tracing.span("trainer.step", batch_id=i):
+            client.send_var("w@GRAD",
+                            np.full(8, 0.1, np.float32))
+            client.send_batch_barrier()
+            client.get_var("w")
+        steps.inc()
+        step_s.observe(time.perf_counter() - t0)
+        print(f"TRAINER_ROUND {i}", flush=True)
+        time.sleep(0.15)
+    print("TRAINER_DONE", flush=True)
+    # stay alive (and scrape-able, lease held) until the driver kills
+    # us — exiting releases the lease and delists the member, which
+    # would race the driver's final assertions
+    time.sleep(args.linger_s)
+    client.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _spawn(cmd, env, logf):
+    import queue
+    import threading
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=logf, text=True, cwd=REPO)
+    # a reader thread drains stdout into a queue so _wait_line can
+    # time out on a child that wedges WITHOUT printing (select() on
+    # the raw fd misses lines already pulled into the TextIOWrapper
+    # buffer, and a bare readline() blocks past any deadline)
+    proc._lines = queue.Queue()
+
+    def _drain():
+        for line in proc.stdout:
+            proc._lines.put(line)
+        proc._lines.put(None)  # EOF marker
+
+    threading.Thread(target=_drain, daemon=True).start()
+    return proc
+
+
+def _wait_line(proc, prefix, timeout_s, what):
+    import queue
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            line = proc._lines.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        if line is None:
+            raise SystemExit(f"{what}: exited before '{prefix}' "
+                             f"(rc {proc.poll()})")
+        print(f"  [{what}] {line.rstrip()}")
+        if line.startswith(prefix):
+            return line.split()
+    raise SystemExit(f"{what}: no '{prefix}' within {timeout_s}s")
+
+
+def _build_model_dir(workdir):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    import paddle_tpu.core.framework as fw
+    from paddle_tpu.models.transformer import build_lm_paged_decoder
+    from paddle_tpu.serving import save_generation_model
+
+    fw.reset_unique_names()
+    startup, dec = build_lm_paged_decoder(23, 4, 4, d_model=16,
+                                          n_heads=2, n_layers=1)
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    states = {n: np.asarray(scope.find_var(n))
+              for n in dec.state_names}
+    model_dir = os.path.join(workdir, "model")
+    save_generation_model(model_dir, states, {
+        "vocab_size": 23, "d_model": 16, "n_heads": 2, "n_layers": 1,
+        "block_size": 4, "max_blocks_per_seq": 4, "slots": 2,
+        "kv_blocks": 16})
+    return model_dir
+
+
+def driver(args):
+    from paddle_tpu.cli import format_fleet_table
+    from paddle_tpu.cloud.registry import Registry
+    from paddle_tpu.observability.collector import TelemetryCollector
+    from paddle_tpu.serving.replica import replica_call, replica_stream
+
+    workdir = tempfile.mkdtemp(prefix="paddle_mini_fleet_")
+    flight_dir = os.path.join(workdir, "flight")
+    trace_dir = os.path.join(workdir, "traces")
+    print(f"mini-fleet workdir: {workdir}")
+
+    registry = Registry()
+    reg_addr = f"127.0.0.1:{registry.serve(0)}"
+    coll = TelemetryCollector(registry_addr=reg_addr, period_s=0.3,
+                              scrape_timeout_s=1.0)
+
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PADDLE_TPU_METRICS="on",
+               PADDLE_TPU_TELEMETRY_REGISTRY=reg_addr,
+               PADDLE_TPU_FLIGHT_DIR=flight_dir,
+               PADDLE_TPU_TRACE_DIR=trace_dir)
+    logf = open(os.path.join(workdir, "children.log"), "w")
+    me = [sys.executable, os.path.abspath(__file__)]
+    procs = []
+    try:
+        pserver = _spawn(me + ["--role", "pserver",
+                               "--run_s", "600"], env, logf)
+        procs.append(pserver)
+        port = int(_wait_line(pserver, "PSERVER_PORT", 180,
+                              "pserver")[1])
+        # scrape THROUGH the traffic window: windowed rates/quantiles
+        # need samples on both sides of the counters moving
+        coll.start()
+
+        trainer = _spawn(me + ["--role", "trainer", "--endpoint",
+                               f"127.0.0.1:{port}",
+                               "--rounds", str(args.rounds)],
+                         env, logf)
+        procs.append(trainer)
+
+        model_dir = _build_model_dir(workdir)
+        replica = _spawn([sys.executable, "-m", "paddle_tpu.cli",
+                          "serve", model_dir, "--use_tpu", "0"],
+                         env, logf)
+        procs.append(replica)
+        line = _wait_line(replica, "serving ", 300, "replica")
+        replica_addr = line[3]
+
+        _wait_line(trainer, "TRAINER_DONE", 180, "trainer")
+
+        # a few generate streams so the serving series move, spaced so
+        # scrapes land between them
+        for i in range(4):
+            toks = list(replica_stream(
+                replica_addr,
+                {"op": "generate", "prompt": [1, 2, 3], "max_new": 5},
+                timeout_s=300))
+            assert toks, "replica generated nothing"
+            time.sleep(0.4)
+        print(f"  [driver] replica streamed 4 requests "
+              f"({len(toks)} tokens last)")
+        assert replica_call(replica_addr,
+                            {"op": "flight"})["ok"], "flight op"
+
+        time.sleep(0.5)
+        coll.scrape_once()  # one deterministic final sweep
+
+        members = coll.members()
+        kinds = {m["kind"] for m in members}
+        assert {"trainer", "pserver", "generation"} <= kinds, members
+        text = coll.federation_text()
+        for kind, series in (
+                ("pserver", "paddle_tpu_pserver_requests_total"),
+                ("trainer", "paddle_tpu_trainer_steps_total"),
+                ("generation",
+                 "paddle_tpu_serving_generation_requests_total")):
+            member = next(m["member"] for m in members
+                          if m["kind"] == kind)
+            assert f'kind="{kind}"' in text, f"no {kind} series"
+            assert f'member="{member}"' in text, f"no {member} label"
+            assert series in text, f"missing {series}"
+        print()
+        print(format_fleet_table(coll, window_s=60))
+        print()
+
+        out = coll.write_federation(args.out)
+        print(f"federated Prometheus dump -> {out} "
+              f"({len(text.splitlines())} lines, "
+              f"{len(members)} members)")
+
+        # flight-recorder recovery from a SIGKILLed pserver: the
+        # periodic flush (0.5 s) must have left its final seconds on
+        # disk — no handler runs for SIGKILL
+        time.sleep(1.5)
+        flight_path = os.path.join(flight_dir,
+                                   f"flight_{pserver.pid}.json")
+        os.kill(pserver.pid, signal.SIGKILL)
+        pserver.wait(timeout=30)
+        assert os.path.exists(flight_path), \
+            f"no flight dump at {flight_path}"
+        import json
+        with open(flight_path) as f:
+            dump = json.load(f)
+        span_names = {s["name"] for s in dump["spans"]}
+        assert any(n.startswith("pserver.") for n in span_names), \
+            span_names
+        print(f"flight dump recovered from SIGKILLed pserver: "
+              f"{len(dump['spans'])} spans, "
+              f"{len(dump['events'])} events")
+        print("mini-fleet: all green")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        coll.close()
+        registry.close()
+        logf.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--role", default="driver",
+                    choices=["driver", "pserver", "trainer"])
+    ap.add_argument("--out", default="/tmp/paddle_tpu_fleet.prom")
+    ap.add_argument("--endpoint", default="")
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--scrapes", type=int, default=8)
+    ap.add_argument("--run_s", type=float, default=600.0)
+    ap.add_argument("--linger_s", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    if args.role == "pserver":
+        return role_pserver(args)
+    if args.role == "trainer":
+        return role_trainer(args)
+    return driver(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
