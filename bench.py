@@ -50,7 +50,12 @@ KV-quantization residency table, and a Prometheus dump at
 BENCH_SERVING_PROM if set.  Knobs: BENCH_SERVING_PREFIX_POOL/
 _PREFIX_LEN/_PREFIX_HIT shape the shared-prefix workload,
 BENCH_SERVING_SPEC_K sets the draft length, BENCH_SERVING_SPEC=0 /
-BENCH_SERVING_QUANT=0 skip those sections).
+BENCH_SERVING_QUANT=0 skip those sections), BENCH_SERVING_RAMP=1
+(open-loop load ramp against a LIVE autoscaling fleet — router +
+autoscaler + `cli serve` replicas from a warm-start model dir: rate
+ramps up then down, reporting per-phase tokens/s and p99, the scaling
+timeline, zero-failed accounting, and new-replica warm-start stats;
+knobs BENCH_SERVING_RAMP_PEAK/_PHASE_S/_MAX).
 """
 import json
 import os
@@ -495,6 +500,14 @@ def main():
                 "0", "false", "no", "off"),
             with_quant=env("BENCH_SERVING_QUANT", "1").lower() not in (
                 "0", "false", "no", "off"))
+    if os.environ.get("BENCH_SERVING_RAMP", "0").lower() in (
+            "1", "true", "yes", "on"):
+        from run_serving import run_fleet_ramp_bench
+        env = os.environ.get
+        out["serving_ramp"] = run_fleet_ramp_bench(
+            peak_rps=float(env("BENCH_SERVING_RAMP_PEAK", "24")),
+            phase_s=float(env("BENCH_SERVING_RAMP_PHASE_S", "6")),
+            max_replicas=int(env("BENCH_SERVING_RAMP_MAX", "3")))
     if os.environ.get("BENCH_CONVERGENCE", "1").lower() not in (
             "0", "false", "no", "off"):
         conv = run_convergence()

@@ -391,6 +391,262 @@ def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
         obs_metrics.set_enabled(metrics_were_on)
 
 
+# ---------------------------------------------------------------------------
+# --ramp: open-loop load ramp against a LIVE autoscaling fleet
+# (the ROADMAP-4 acceptance driver; reused by tools/mini_fleet.py's
+# autoscale drill and tests/test_autoscaler.py)
+# ---------------------------------------------------------------------------
+
+
+def ramp_rates(peak_rps, floor_frac=0.25):
+    """The up-then-down open-loop schedule: floor -> half -> peak ->
+    half -> floor."""
+    return [peak_rps * floor_frac, peak_rps * 0.5, peak_rps,
+            peak_rps * 0.5, peak_rps * floor_frac]
+
+
+def run_ramp(submit, reqs, rates, phase_s, *, result_timeout_s=180.0,
+             deadline_ms=None, on_phase=None):
+    """Drive an open-loop up-then-down ramp through `submit(prompt,
+    max_new, deadline_ms=...) -> stream` (a GenerationServer or a
+    ReplicaRouter — the fleet path).  Arrivals follow the rate
+    schedule alone; each request is attributed to the phase it ARRIVED
+    in.  Returns per-phase tokens/s, p50/p99 completion latency and
+    shed rate, plus the totals the zero-failed acceptance pins:
+    `failed` counts non-shed errors (sheds are policy answers)."""
+    from paddle_tpu.serving import (RequestDeadlineExceeded,
+                                    ServerSaturated)
+
+    reqs = list(reqs)
+    results = []  # (phase, latency_or_None, ntokens, shed, failed)
+    rlock = threading.Lock()
+    waiters = []
+    it = iter(reqs)
+
+    def wait_for(phase, t0, stream):
+        lat = ntok = 0
+        shed = failed = False
+        try:
+            out = stream.result(timeout=result_timeout_s)
+            lat, ntok = time.perf_counter() - t0, len(out)
+        except (RequestDeadlineExceeded, ServerSaturated):
+            shed = True
+        except Exception:
+            failed = True
+        with rlock:
+            results.append((phase, lat if ntok else None, ntok, shed,
+                            failed))
+
+    t_start = time.perf_counter()
+    for phase, rate in enumerate(rates):
+        phase_t0 = time.perf_counter()
+        n_phase = max(1, int(rate * phase_s))
+        for i in range(n_phase):
+            target = phase_t0 + i / rate if rate > 0 else phase_t0
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            req = next(it, None)
+            if req is None:
+                it = iter(reqs)   # recycle the mix
+                req = next(it)
+            prompt, max_new = req
+            t0 = time.perf_counter()
+            try:
+                stream = submit(prompt, max_new,
+                                deadline_ms=deadline_ms)
+            except (ServerSaturated, RequestDeadlineExceeded):
+                with rlock:
+                    results.append((phase, None, 0, True, False))
+                continue
+            except Exception:
+                with rlock:
+                    results.append((phase, None, 0, False, True))
+                continue
+            w = threading.Thread(target=wait_for,
+                                 args=(phase, t0, stream), daemon=True)
+            w.start()
+            waiters.append(w)
+        left = phase_s - (time.perf_counter() - phase_t0)
+        if left > 0:
+            time.sleep(left)
+        if on_phase is not None:
+            on_phase(phase, rate)
+    for w in waiters:
+        w.join(timeout=result_timeout_s)
+    wall = time.perf_counter() - t_start
+
+    phases = []
+    for phase, rate in enumerate(rates):
+        rows = [r for r in results if r[0] == phase]
+        lats = [r[1] for r in rows if r[1] is not None]
+        toks = sum(r[2] for r in rows)
+        phases.append({
+            "phase": phase, "rate_rps": round(rate, 2),
+            "requests": len(rows),
+            "tokens_per_sec": round(toks / phase_s, 1),
+            "latency_p50_s": round(float(np.percentile(lats, 50)), 4)
+            if lats else None,
+            "latency_p99_s": round(float(np.percentile(lats, 99)), 4)
+            if lats else None,
+            "shed_rate": round(sum(r[3] for r in rows)
+                               / max(len(rows), 1), 4),
+        })
+    return {
+        "rates_rps": [round(r, 2) for r in rates],
+        "phase_s": phase_s,
+        "wall_s": round(wall, 2),
+        "requests": len(results),
+        "tokens": sum(r[2] for r in results),
+        "shed": sum(1 for r in results if r[3]),
+        "failed": sum(1 for r in results if r[4]),
+        "phases": phases,
+    }
+
+
+def run_fleet_ramp_bench(*, requests=64, peak_rps=20.0, phase_s=6.0,
+                         min_replicas=1, max_replicas=3,
+                         backlog_high=64.0, backlog_low=8.0,
+                         sustain_s=1.0, idle_sustain_s=4.0,
+                         cooldown_s=4.0, d_model=32, n_layers=1,
+                         n_heads=2, block_size=4, max_blocks=8,
+                         slots=2, kv_blocks=24, use_tpu=0,
+                         workdir=None, spawn_timeout_s=300.0,
+                         decode_delay_s=0.02, phase_hook=None,
+                         post_hook=None, env_extra=None):
+    """BENCH_SERVING_RAMP entry point: save a warm-start model dir,
+    front it with ReplicaRouter + Autoscaler spawning REAL `cli serve`
+    replicas, drive the open-loop ramp, and report per-phase serving
+    stats alongside the scaling timeline and each new replica's
+    cold-start accounting (spawn->live seconds; warm-started replicas
+    deserialize their executables, so the time-to-first-token of a
+    scale-out is bounded by model load, not XLA compile).
+
+    `decode_delay_s` arms a PADDLE_TPU_FAULTS delay rule on the
+    replicas' ``serving.decode`` chaos site: the bench model is tiny
+    (a laptop CPU decodes it at thousands of tokens/s), so the
+    injected per-tick latency stands in for a real accelerator's — it
+    makes the overload, and therefore the scale-out/scale-in
+    trajectory, deterministic across hosts.  Pass 0 to measure the
+    raw fleet instead.
+
+    Chaos-drill hooks (tools/mini_fleet.py --drill autoscale rides
+    this function rather than re-building the fleet):
+    `phase_hook(phase, rate, router, scaler)` fires after each ramp
+    phase (e.g. SIGKILL an owned replica at the peak);
+    `post_hook(record, router, scaler)` fires on the finished record
+    BEFORE teardown (the autoscaler/router metric series are reclaimed
+    on close, so a telemetry scrape must happen here); `env_extra`
+    merges into the replica environment."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu.cloud.autoscaler import (Autoscaler,
+                                             AutoscalerPolicy,
+                                             SubprocessReplicaLauncher)
+    from paddle_tpu.cloud.router import ReplicaRouter
+    from paddle_tpu.serving import save_generation_model
+    from paddle_tpu.serving.replica import replica_call
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="paddle_ramp_")
+    model_dir = os.path.join(workdir, "model")
+    max_len = block_size * max_blocks
+    dec, states = _build_decoder(d_model, n_layers, n_heads,
+                                 block_size, max_blocks)
+    t0 = time.perf_counter()
+    save_generation_model(
+        model_dir, states,
+        {"vocab_size": VOCAB, "d_model": d_model, "n_heads": n_heads,
+         "n_layers": n_layers, "block_size": block_size,
+         "max_blocks_per_seq": max_blocks, "slots": slots,
+         "kv_blocks": kv_blocks},
+        warm_start=True, place=fluid.CPUPlace())
+    artifact_s = round(time.perf_counter() - t0, 2)
+
+    router = ReplicaRouter(desired=max_replicas * 2, refresh_s=0.1)
+    policy = AutoscalerPolicy(
+        min_replicas, max_replicas, p99_high_s=30.0,
+        backlog_high=backlog_high, backlog_low=backlog_low,
+        sustain_s=sustain_s, idle_sustain_s=idle_sustain_s,
+        cooldown_s=cooldown_s)
+    extra = dict(env_extra or {})
+    if decode_delay_s > 0:
+        extra["PADDLE_TPU_FAULTS"] = ",".join(filter(None, [
+            extra.get("PADDLE_TPU_FAULTS",
+                      os.environ.get("PADDLE_TPU_FAULTS", "")),
+            f"serving.decode:delay:1:1000000000:{decode_delay_s}"]))
+    env = dict(os.environ, **extra) if extra else None
+    launcher = SubprocessReplicaLauncher(
+        model_dir, router.registry_addr, use_tpu=use_tpu, ttl_s=1.5,
+        drain_grace_s=30.0, env=env)
+    scaler = Autoscaler(router, launcher, policy, poll_s=0.2,
+                        window_s=8.0,
+                        spawn_timeout_s=spawn_timeout_s,
+                        drain_grace_s=30.0)
+    reqs = make_requests(requests, max_len, np.random.RandomState(0))
+    fleet_sizes = []
+
+    def _on_phase(p, r):
+        fleet_sizes.append(
+            len(router.live_replicas(include_draining=False)))
+        if phase_hook is not None:
+            phase_hook(p, r, router, scaler)
+
+    try:
+        scaler.ensure_min(timeout_s=spawn_timeout_s)
+        scaler.start()
+        ramp = run_ramp(
+            router.submit, reqs, ramp_rates(peak_rps), phase_s,
+            on_phase=_on_phase)
+        # ramp-down tail: give the idle-sustain window room to retire
+        deadline = time.monotonic() + 4 * (idle_sustain_s
+                                           + cooldown_s) + 30
+        while (len(router.live_replicas(include_draining=False))
+               > min_replicas and time.monotonic() < deadline):
+            time.sleep(0.2)
+        replicas = {}
+        for addr in router.live_replicas():
+            try:
+                st = replica_call(addr, {"op": "stats"},
+                                  timeout_s=10)["stats"]
+                replicas[addr] = {
+                    "warm_start": st.get("warm_start"),
+                    "warmup_s": st.get("warmup_s"),
+                    "compile_seconds": st.get("compile_seconds"),
+                    "cache_hits": st.get("cache_hits"),
+                    "cache_misses": st.get("cache_misses"),
+                    "recompiles_after_warmup":
+                        st.get("recompiles_after_warmup"),
+                }
+            except OSError:
+                pass
+        out = {
+            "bench": "serving_ramp",
+            "peak_rps": peak_rps, "phase_s": phase_s,
+            "decode_delay_s": decode_delay_s,
+            "band": [min_replicas, max_replicas],
+            "artifact_build_s": artifact_s,
+            "ramp": ramp,
+            "fleet_size_per_phase": fleet_sizes,
+            "fleet_size_final": len(
+                router.live_replicas(include_draining=False)),
+            "scale_events": list(scaler.events),
+            "status": scaler.status(),
+            "replicas": replicas,
+            "router": router.stats(),
+        }
+        if post_hook is not None:
+            post_hook(out, router, scaler)
+        return out
+    finally:
+        scaler.close(retire_owned=True)
+        router.close()
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=48)
@@ -419,7 +675,28 @@ def main():
                     help="skip the KV-quantization residency section")
     ap.add_argument("--prom_out", default="",
                     help="write the Prometheus text dump here")
+    ap.add_argument("--ramp", action="store_true",
+                    help="instead of the ablation ladder, run the "
+                    "open-loop load ramp against a LIVE autoscaling "
+                    "fleet (router + autoscaler + `cli serve` "
+                    "replicas): rate ramps up then down, reporting "
+                    "per-phase tokens/s, p99, shed rate, the scaling "
+                    "timeline, and new-replica warm-start accounting")
+    ap.add_argument("--ramp-peak", type=float, default=24.0,
+                    help="peak arrival rate req/s at the ramp top")
+    ap.add_argument("--ramp-phase-s", type=float, default=6.0)
+    ap.add_argument("--ramp-max", type=int, default=3,
+                    help="max replicas the autoscaler may spawn")
     a = ap.parse_args()
+    if a.ramp:
+        out = run_fleet_ramp_bench(
+            requests=a.requests, peak_rps=a.ramp_peak,
+            phase_s=a.ramp_phase_s, max_replicas=a.ramp_max,
+            d_model=a.d_model, n_layers=a.layers, n_heads=a.heads,
+            block_size=a.block_size, max_blocks=a.max_blocks,
+            slots=a.slots)
+        print(json.dumps(out))
+        return
     out = run_serving_bench(
         requests=a.requests, rate_rps=a.rate, slots=a.slots,
         kv_blocks=a.kv_blocks, block_size=a.block_size,

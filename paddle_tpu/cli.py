@@ -625,6 +625,17 @@ def cmd_serve(argv):
                     "with (kind 'generation')")
     ap.add_argument("--ttl", type=float, default=2.0,
                     help="registry lease TTL seconds")
+    ap.add_argument("--drain_grace", "--drain-grace", type=float,
+                    default=30.0, dest="drain_grace",
+                    help="graceful-SIGTERM drain budget seconds: on "
+                    "SIGTERM the replica stops admission, releases "
+                    "its lease, finishes in-flight streams within "
+                    "this budget, delists telemetry, then exits "
+                    "(docs/serving.md 'Autoscaling')")
+    ap.add_argument("--cold", action="store_true",
+                    help="ignore the model dir's warm-start xla_cache "
+                    "artifact (compile from scratch — the baseline "
+                    "the artifact is measured against)")
     ap.add_argument("--telemetry",
                     default=os.environ.get(
                         "PADDLE_TPU_TELEMETRY_REGISTRY", ""),
@@ -645,12 +656,26 @@ def cmd_serve(argv):
         kv_dtype=args.kv_dtype or None,
         spec_k=args.spec_k or None,
         use_draft=not args.no_draft,
+        warm_start=not args.cold,
         place=_place(args.use_tpu))
     rep = ReplicaServer(server, port=args.port, host=args.host,
                         registry_addr=args.registry or None,
-                        ttl_s=args.ttl)
+                        ttl_s=args.ttl,
+                        drain_grace_s=args.drain_grace,
+                        own_announcement=True)
+    # graceful scale-in: SIGTERM drains before exit, chaining onto the
+    # flight recorder's dump handler when PADDLE_TPU_FLIGHT_DIR is set
+    rep.install_sigterm()
     suffix = (f", registered in {args.registry}" if args.registry
               else "")
+    ws = server.warmup_stats
+    if server.warm_start_dir:
+        suffix += (f" (warm start: {ws['cache_hits']} executables "
+                   f"deserialized, {ws['cache_misses']} compiled, "
+                   f"warmup {ws['warmup_s']:.2f}s)")
+    else:
+        suffix += (f" (cold start: {ws['compiles']} compiles, "
+                   f"warmup {ws['warmup_s']:.2f}s)")
     print(f"serving {args.model_dir} on {rep.addr}{suffix}", flush=True)
     try:
         rep.wait()
@@ -659,6 +684,102 @@ def cmd_serve(argv):
     finally:
         rep.close()
         server.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# `autoscale` subcommand: the self-scaling serving front door
+# ---------------------------------------------------------------------------
+
+
+def cmd_autoscale(argv):
+    """`python -m paddle_tpu.cli autoscale MODEL_DIR [--min 1 --max 4]`
+    — run the ROADMAP-4 front door: a ReplicaRouter (hosting the
+    TTL-lease replica registry unless --registry joins an existing
+    one) plus an Autoscaler that spawns/retires `cli serve` replicas
+    of MODEL_DIR from the router's windowed backlog/p99 signals
+    (docs/serving.md "Autoscaling").  Prints a status line every
+    --status_period seconds until interrupted; on exit the spawned
+    replicas are retired gracefully."""
+    import time as _time
+
+    from paddle_tpu.cloud.autoscaler import (Autoscaler,
+                                             AutoscalerPolicy,
+                                             SubprocessReplicaLauncher)
+    from paddle_tpu.cloud.router import ReplicaRouter
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli autoscale",
+        description="signal-driven autoscaling serving fleet")
+    ap.add_argument("model_dir", help="save_generation_model output "
+                    "dir (ship it with warm_start=True so scale-out "
+                    "replicas skip XLA compile)")
+    ap.add_argument("--registry", default="",
+                    help="join an existing replica registry instead "
+                    "of hosting one")
+    ap.add_argument("--min", type=int, default=1, dest="min_replicas")
+    ap.add_argument("--max", type=int, default=4, dest="max_replicas")
+    ap.add_argument("--p99_high", type=float, default=2.0,
+                    help="scale-out latency target seconds")
+    ap.add_argument("--backlog_high", type=float, default=512,
+                    help="scale-out reserved-token backlog threshold")
+    ap.add_argument("--backlog_low", type=float, default=32,
+                    help="scale-in idle backlog threshold (hysteresis "
+                    "floor)")
+    ap.add_argument("--sustain", type=float, default=3.0,
+                    help="seconds the hot signal must hold")
+    ap.add_argument("--idle_sustain", type=float, default=10.0,
+                    help="seconds the cold signal must hold")
+    ap.add_argument("--cooldown", type=float, default=15.0,
+                    help="refractory seconds after any scale action")
+    ap.add_argument("--poll", type=float, default=0.5)
+    ap.add_argument("--window", type=float, default=15.0,
+                    help="signal window seconds (router.signals)")
+    ap.add_argument("--drain_grace", "--drain-grace", type=float,
+                    default=30.0, dest="drain_grace")
+    ap.add_argument("--spawn_timeout", type=float, default=300.0)
+    ap.add_argument("--status_period", type=float, default=5.0)
+    ap.add_argument("--use_tpu", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    policy = AutoscalerPolicy(
+        args.min_replicas, args.max_replicas,
+        p99_high_s=args.p99_high, backlog_high=args.backlog_high,
+        backlog_low=args.backlog_low, sustain_s=args.sustain,
+        idle_sustain_s=args.idle_sustain, cooldown_s=args.cooldown)
+    router = ReplicaRouter(registry_addr=args.registry or None,
+                           desired=max(args.max_replicas * 2, 8))
+    launcher = SubprocessReplicaLauncher(
+        args.model_dir, router.registry_addr, use_tpu=args.use_tpu,
+        drain_grace_s=args.drain_grace)
+    scaler = Autoscaler(router, launcher, policy, poll_s=args.poll,
+                        window_s=args.window,
+                        spawn_timeout_s=args.spawn_timeout,
+                        drain_grace_s=args.drain_grace)
+    print(f"autoscale: fronting {args.model_dir}; replica registry at "
+          f"{router.registry_addr} (band {args.min_replicas}.."
+          f"{args.max_replicas})", flush=True)
+    try:
+        # inside the try: a Ctrl-C during the cold boot (the floor
+        # replica can take minutes on the compile path) must still
+        # reach the finally and retire whatever was already spawned
+        scaler.ensure_min()
+        scaler.start()
+        while True:
+            _time.sleep(args.status_period)
+            st = scaler.status()
+            sig = router.signals(args.window)
+            print(f"autoscale: live={len(st['live'])} "
+                  f"pending={st['pending_spawns']} "
+                  f"qps={_fmt_stat(sig['qps'])} "
+                  f"p99={_fmt_stat(sig['p99'], '{:.4g}')} "
+                  f"backlog={_fmt_stat(sig['outstanding_tokens'])} "
+                  f"| {st['last_event']}", flush=True)
+    except KeyboardInterrupt:
+        print("autoscale: retiring owned replicas", flush=True)
+    finally:
+        scaler.close(retire_owned=True)
+        router.close()
     return 0
 
 
@@ -1156,7 +1277,8 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     subcommands = {"verify": cmd_verify, "analyze": cmd_analyze,
                    "metrics": cmd_metrics, "trace": cmd_trace,
-                   "serve": cmd_serve, "concurrency": cmd_concurrency,
+                   "serve": cmd_serve, "autoscale": cmd_autoscale,
+                   "concurrency": cmd_concurrency,
                    "top": cmd_top, "slo": cmd_slo}
     if argv and argv[0] in subcommands:
         sys.exit(subcommands[argv[0]](argv[1:]))
@@ -1164,8 +1286,8 @@ def main(argv=None):
         prog="paddle_tpu.cli",
         description="legacy `paddle train` workflow over Program/Executor"
         " (plus subcommands: `python -m paddle_tpu.cli "
-        "verify|analyze|concurrency|metrics|trace|serve|top|slo "
-        "--help`)")
+        "verify|analyze|concurrency|metrics|trace|serve|autoscale|"
+        "top|slo --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

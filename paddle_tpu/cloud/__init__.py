@@ -10,15 +10,23 @@ cgo there.
 from .master import Master, MasterClient, task_record_reader
 
 __all__ = ["Master", "MasterClient", "task_record_reader",
-           "ReplicaRouter", "NoReplicasAvailable"]
+           "ReplicaRouter", "NoReplicasAvailable",
+           "Autoscaler", "AutoscalerPolicy",
+           "SubprocessReplicaLauncher"]
 
 
 def __getattr__(name):
-    # the serving front door (cloud/router.py) pulls in the whole
-    # serving subsystem; load it on first use so cloud-only users
-    # (masters, pservers, cluster controllers) stay light
+    # the serving front door (cloud/router.py, cloud/autoscaler.py)
+    # pulls in the whole serving subsystem; load it on first use so
+    # cloud-only users (masters, pservers, cluster controllers) stay
+    # light
     if name in ("ReplicaRouter", "NoReplicasAvailable"):
         from . import router
 
         return getattr(router, name)
+    if name in ("Autoscaler", "AutoscalerPolicy",
+                "SubprocessReplicaLauncher"):
+        from . import autoscaler
+
+        return getattr(autoscaler, name)
     raise AttributeError(name)

@@ -131,6 +131,12 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {}
         self._demoted: Dict[str, float] = {}
+        # addresses an operator/autoscaler marked as draining: still
+        # live (their in-flight streams keep completing) but excluded
+        # from placement so the drain converges.  Keyed by address, not
+        # _Replica, so the mark survives table re-lists and applies to
+        # replicas not yet discovered.
+        self._draining: set = set()
         self._last_refresh = 0.0
         self._closed = False
         rid = self._rid = str(next(_ROUTER_IDS))
@@ -171,6 +177,9 @@ class ReplicaRouter:
             for addr in list(self._replicas):
                 if addr not in listed:
                     del self._replicas[addr]
+                    # a retired replica's drain mark must not outlive
+                    # it: the same host:port may serve a future replica
+                    self._draining.discard(addr)
             # a demotion outlives the TTL only if the registry still
             # lists the member; expire stale demotions so a RESTARTED
             # replica on the same address gets traffic again
@@ -183,7 +192,8 @@ class ReplicaRouter:
 
     def _pick_locked(self) -> Optional[_Replica]:
         live = [r for a, r in self._replicas.items()
-                if a not in self._demoted and not r.swapping]
+                if a not in self._demoted and not r.swapping
+                and a not in self._draining]
         if not live:
             return None
         return min(live, key=lambda r: r.outstanding)
@@ -193,11 +203,34 @@ class ReplicaRouter:
             self._demoted[addr] = time.monotonic() + self._demote_s
             self._last_refresh = 0.0  # force a re-list on next pick
 
-    def live_replicas(self) -> List[str]:
-        self._refresh(force=True)
+    def set_draining(self, addr: str, draining: bool = True) -> None:
+        """Mark/unmark one replica as draining: it stays in the table
+        (its in-flight streams keep their per-token accounting) but
+        receives no new placements.  The autoscaler marks its scale-in
+        victim before sending the replica `drain` verb so the router
+        converges instead of racing fresh requests onto it."""
+        with self._lock:
+            if draining:
+                self._draining.add(addr)
+            else:
+                self._draining.discard(addr)
+
+    def live_replicas(self, include_draining: bool = True,
+                      refresh: bool = True) -> List[str]:
+        """Registry-live replica addresses (demotions excluded).  The
+        autoscaler's capacity/invariant checks pass
+        ``include_draining=False``: a draining replica still answers
+        its accepted streams but is no longer serving capacity.
+        ``refresh=False`` reads the table as of the last re-list — for
+        a caller that just forced one and wants a second view of the
+        SAME listing instead of another registry round-trip."""
+        if refresh:
+            self._refresh(force=True)
         with self._lock:
             return sorted(a for a in self._replicas
-                          if a not in self._demoted)
+                          if a not in self._demoted
+                          and (include_draining
+                               or a not in self._draining))
 
     # -- request path -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int, *,
@@ -390,7 +423,11 @@ class ReplicaRouter:
             "outstanding_tokens": series.latest(
                 "paddle_tpu_serving_router_outstanding_tokens",
                 labels=lbl),
-            "replicas_live": len(self.live_replicas()),
+            # as-of-last-re-list: a gauge in a SIGNAL summary must not
+            # cost a forced registry round-trip per read (the autoscaler
+            # polls signals() right after its own forced listing; the
+            # request path re-lists on every pick anyway)
+            "replicas_live": len(self.live_replicas(refresh=False)),
         }
 
     def stats(self) -> dict:
@@ -399,6 +436,7 @@ class ReplicaRouter:
                 "replicas": {a: r.outstanding
                              for a, r in self._replicas.items()},
                 "demoted": sorted(self._demoted),
+                "draining": sorted(self._draining),
                 "requests_ok": int(self._m_ok.value),
                 "requests_shed": int(self._m_shed.value),
                 "requests_failed": int(self._m_failed.value),

@@ -306,6 +306,66 @@ flags_mod.on_flag_change("compilation_cache_dir",
 
 
 # ---------------------------------------------------------------------------
+# process-wide XLA compile accounting (jax monitoring events)
+# ---------------------------------------------------------------------------
+
+# Every backend-compile request in this jax records a
+# '/jax/core/compile/backend_compile_duration' event (the duration is
+# the XLA compile, or the much cheaper persistent-cache deserialization
+# on a hit), and with the persistent cache armed every request
+# additionally records a cache_hits/cache_misses event.  Counting them
+# gives an exact, backend-level "did anything compile?" signal that the
+# serving warm-start contract pins (recompiles_after_warmup == 0 for a
+# replica started from a shipped xla_cache artifact) — jit tracing
+# alone cannot distinguish a real compile from a cache deserialization.
+_xla_compile_counts = {"compiles": 0, "compile_seconds": 0.0,
+                       "cache_hits": 0, "cache_misses": 0}
+_xla_listeners_installed = False
+
+
+def _install_xla_event_listeners():
+    global _xla_listeners_installed
+    if _xla_listeners_installed:
+        return
+    _xla_listeners_installed = True
+    try:
+        from jax._src import monitoring as jax_monitoring
+    except Exception as e:  # monitoring module moved in this jax
+        _note_cache_config_issue("jax._src.monitoring", e)
+        return
+
+    def _on_event(name, **kw):
+        if name == "/jax/compilation_cache/cache_hits":
+            _xla_compile_counts["cache_hits"] += 1
+        elif name == "/jax/compilation_cache/cache_misses":
+            _xla_compile_counts["cache_misses"] += 1
+
+    def _on_duration(name, secs, **kw):
+        if name == "/jax/core/compile/backend_compile_duration":
+            _xla_compile_counts["compiles"] += 1
+            _xla_compile_counts["compile_seconds"] += float(secs)
+
+    try:
+        jax_monitoring.register_event_listener(_on_event)
+        jax_monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:
+        _note_cache_config_issue("monitoring listener registration", e)
+
+
+def xla_compile_counts() -> Dict[str, float]:
+    """Snapshot of this process's XLA compile activity: `compiles`
+    (backend compile requests — each is a real XLA compile or a
+    persistent-cache deserialization), `compile_seconds` (wall time
+    inside those requests), and `cache_hits`/`cache_misses` (persistent
+    compilation cache outcomes; both stay 0 while the cache is
+    disabled).  Counters are process-wide and monotonic — take a
+    snapshot before an operation and diff after it (what
+    GenerationServer's warm-start accounting does)."""
+    _install_xla_event_listeners()
+    return dict(_xla_compile_counts)
+
+
+# ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
 

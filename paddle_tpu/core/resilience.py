@@ -299,6 +299,11 @@ class FaultInjector:
         self._rules: List[FaultRule] = []
         self._calls: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # rate limiter for the fired-fault log line + eager flight
+        # dump: a high-frequency rule (e.g. a per-decode-tick delay
+        # simulating a slow accelerator) must not hose the disk or the
+        # log — the metric counter still counts every firing
+        self._last_note: Dict[tuple, float] = {}
 
     # -- configuration ------------------------------------------------------
     def inject(self, site: str, kind: str = "error", nth: int = 1,
@@ -315,6 +320,7 @@ class FaultInjector:
         with self._lock:
             self._rules = []
             self._calls = {}
+            self._last_note = {}
 
     def rules(self) -> List[FaultRule]:
         with self._lock:
@@ -367,9 +373,14 @@ class FaultInjector:
                     return rule
         return None
 
-    @staticmethod
-    def _note_fired(site: str, rule: FaultRule):
+    def _note_fired(self, site: str, rule: FaultRule):
         _M_FAULTS.labels(site=site, kind=rule.kind).inc()
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_note.get((site, rule.kind), float("-inf"))
+            if now - last < 1.0:
+                return  # noted within the last second: count only
+            self._last_note[(site, rule.kind)] = now
         _LOG.warning("fault injected at %s: kind=%s (rule %r)",
                      site, rule.kind, rule)
         try:

@@ -64,6 +64,10 @@ ALIASES = {
     "serving.kv_util": "paddle_tpu_serving_kv_pool_utilization",
     "serving.requests": "paddle_tpu_serving_generation_requests_total",
     "router": "paddle_tpu_serving_router_request_seconds",
+    "router.failed": "paddle_tpu_serving_router_requests_total",
+    "fleet.replicas": "paddle_tpu_autoscaler_replicas_live",
+    "fleet.crashloops": "paddle_tpu_autoscaler_crashloops_total",
+    "fleet.spawn": "paddle_tpu_autoscaler_spawn_seconds",
     "pserver.barrier_wait": "paddle_tpu_pserver_barrier_wait_seconds",
     "pserver.optimize": "paddle_tpu_pserver_optimize_seconds",
     "pserver.requests": "paddle_tpu_pserver_requests_total",

@@ -76,11 +76,20 @@ from .batching import RequestDeadlineExceeded, ServerSaturated
 from .kv_cache import KVPoolExhausted, PagedKVCache
 
 __all__ = ["GenerationServer", "GenerationStream",
-           "save_generation_model", "load_generation_model"]
+           "save_generation_model", "load_generation_model",
+           "build_warm_start_artifact"]
 
 MODEL_SPEC_FILENAME = "generation.json"
 MODEL_PARAMS_FILENAME = "generation_params.npz"
 MODEL_DRAFT_PARAMS_FILENAME = "generation_draft_params.npz"
+# the warm-start artifact: a persistent XLA compilation cache shipped
+# NEXT TO the model (save_generation_model(warm_start=True) /
+# build_warm_start_artifact).  A scale-out replica started from the dir
+# points PADDLE_TPU_COMPILATION_CACHE_DIR at it and DESERIALIZES the
+# serving executables instead of compiling them, so its time-to-first-
+# token is bounded by model load, not XLA compile (docs/serving.md
+# "Autoscaling").
+WARM_START_DIRNAME = "xla_cache"
 
 _SERVER_IDS = itertools.count()
 # stats()-backing series are always=True (the stats contract predates
@@ -402,8 +411,12 @@ class GenerationServer:
         self._max_queue = int(max_queue)
         self._lock = threading.Condition()
         self._stop = False
+        self._draining = False
         self._pending_states = None
         self._swap_done = threading.Event()
+        # which warm-start artifact (if any) fed this server's warmup;
+        # server_from_model_dir sets it for ping/stats introspection
+        self.warm_start_dir: Optional[str] = None
 
         self._m_requests = _M_REQUESTS.labels(server=sid)
         self._m_tokens = _M_TOKENS.labels(server=sid)
@@ -418,7 +431,26 @@ class GenerationServer:
         self._m_proposed = _M_DRAFT_PROPOSED.labels(server=sid)
         self._m_accepted = _M_DRAFT_ACCEPTED.labels(server=sid)
 
+        from ..core.executor import xla_compile_counts
+
+        c0 = xla_compile_counts()
+        t0 = time.perf_counter()
         self._warmup()
+        c1 = xla_compile_counts()
+        # warm-start accounting (process-wide counters, diffed around
+        # THIS warmup): cache_misses == 0 with hits > 0 means every
+        # serving executable deserialized from a warm-start artifact —
+        # the cold-start contract ROADMAP 4's autoscaler relies on
+        self.warmup_stats = {
+            "warmup_s": round(time.perf_counter() - t0, 4),
+            "compiles": int(c1["compiles"] - c0["compiles"]),
+            "compile_seconds": round(
+                c1["compile_seconds"] - c0["compile_seconds"], 4),
+            "cache_hits": int(c1["cache_hits"] - c0["cache_hits"]),
+            "cache_misses": int(c1["cache_misses"]
+                                - c0["cache_misses"]),
+        }
+        self._compiles_after_warmup_base = int(c1["compiles"])
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -489,6 +521,14 @@ class GenerationServer:
         with self._lock:
             if self._stop:
                 raise RuntimeError("GenerationServer is closed")
+            if self._draining:
+                # retryable by contract: the replica front maps
+                # RuntimeError to a non-fatal wire error, so a router
+                # resubmits on a survivor — a draining replica sheds
+                # ADMISSION, never an accepted request
+                raise RuntimeError(
+                    "GenerationServer is draining (graceful scale-in/"
+                    "shutdown): submit on another replica")
             if len(self._queue) >= self._max_queue:
                 self._m_shed.inc()
                 raise ServerSaturated(
@@ -554,13 +594,73 @@ class GenerationServer:
             return self._swap_done.wait(timeout)
         return True
 
+    # -- graceful drain (scale-in / SIGTERM) --------------------------------
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def drain(self, wait: bool = True,
+              timeout: Optional[float] = None) -> bool:
+        """Stop ADMITTING new requests and (with `wait`) block until
+        everything already accepted — active slots AND the queue — has
+        run to completion.  This is the graceful-scale-in half of the
+        PR 8 hot-swap machinery: a drained replica has delivered every
+        stream it ever accepted, so retiring it afterwards fails
+        nothing.  New submits raise RuntimeError (mapped to a
+        RETRYABLE wire error by serving/replica.py, so a router
+        resubmits on a survivor).  Returns True when fully drained
+        within `timeout`; `resume()` re-opens admission for an aborted
+        scale-in."""
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("GenerationServer is closed")
+            self._draining = True
+            self._lock.notify_all()
+        if not wait:
+            return True
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._lock:
+            while not self._stop and (
+                    self._queue
+                    or any(s is not None for s in self._active)):
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    return False
+                # the scheduler notifies on evictions; the short cap
+                # also covers the error-eviction path, which doesn't
+                self._lock.wait(timeout=min(0.05, left)
+                                if left is not None else 0.05)
+            return not self._stop
+
+    def resume(self) -> None:
+        """Re-open admission after drain() (an aborted scale-in: the
+        at-least-one-replica invariant found no survivor to retire
+        onto)."""
+        with self._lock:
+            self._draining = False
+            self._lock.notify_all()
+
     def stats(self) -> Dict[str, float]:
         """Serving telemetry view (docs/serving.md): request/token/tick
         counters, shed accounting, live occupancy, KV-pool state,
         prefix-cache hit accounting and speculative accept rates."""
+        from ..core.executor import xla_compile_counts
+
         with self._lock:
             active = sum(1 for s in self._active if s is not None)
             qdepth = len(self._queue)
+            draining = self._draining
+        # process-wide compile counter diffed against this server's
+        # post-warmup base: 0 == no XLA compile has happened since
+        # warmup (the serving-side analogue of Executor.cache_stats()'s
+        # recompiles_after_warmup; in a one-server process — a `cli
+        # serve` replica — any nonzero value is a compile paid inside
+        # request latency)
+        recompiles = int(xla_compile_counts()["compiles"]
+                         - self._compiles_after_warmup_base)
         out = {"requests": int(self._m_requests.value),
                "generated_tokens": int(self._m_tokens.value),
                "ticks": int(self._m_ticks.value),
@@ -577,7 +677,11 @@ class GenerationServer:
                                      * self._cache.bytes_per_block),
                "draft_proposed": int(self._m_proposed.value),
                "draft_accepted": int(self._m_accepted.value),
-               "spec_k": self._spec_k if self._draft is not None else 0}
+               "spec_k": self._spec_k if self._draft is not None else 0,
+               "draining": draining,
+               "recompiles_after_warmup": recompiles,
+               "warm_start": bool(self.warm_start_dir)}
+        out.update(self.warmup_stats)
         out.update(self._cache.prefix_stats())
         return out
 
@@ -989,7 +1093,9 @@ class GenerationServer:
 
 def save_generation_model(dirname: str, states: Dict[str, np.ndarray],
                           spec: Dict,
-                          draft_states: Optional[Dict] = None) -> str:
+                          draft_states: Optional[Dict] = None,
+                          warm_start: bool = False,
+                          place=None) -> str:
     """Persist a generation model: `generation.json` (architecture
     spec: vocab_size/d_model/n_heads/n_layers/d_inner, plus optional
     serving defaults block_size/max_blocks_per_seq/slots/kv_blocks/
@@ -999,7 +1105,14 @@ def save_generation_model(dirname: str, states: Dict[str, np.ndarray],
     name its architecture ({d_model, n_heads, n_layers[, d_inner]};
     vocab and block geometry are shared with the target).  The
     directory is what `cli serve` and the replica hot-swap verb
-    consume."""
+    consume.
+
+    `warm_start=True` additionally ships the cold-start artifact: the
+    serving executables are compiled once, at save time, into a
+    persistent XLA compilation cache at ``<dirname>/xla_cache``
+    (build_warm_start_artifact).  A replica later started from the dir
+    deserializes them — its time-to-first-token is bounded by model
+    load, not XLA compile."""
     os.makedirs(dirname, exist_ok=True)
     for key in ("vocab_size", "d_model", "n_heads", "n_layers"):
         if key not in spec:
@@ -1019,6 +1132,11 @@ def save_generation_model(dirname: str, states: Dict[str, np.ndarray],
         json.dump(spec, f, indent=1, sort_keys=True)
     np.savez(os.path.join(dirname, MODEL_PARAMS_FILENAME),
              **{n: np.asarray(v) for n, v in states.items()})
+    if warm_start:
+        # the ROADMAP-4 cold-start enabler: compile the serving
+        # executables ONCE at save time into <dirname>/xla_cache so
+        # every scale-out replica deserializes instead of compiling
+        build_warm_start_artifact(dirname, place=place)
     return dirname
 
 
@@ -1039,6 +1157,22 @@ def load_generation_model(dirname: str, with_draft: bool = False):
     return states, spec, draft_states
 
 
+def build_warm_start_artifact(dirname: str, place=None) -> str:
+    """Grow a saved generation model dir's warm-start artifact: build
+    its serving decoder(s) and run the server warmup with the
+    persistent XLA compilation cache pointed at
+    ``<dirname>/xla_cache``, so the compiled executables serialize
+    next to the parameters they serve.  The executables are keyed by
+    shape, so the artifact covers the SPEC's serving geometry
+    (slots/kv_blocks/block_size/...); a replica started with overrides
+    compiles those shapes fresh.  Returns the artifact path."""
+    cache = os.path.join(dirname, WARM_START_DIRNAME)
+    srv = server_from_model_dir(dirname, place=place,
+                                warm_cache_dir=cache)
+    srv.close()
+    return cache
+
+
 def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
                           max_blocks_per_seq: Optional[int] = None,
                           slots: Optional[int] = None,
@@ -1046,6 +1180,8 @@ def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
                           kv_dtype: Optional[str] = None,
                           spec_k: Optional[int] = None,
                           use_draft: bool = True,
+                          warm_start: bool = True,
+                          warm_cache_dir: Optional[str] = None,
                           **kw) -> GenerationServer:
     """Build a GenerationServer from a saved model dir.
 
@@ -1053,10 +1189,33 @@ def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
     under the names the parameters were saved with — intended for
     fresh serving processes (cli serve, replicas), not mid-session.
     `kv_dtype` overrides the spec's pool precision; a model dir with
-    draft params arms speculative decoding unless `use_draft=False`."""
+    draft params arms speculative decoding unless `use_draft=False`.
+
+    When the dir ships a warm-start artifact (``xla_cache/``, written
+    by ``save_generation_model(warm_start=True)``) and no persistent
+    compilation cache is already configured, the build+warmup runs
+    with PADDLE_TPU_COMPILATION_CACHE_DIR pointed at the artifact and
+    the executables DESERIALIZE instead of compiling
+    (``warmup_stats['cache_misses'] == 0``); the prior flag value is
+    restored afterwards.  ``warm_start=False`` opts out;
+    ``warm_cache_dir`` forces a cache dir (creating it — how
+    build_warm_start_artifact writes the artifact in the first
+    place)."""
+    from ..core import flags as core_flags
     from ..core import framework as fw
     from ..models.transformer import build_lm_paged_decoder
 
+    cache = warm_cache_dir or ""
+    if not cache and warm_start:
+        shipped = os.path.join(dirname, WARM_START_DIRNAME)
+        if os.path.isdir(shipped):
+            cache = shipped
+    prev = core_flags.get_flag("compilation_cache_dir")
+    # an EXPLICIT warm_cache_dir always arms (build_warm_start_artifact
+    # must write the artifact even when the operator runs with a global
+    # cache configured); the shipped-artifact auto-arm never stomps a
+    # configured cache
+    armed = bool(cache) and (warm_cache_dir is not None or not prev)
     states, spec, draft_states = load_generation_model(
         dirname, with_draft=True)
     bs = int(block_size or spec.get("block_size", 16))
@@ -1064,25 +1223,36 @@ def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
              or spec.get("max_blocks_per_seq",
                          -(-int(spec.get("max_len", 256)) // bs)))
     kvd = kv_dtype or spec.get("kv_dtype")
-    fw.reset_unique_names()
-    _, decoder = build_lm_paged_decoder(
-        spec["vocab_size"], bs, nb, d_model=spec["d_model"],
-        n_heads=spec["n_heads"], n_layers=spec["n_layers"],
-        d_inner=spec.get("d_inner"), kv_dtype=kvd)
-    draft_decoder = None
-    if draft_states is not None and use_draft:
-        dspec = spec["draft"]
+    try:
+        if armed:
+            core_flags.set_flags({"compilation_cache_dir": cache})
         fw.reset_unique_names()
-        _, draft_decoder = build_lm_paged_decoder(
-            spec["vocab_size"], bs, nb, d_model=dspec["d_model"],
-            n_heads=dspec["n_heads"], n_layers=dspec["n_layers"],
-            d_inner=dspec.get("d_inner"), kv_dtype=kvd)
-    else:
-        draft_states = None
-    return GenerationServer(
-        decoder, states,
-        slots=int(slots or spec.get("slots", 8)),
-        kv_blocks=int(kv_blocks or spec.get("kv_blocks", 64)),
-        draft_decoder=draft_decoder, draft_states=draft_states,
-        spec_k=(spec_k if spec_k is not None
-                else spec.get("spec_k")), **kw)
+        _, decoder = build_lm_paged_decoder(
+            spec["vocab_size"], bs, nb, d_model=spec["d_model"],
+            n_heads=spec["n_heads"], n_layers=spec["n_layers"],
+            d_inner=spec.get("d_inner"), kv_dtype=kvd)
+        draft_decoder = None
+        if draft_states is not None and use_draft:
+            dspec = spec["draft"]
+            fw.reset_unique_names()
+            _, draft_decoder = build_lm_paged_decoder(
+                spec["vocab_size"], bs, nb, d_model=dspec["d_model"],
+                n_heads=dspec["n_heads"], n_layers=dspec["n_layers"],
+                d_inner=dspec.get("d_inner"), kv_dtype=kvd)
+        else:
+            draft_states = None
+        server = GenerationServer(
+            decoder, states,
+            slots=int(slots or spec.get("slots", 8)),
+            kv_blocks=int(kv_blocks or spec.get("kv_blocks", 64)),
+            draft_decoder=draft_decoder, draft_states=draft_states,
+            spec_k=(spec_k if spec_k is not None
+                    else spec.get("spec_k")), **kw)
+    finally:
+        if armed:
+            # the executables are loaded; later in-process compiles
+            # must follow the caller's own cache configuration
+            core_flags.set_flags({"compilation_cache_dir": prev})
+    if armed:
+        server.warm_start_dir = cache
+    return server

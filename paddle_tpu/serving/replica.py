@@ -20,24 +20,37 @@ so a text protocol costs nothing measurable):
       -> {"err":"..."}              (replica-local failure — the router
          retries on a survivor)
   {"op":"ping"}   -> {"ok":true,"outstanding":N,"free_blocks":F,
-                      "draining":false}
+                      "draining":false,"warm_start":false}
   {"op":"stats"}  -> {"ok":true,"stats":{...}}
   {"op":"flight"} -> {"ok":true,"dump":{...}}  (the process flight-
                      recorder ring: recent spans/events/metric
                      snapshots, observability/flightrecorder.py)
   {"op":"swap","dir":"..."} -> {"ok":true} after drain+swap+resume
+  {"op":"drain","timeout":30} -> {"ok":true,"drained":true} — stop
+                     ADMISSION and (by default) wait for every
+                     accepted request to finish: the graceful-scale-in
+                     verb the autoscaler calls before retiring a
+                     replica ({"wait":false} just flips the flag)
+  {"op":"resume"} -> {"ok":true} — re-open admission (aborted scale-in)
   {"op":"stop"}   -> {"ok":true}, then the replica shuts down
 
 A replica registers itself in the front door's TTL-lease registry
 (kind "generation") and holds the lease for its lifetime: lease expiry
 IS the health check — a SIGKILLed replica vanishes from the routing
-table within one TTL.
+table within one TTL.  A SIGTERMed replica (scale-in, rolling restart)
+dies GRACEFULLY when `install_sigterm()` is armed (`cli serve` does):
+stop admission -> release the lease (delist from routing) -> drain
+in-flight streams -> delist the telemetry announcement -> exit — the
+front door never mistakes a scale-in for a death.
 """
 from __future__ import annotations
 
 import json
+import os
+import signal
 import socket
 import threading
+import time
 from typing import Iterator, Optional
 
 from ..core.resilience import fault_injector
@@ -66,7 +79,9 @@ class ReplicaServer:
 
     def __init__(self, server, port: int = 0, host: str = "127.0.0.1",
                  registry_addr: Optional[str] = None,
-                 kind: str = "generation", ttl_s: float = 2.0):
+                 kind: str = "generation", ttl_s: float = 2.0,
+                 drain_grace_s: float = 30.0,
+                 own_announcement: bool = False):
         self._server = server
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -75,6 +90,13 @@ class ReplicaServer:
         self.port = self._sock.getsockname()[1]
         self.addr = f"{host}:{self.port}"
         self._stop = threading.Event()
+        self._drain_grace_s = float(drain_grace_s)
+        self._prev_sigterm = None
+        # in-flight generate CONNECTIONS (distinct from the scheduler's
+        # active set: the scheduler can be drained while a handler
+        # thread is still flushing a stream's tail to a slow client)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._lease = None
         if registry_addr:
             # lazy import: the registry rides the native lib, which a
@@ -88,10 +110,16 @@ class ReplicaServer:
         self._accept_thread.start()
         # fleet telemetry: with PADDLE_TPU_TELEMETRY_REGISTRY set, the
         # replica publishes its /metrics endpoint for the
-        # TelemetryCollector (no-op otherwise)
+        # TelemetryCollector (no-op otherwise).  The announcement is
+        # PROCESS-global (maybe_announce returns one shared handle), so
+        # a graceful shutdown only delists it when this replica OWNS
+        # the process (`cli serve` passes own_announcement=True) — an
+        # embedded replica retiring must not remove a still-serving
+        # process from the collector's member table.
         from ..observability.collector import maybe_announce
 
-        maybe_announce(kind)
+        self._own_announcement = bool(own_announcement)
+        self._announcement = maybe_announce(kind)
 
     # -- server side --------------------------------------------------------
     def _accept(self):
@@ -145,7 +173,11 @@ class ReplicaServer:
                 "ok": True,
                 "outstanding": self._server.outstanding_tokens(),
                 "free_blocks": self._server._cache.free_blocks,
-                "draining": self._server._pending_states is not None})
+                "draining": (self._server.draining
+                             or self._server._pending_states
+                             is not None),
+                "warm_start": bool(getattr(self._server,
+                                           "warm_start_dir", None))})
         elif op == "stats":
             self._reply(f, {"ok": True, "stats": self._server.stats()})
         elif op == "flight":
@@ -173,6 +205,21 @@ class ReplicaServer:
                 self._reply(f, {"ok": bool(ok)})
             except Exception as e:
                 self._reply(f, {"err": f"swap failed: {e!r}"})
+        elif op == "drain":
+            try:
+                drained = self._server.drain(
+                    wait=bool(req.get("wait", True)),
+                    timeout=req.get("timeout", self._drain_grace_s))
+                self._reply(f, {"ok": True, "drained": bool(drained),
+                                "draining": True})
+            except RuntimeError as e:  # already closed
+                self._reply(f, {"err": str(e)})
+        elif op == "resume":
+            try:
+                self._server.resume()
+                self._reply(f, {"ok": True})
+            except Exception as e:
+                self._reply(f, {"err": f"resume failed: {e!r}"})
         elif op == "stop":
             self._reply(f, {"ok": True})
             self.close()
@@ -182,6 +229,15 @@ class ReplicaServer:
         return True
 
     def _op_generate(self, f, req):
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            self._op_generate_inner(f, req)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _op_generate_inner(self, f, req):
         try:
             stream = self._server.submit(
                 req["prompt"], int(req["max_new"]),
@@ -220,12 +276,97 @@ class ReplicaServer:
         close()); the `cli serve` foreground loop."""
         return self._stop.wait(timeout)
 
+    # -- graceful termination (scale-in / SIGTERM) --------------------------
+    def install_sigterm(self, grace_s: Optional[float] = None) -> bool:
+        """Arm graceful SIGTERM handling, CHAINING onto whatever
+        handler is already installed — when the flight recorder is
+        armed (PADDLE_TPU_FLIGHT_DIR), its dump-and-redeliver hook
+        still runs after the drain, so a terminated replica leaves
+        both a clean fleet AND a post-mortem ring.  Main-thread only
+        (signal.signal's rule); returns False when it could not be
+        installed."""
+        if grace_s is not None:
+            self._drain_grace_s = float(grace_s)
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+        except (ValueError, OSError):  # not the main thread
+            self._prev_sigterm = None
+            return False
+        return True
+
+    def _on_sigterm(self, signum, frame):
+        self.shutdown_gracefully(self._drain_grace_s)
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)  # e.g. the flight recorder's dump hook
+        elif prev == signal.SIG_IGN:
+            return
+        else:
+            # restore the default disposition and re-deliver so the
+            # process still dies OF SIGTERM (exit status intact)
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def shutdown_gracefully(self, grace_s: float = 30.0) -> None:
+        """The scale-in exit sequence (docs/serving.md 'Autoscaling'):
+
+        1. stop ADMISSION (new generate ops answer a retryable error,
+           so a front-door router resubmits on a survivor);
+        2. release the registry lease — the replica delists from the
+           routing table immediately instead of looking like a death
+           whose TTL expiry trips router retries;
+        3. drain: every accepted request runs to completion and its
+           handler thread finishes flushing the stream (bounded by
+           `grace_s`; whatever is left past the grace is cut off and
+           resumed by the router on a survivor — still zero failed);
+        4. delist the telemetry announcement (this process's /metrics
+           endpoint leaves the collector's member table cleanly);
+        5. close the listener.
+
+        Idempotent; called by the SIGTERM chain and usable directly."""
+        if self._stop.is_set():
+            return
+        deadline = time.monotonic() + float(grace_s)
+        try:
+            self._server.drain(wait=False)
+        except RuntimeError:
+            pass  # server already closed: nothing to drain
+        if self._lease is not None:
+            self._lease.release()
+        try:
+            self._server.drain(
+                wait=True, timeout=max(0.0,
+                                       deadline - time.monotonic()))
+        except RuntimeError:
+            pass
+        # scheduler drained; let handler threads flush stream tails
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        ann, self._announcement = self._announcement, None
+        if ann is not None and self._own_announcement:
+            ann.close()
+        self.close()
+
     def close(self):
         if self._stop.is_set():
             return
         self._stop.set()
         if self._lease is not None:
             self._lease.release()
+        # shutdown BEFORE close (the PR 7 VariableServer lesson): the
+        # accept thread blocked in accept() holds the kernel's open
+        # file description, so a bare close() leaves the port
+        # LISTENING until one more client connects and gets served by
+        # a supposedly-stopped replica — shutdown wakes the accept
+        # immediately instead
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # never accepted / already gone
         try:
             self._sock.close()
         except OSError:

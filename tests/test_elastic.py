@@ -286,7 +286,21 @@ def test_join_rebalances_and_migrates_shards():
 def test_dead_pserver_shards_restore_from_snapshot(tmp_path):
     """A pserver that dies WITHOUT releasing its lease (SIGKILL
     semantics: heartbeats just stop) is evicted by TTL expiry and its
-    shards come back from its latest snapshot."""
+    shards come back from its latest snapshot.
+
+    TTL discipline (the PR 11 load flake, root-caused): the module's
+    0.4s default TTL means a heartbeat thread starved for >0.4s — a
+    loaded host parking this process while other suites compile —
+    spuriously revokes the SURVIVOR's lease too, and the controller
+    then evicts ep1 as well: wait_view sees an empty (non-stable)
+    cluster instead of [ep1] and the test times out.  Only ep2's lease
+    is SUPPOSED to expire here, so only it keeps the short TTL (fast
+    eviction); the survivor gets a TTL wide enough that no plausible
+    scheduling stall revokes it — the deterministic widening: every
+    timing assumption the test makes is now explicit in its leases.
+    (The failure was never reproduced on an unloaded host — PR 11
+    logged it green 3x in isolation — which is exactly the spurious-
+    revocation signature: it needs an external >0.4s stall.)"""
     snap = {0: str(tmp_path / "ps0"), 1: str(tmp_path / "ps1")}
     srv1, ep1 = _sgd_server(PARAMS4, snapshot_dir=snap[0],
                             snapshot_every=1)
@@ -294,7 +308,7 @@ def test_dead_pserver_shards_restore_from_snapshot(tmp_path):
                             snapshot_every=1)
     ctl = _controller(PARAMS4, min_pservers=2, snapshot_dirs=snap)
     try:
-        l1 = _lease(ctl, "pserver", ep1)
+        l1 = _lease(ctl, "pserver", ep1, ttl_s=5.0)  # must NOT expire
         l2 = _lease(ctl, "pserver", ep2)
         assert l1.index == 0 and l2.index == 1  # snapshot_dirs keys
         v1 = ctl.wait_view(1, timeout_s=10)

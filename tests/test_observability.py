@@ -805,8 +805,42 @@ def test_two_trainer_one_pserver_metrics_and_trace(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# overhead guard: metrics off must be near-free on a hot loop
+# overhead guards: instruments off / flight recorder armed must be
+# near-free on a hot loop.  Each probe runs in a FRESH interpreter: the
+# guards compare paired loop timings at 5% granularity, and in-process
+# that marginal is polluted by whatever heap/allocator state the test
+# modules that happen to run earlier in the suite leave behind — the
+# instrumented side ALLOCATES (span records, ring entries) while the
+# bare side doesn't, so fragmentation inflates exactly the quantity
+# under test (observed: the same probe green 8x in isolation, ~1-in-3
+# red after a serving-heavy module ran first).  A subprocess pins the
+# baseline; noise can still only INFLATE a round, so one retry keeps a
+# loaded host from flagging a false regression.
 # ---------------------------------------------------------------------------
+
+
+def _overhead_probe(script, attempts=2):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_TPU_METRICS",
+                                "PADDLE_TPU_TRACE",
+                                "PADDLE_TPU_FLIGHT"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    best = None
+    for _ in range(attempts):
+        out = subprocess.run([sys.executable, "-c", script], text=True,
+                             capture_output=True, env=env, timeout=180)
+        assert out.returncode == 0, out.stderr
+        verdict = json.loads(out.stdout.strip().splitlines()[-1])
+        if best is None or verdict["overhead"] < best["overhead"]:
+            best = verdict
+        if best["overhead"] < 0.05:
+            break
+    return best
 
 
 @pytest.mark.perf
@@ -814,58 +848,64 @@ def test_metrics_off_overhead_under_5_percent():
     """The instrumented shape of a hot loop (gated counter inc + gauge
     set + histogram observe + span + a resilience fire()) must cost < 5%
     over the same loop without the instruments when everything is off.
-    Best-of-5 walls over a workload with real (numpy) per-iteration
-    cost, same discipline as the async-feed perf tests."""
-    from paddle_tpu.core.resilience import fault_injector
+    Paired rounds + min ratio (scheduler noise only ever INFLATES a
+    round) over a workload with real (numpy) per-iteration cost sized
+    like a MINIMAL real step (~100 µs of host work): the disabled
+    instruments cost ~1 µs per iteration for FIVE sites, so any real
+    hot path sits far below the 5% line this guard enforces."""
+    verdict = _overhead_probe(r"""
+import json, time
+import numpy as np
+from paddle_tpu.core.resilience import fault_injector
+from paddle_tpu.observability import metrics, tracing
 
-    assert not metrics.enabled() and not tracing.enabled()
-    reg = metrics.MetricsRegistry()
-    c = metrics.counter("bench_total", registry=reg)
-    g = metrics.gauge("bench_depth", registry=reg)
-    h = metrics.histogram("bench_seconds", registry=reg)
-    inj = fault_injector()
-    # per-iteration work sized like a MINIMAL real step (~100 µs of
-    # host work — a small interpreted op loop or one packed feed): the
-    # disabled instruments cost ~1 µs per iteration for FIVE sites, so
-    # any real hot path (one span + 1-2 metric calls per >=100 µs step)
-    # sits far below the 5% line this guard enforces
-    x = np.random.RandomState(0).rand(512, 512)
-    n = 100
+assert not metrics.enabled() and not tracing.enabled()
+reg = metrics.MetricsRegistry()
+c = metrics.counter("bench_total", registry=reg)
+g = metrics.gauge("bench_depth", registry=reg)
+h = metrics.histogram("bench_seconds", registry=reg)
+inj = fault_injector()
+x = np.random.RandomState(0).rand(512, 512)
+n = 100
 
-    def plain():
-        acc = 0.0
-        for _ in range(n):
+
+def plain():
+    acc = 0.0
+    for _ in range(n):
+        acc += float(x.sum())
+    return acc
+
+
+def instrumented():
+    acc = 0.0
+    for i in range(n):
+        with tracing.span("bench.step", i=i):
             acc += float(x.sum())
-        return acc
+        c.inc()
+        g.set(i)
+        h.observe(0.001)
+        inj.fire("bench.site")
+    return acc
 
-    def instrumented():
-        acc = 0.0
-        for i in range(n):
-            with tracing.span("bench.step", i=i):
-                acc += float(x.sum())
-            c.inc()
-            g.set(i)
-            h.observe(0.001)
-            inj.fire("bench.site")
-        return acc
 
-    plain()  # warm both paths
+plain()  # warm both paths
+instrumented()
+ratios = []
+for _ in range(7):
+    t0 = time.perf_counter()
+    plain()
+    t_plain = time.perf_counter() - t0
+    t0 = time.perf_counter()
     instrumented()
-    # paired rounds + min ratio: scheduler noise only ever INFLATES a
-    # round, so the cleanest round bounds the true overhead from above
-    ratios = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        plain()
-        t_plain = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        instrumented()
-        t_inst = time.perf_counter() - t0
-        ratios.append(t_inst / t_plain)
-    overhead = min(ratios) - 1.0
-    assert overhead < 0.05, (
-        f"metrics-off instrumentation overhead {overhead:.1%} "
-        f"(per-round ratios {[f'{r:.3f}' for r in ratios]})")
+    t_inst = time.perf_counter() - t0
+    ratios.append(t_inst / t_plain)
+print(json.dumps({"overhead": min(ratios) - 1.0,
+                  "ratios": [round(r, 3) for r in ratios]}))
+""")
+    assert verdict["overhead"] < 0.05, (
+        f"metrics-off instrumentation overhead "
+        f"{verdict['overhead']:.1%} (per-round ratios "
+        f"{verdict['ratios']})")
 
 
 @pytest.mark.perf
@@ -887,50 +927,58 @@ def test_flight_recorder_armed_overhead_under_5_percent():
     ratio of each side's minimum round, since scheduler noise only
     ever inflates a round and the two minima converge on the true
     costs independently."""
-    from paddle_tpu.core.resilience import fault_injector
-    from paddle_tpu.observability import flightrecorder
+    verdict = _overhead_probe(r"""
+import json, time
+import numpy as np
+from paddle_tpu.core.resilience import fault_injector
+from paddle_tpu.observability import flightrecorder, metrics, tracing
 
-    assert not metrics.enabled() and not tracing.enabled()
-    reg = metrics.MetricsRegistry()
-    c = metrics.counter("bench_flight_total", registry=reg)
-    inj = fault_injector()
-    x = np.random.RandomState(0).rand(4096, 2048)  # 64 MB
-    n = 8
+assert not metrics.enabled() and not tracing.enabled()
+reg = metrics.MetricsRegistry()
+c = metrics.counter("bench_flight_total", registry=reg)
+inj = fault_injector()
+x = np.random.RandomState(0).rand(4096, 2048)  # 64 MB
+n = 8
 
-    def instrumented():
-        acc = 0.0
-        for i in range(n):
-            with tracing.span("bench.step", i=i):
-                acc += float(x.sum())
-            c.inc()
-            inj.fire("bench.site")
-            flightrecorder.note("step", i=i)
-        return acc
 
-    try:
-        instrumented()  # warm (disarmed)
-        flightrecorder.install()
-        instrumented()  # warm (armed)
-        flightrecorder.uninstall()
-        t_off, t_on = [], []
-        for _ in range(9):
-            t0 = time.perf_counter()
-            instrumented()
-            t_off.append(time.perf_counter() - t0)
-            flightrecorder.install()
-            t0 = time.perf_counter()
-            instrumented()
-            t_on.append(time.perf_counter() - t0)
-            captured = flightrecorder.dump_dict()
-            flightrecorder.uninstall()
-        overhead = min(t_on) / min(t_off) - 1.0
-        assert overhead < 0.05, (
-            f"flight-recorder-armed overhead {overhead:.1%} "
-            f"(disarmed min {min(t_off):.4f}s, armed min "
-            f"{min(t_on):.4f}s over 9 rounds)")
-        # and the armed rounds really captured the loop they watched
-        assert any(s["name"] == "bench.step"
-                   for s in captured["spans"])
-        assert any(e["kind"] == "step" for e in captured["events"])
-    finally:
-        flightrecorder.uninstall()
+def instrumented():
+    acc = 0.0
+    for i in range(n):
+        with tracing.span("bench.step", i=i):
+            acc += float(x.sum())
+        c.inc()
+        inj.fire("bench.site")
+        flightrecorder.note("step", i=i)
+    return acc
+
+
+instrumented()  # warm (disarmed)
+flightrecorder.install()
+instrumented()  # warm (armed)
+flightrecorder.uninstall()
+t_off, t_on = [], []
+for _ in range(9):
+    t0 = time.perf_counter()
+    instrumented()
+    t_off.append(time.perf_counter() - t0)
+    flightrecorder.install()
+    t0 = time.perf_counter()
+    instrumented()
+    t_on.append(time.perf_counter() - t0)
+    captured = flightrecorder.dump_dict()
+    flightrecorder.uninstall()
+print(json.dumps({
+    "overhead": min(t_on) / min(t_off) - 1.0,
+    "off_min": round(min(t_off), 4), "on_min": round(min(t_on), 4),
+    "captured_span": any(s["name"] == "bench.step"
+                         for s in captured["spans"]),
+    "captured_event": any(e["kind"] == "step"
+                          for e in captured["events"]),
+}))
+""")
+    assert verdict["overhead"] < 0.05, (
+        f"flight-recorder-armed overhead {verdict['overhead']:.1%} "
+        f"(disarmed min {verdict['off_min']}s, armed min "
+        f"{verdict['on_min']}s over 9 rounds)")
+    # and the armed rounds really captured the loop they watched
+    assert verdict["captured_span"] and verdict["captured_event"]

@@ -301,21 +301,143 @@ def driver(args):
         logf.close()
 
 
+# ---------------------------------------------------------------------------
+# autoscale drill (tools/ci_check.sh step 12)
+# ---------------------------------------------------------------------------
+
+
+def drill_autoscale(args):
+    """Chaos acceptance for the autoscaling fleet (docs/serving.md
+    "Autoscaling"): ride `run_fleet_ramp_bench` — the BENCH_SERVING_RAMP
+    fleet driver owns the model/router/autoscaler/teardown — with its
+    chaos hooks: ramp open-loop load until a second `cli serve` replica
+    spawns, SIGKILL one AT THE PEAK (phase_hook), keep ramping down
+    until the fleet scales back in — asserting ZERO failed requests end
+    to end (the router's resume contract holds through spawn, drain,
+    and the SIGKILL), that the fleet actually grew and shrank, and that
+    the warm-started scale-out replicas deserialized their executables.
+    The federated Prometheus dump (driver announces the
+    router/autoscaler series; post_hook scrapes before teardown
+    reclaims them) is written to --out for the `cli slo --check --prom`
+    fleet-size / crash-loop / zero-failed gate that follows in
+    ci_check."""
+    import signal as _signal
+
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    from run_serving import run_fleet_ramp_bench
+
+    from paddle_tpu.cloud.registry import Registry
+    from paddle_tpu.observability.collector import (TelemetryCollector,
+                                                    maybe_announce)
+
+    telem_registry = Registry()
+    telem_addr = f"127.0.0.1:{telem_registry.serve(0)}"
+    # federate the driver's own series (router + autoscaler gauges/
+    # counters) so the SLO gate sees fleet.replicas / crashloops /
+    # router outcome counters
+    os.environ["PADDLE_TPU_TELEMETRY_REGISTRY"] = telem_addr
+    ann = maybe_announce("router")
+    coll = TelemetryCollector(registry_addr=telem_addr, period_s=0.3)
+    coll.start()
+
+    killed = {"pid": None}
+
+    def phase_hook(phase, rate, router, scaler):
+        live = router.live_replicas(include_draining=False)
+        print(f"  [drill] phase {phase} (rate {rate:.0f}/s) done: "
+              f"fleet size {len(live)}", flush=True)
+        if phase == 2 and killed["pid"] is None:
+            owned = scaler.owned_pids()
+            if len(owned) >= 2:
+                addr, pid = sorted(owned.items())[-1]
+                killed["pid"] = pid
+                print(f"  [drill] SIGKILL replica {addr} (pid {pid}) "
+                      "at the peak", flush=True)
+                os.kill(pid, _signal.SIGKILL)
+
+    def post_hook(record, router, scaler):
+        # scrape while the driver's router/autoscaler series still
+        # exist — teardown reclaims them on close()
+        time.sleep(1.0)
+        coll.scrape_once()
+
+    try:
+        record = run_fleet_ramp_bench(
+            requests=64, peak_rps=args.peak_rps, phase_s=args.phase_s,
+            max_replicas=args.max_replicas, backlog_low=6.0,
+            sustain_s=0.8, idle_sustain_s=3.0, cooldown_s=3.0,
+            d_model=16, decode_delay_s=args.decode_delay,
+            phase_hook=phase_hook, post_hook=post_hook,
+            env_extra={
+                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS",
+                                                "cpu"),
+                "PADDLE_TPU_METRICS": "on",
+                "PADDLE_TPU_TELEMETRY_REGISTRY": telem_addr})
+        ramp = record["ramp"]
+        sizes = record["fleet_size_per_phase"]
+        print(f"  [drill] ramp: {ramp['requests']} requests, "
+              f"{ramp['shed']} shed, {ramp['failed']} failed")
+        for e in record["scale_events"]:
+            print(f"  [drill] {e}")
+        assert ramp["failed"] == 0, \
+            f"{ramp['failed']} requests FAILED (zero-failed contract)"
+        assert max(sizes) >= 2, \
+            f"fleet never scaled out (sizes {sizes})"
+        assert killed["pid"] is not None, \
+            "drill never found a second owned replica to SIGKILL"
+        assert record["fleet_size_final"] == 1, record
+        assert record["status"]["crashloops"] == 0, record["status"]
+        # the warm-start contract on the surviving replica(s)
+        assert record["replicas"], record
+        for addr, rs in record["replicas"].items():
+            assert rs["warm_start"], (addr, rs)
+            assert rs["cache_misses"] == 0, \
+                f"scale-out replica {addr} COMPILED: {rs}"
+            assert rs["recompiles_after_warmup"] == 0, (addr, rs)
+        text = coll.federation_text()
+        for series in ("paddle_tpu_autoscaler_replicas_live",
+                       "paddle_tpu_autoscaler_scale_events_total",
+                       "paddle_tpu_serving_router_requests_total"):
+            assert series in text, f"missing {series} in federation"
+        out = coll.write_federation(args.out)
+        print(f"federated Prometheus dump -> {out}")
+        print("autoscale drill: all green "
+              f"(sizes {sizes} -> {record['fleet_size_final']}, "
+              f"{ramp['requests']} requests, 0 failed)")
+        return 0
+    finally:
+        if ann is not None:
+            ann.close()
+        coll.close()
+        telem_registry.close()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--role", default="driver",
                     choices=["driver", "pserver", "trainer"])
+    ap.add_argument("--drill", default="telemetry",
+                    choices=["telemetry", "autoscale"],
+                    help="telemetry: the step-11 federation smoke; "
+                    "autoscale: the step-12 scale-out/SIGKILL/"
+                    "scale-in chaos drill")
     ap.add_argument("--out", default="/tmp/paddle_tpu_fleet.prom")
     ap.add_argument("--endpoint", default="")
     ap.add_argument("--rounds", type=int, default=8)
     ap.add_argument("--scrapes", type=int, default=8)
     ap.add_argument("--run_s", type=float, default=600.0)
     ap.add_argument("--linger_s", type=float, default=600.0)
+    ap.add_argument("--peak_rps", type=float, default=20.0)
+    ap.add_argument("--phase_s", type=float, default=6.0)
+    ap.add_argument("--max_replicas", type=int, default=3)
+    ap.add_argument("--decode_delay", type=float, default=0.02)
     args = ap.parse_args(argv)
     if args.role == "pserver":
         return role_pserver(args)
     if args.role == "trainer":
         return role_trainer(args)
+    if args.drill == "autoscale":
+        return drill_autoscale(args)
     return driver(args)
 
 
