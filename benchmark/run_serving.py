@@ -384,11 +384,44 @@ def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
             out["kv_quantization"] = _quant_residency(
                 d_model, n_layers, n_heads, block_size, max_blocks,
                 states, kv_blocks)
+        out["phase_breakdown"] = phase_breakdown()
         if prom_out:
             out["prometheus_dump"] = exporters.write_prometheus(prom_out)
         return out
     finally:
         obs_metrics.set_enabled(metrics_were_on)
+
+
+def phase_breakdown():
+    """This process's per-phase attribution (lifetime sums of the
+    paddle_tpu_*_phase_seconds families), as rows plus the rendered
+    `cli why` table — the artifact's "where did the bench spend its
+    time" section."""
+    from paddle_tpu.observability import attribution, exporters
+    from paddle_tpu.observability.collector import parse_prometheus_text
+
+    try:
+        parsed = parse_prometheus_text(exporters.prometheus_text())
+        rows = attribution.why_rows_from_parsed(parsed)
+        return {"rows": rows,
+                "table": attribution.format_why_table(rows)}
+    except Exception as e:  # attribution must never fail the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def write_bench_artifact(out, directory=".", prefix="BENCH_SERVING"):
+    """Write `out` as the next free ``<prefix>_rNN.json`` revision in
+    `directory` (the repo's committed-artifact convention: BENCH_r05,
+    BOOK_MATRIX_r05, ...).  Returns the path."""
+    n = 1
+    while True:
+        path = os.path.join(directory, f"{prefix}_r{n:02d}.json")
+        if not os.path.exists(path):
+            break
+        n += 1
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, default=str)
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -687,6 +720,10 @@ def main():
     ap.add_argument("--ramp-phase-s", type=float, default=6.0)
     ap.add_argument("--ramp-max", type=int, default=3,
                     help="max replicas the autoscaler may spawn")
+    ap.add_argument("--artifact-dir", default="",
+                    help="also write the result as the next free "
+                    "BENCH_SERVING_rNN.json (BENCH_SERVING_RAMP_rNN "
+                    "for --ramp) revision in this directory")
     a = ap.parse_args()
     if a.ramp:
         out = run_fleet_ramp_bench(
@@ -695,6 +732,10 @@ def main():
             d_model=a.d_model, n_layers=a.layers, n_heads=a.heads,
             block_size=a.block_size, max_blocks=a.max_blocks,
             slots=a.slots)
+        out["phase_breakdown"] = phase_breakdown()
+        if a.artifact_dir:
+            out["artifact"] = write_bench_artifact(
+                out, a.artifact_dir, prefix="BENCH_SERVING_RAMP")
         print(json.dumps(out))
         return
     out = run_serving_bench(
@@ -706,6 +747,8 @@ def main():
         prefix_hit=a.prefix_hit, spec_k=a.spec_k,
         with_spec=not a.no_spec, with_quant=not a.no_quant,
         prom_out=a.prom_out)
+    if a.artifact_dir:
+        out["artifact"] = write_bench_artifact(out, a.artifact_dir)
     print(json.dumps(out))
 
 

@@ -67,6 +67,7 @@ __all__ = [
     "estimate_peak_hbm",
     "estimate_comm",
     "ridge_point",
+    "roofline_seconds",
     "DEVICE_SPECS",
     "DEFAULT_DEVICE",
     "DEFAULT_BATCH",
@@ -102,6 +103,19 @@ def ridge_point(device: str = DEFAULT_DEVICE) -> float:
     """flop/byte at which `device` flips memory- to compute-bound."""
     peak, hbm = DEVICE_SPECS[device]
     return peak / hbm
+
+
+def roofline_seconds(flops: float, bytes_: float,
+                     device: str = DEFAULT_DEVICE) -> float:
+    """Static roofline floor in SECONDS for work doing `flops` FLOPs
+    and moving `bytes_` HBM bytes on `device` — max of the compute
+    floor and the bandwidth floor.  The time-attribution plane
+    publishes this per phase (``*_phase_static_seconds``) so the
+    collector can band measured phase time against the static model
+    (``paddle_tpu_calibration_ratio``; docs/observability.md "Time
+    attribution")."""
+    peak, hbm = DEVICE_SPECS[device]
+    return max(float(flops) / peak, float(bytes_) / hbm)
 
 
 @dataclasses.dataclass

@@ -455,6 +455,27 @@ def format_fleet_table(coll, window_s: float = 60.0) -> str:
     return "\n".join(lines)
 
 
+def format_straggler_lines(coll, window_s: float = 60.0,
+                           flag_at: float = 3.0) -> str:
+    """Comm-endpoint straggler scores for the `cli top` footer: one
+    line per endpoint whose mean round time drifts above its peers',
+    the SLO-able detector threshold marked.  Empty string when the
+    fleet has no per-endpoint round data (no distributed training
+    running, or a single pserver)."""
+    from paddle_tpu.observability import attribution
+
+    scores = attribution.straggler_scores(coll.series,
+                                          window_s=window_s)
+    drifted = {ep: s for ep, s in scores.items() if s > 0.5}
+    if not drifted:
+        return ""
+    lines = ["stragglers (round-time z-score vs peers):"]
+    for ep, s in sorted(drifted.items(), key=lambda t: -t[1]):
+        mark = "  << STRAGGLER" if s >= flag_at else ""
+        lines.append(f"  {ep}  {s:.1f}{mark}")
+    return "\n".join(lines)
+
+
 def cmd_top(argv):
     """`python -m paddle_tpu.cli top --registry HOST:PORT` — the live
     fleet table: every announced member (trainers, pservers, serving
@@ -498,6 +519,10 @@ def cmd_top(argv):
                     _time.sleep(args.period)
                 coll.scrape_once()
             print(format_fleet_table(coll, window_s=args.window))
+            straggler = format_straggler_lines(coll,
+                                               window_s=args.window)
+            if straggler:
+                print(straggler)
             if specs:
                 print()
                 print(slo_mod.format_slo_table(
@@ -576,6 +601,134 @@ def cmd_slo(argv):
           + ("FAILED" if bad else "all met"))
     if args.check and bad:
         return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# `why` / `trace-of` subcommands: the time-attribution plane
+# (docs/observability.md "Time attribution")
+# ---------------------------------------------------------------------------
+
+
+def cmd_why(argv):
+    """`python -m paddle_tpu.cli why [--kind generation|trainer|
+    pserver]` — the fleet "where does the time go" table: per
+    (kind, member, phase) share of attributed time.  Two modes like
+    `cli slo`: `--prom DUMP` reads lifetime sums from a federated
+    Prometheus dump; `--registry HOST:PORT` scrapes a live fleet and
+    shows windowed rates."""
+    import time as _time
+
+    from paddle_tpu.observability import attribution
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli why",
+        description="per-phase time attribution across the fleet "
+        "(docs/observability.md 'Time attribution')")
+    ap.add_argument("--kind", default="",
+                    choices=[""] + list(attribution.KINDS),
+                    help="restrict to one member kind")
+    ap.add_argument("--prom", default="",
+                    help="snapshot mode: a federated Prometheus dump")
+    ap.add_argument("--registry", default="",
+                    help="live mode: scrape this fleet registry")
+    ap.add_argument("--period", type=float, default=0.5)
+    ap.add_argument("--samples", type=int, default=4)
+    ap.add_argument("--window", type=float, default=60.0)
+    args = ap.parse_args(argv)
+
+    kind = args.kind or None
+    if bool(args.registry) == bool(args.prom):
+        raise SystemExit("why: give exactly one of --registry (live) "
+                         "or --prom (snapshot)")
+    if args.prom:
+        from paddle_tpu.observability.collector import \
+            parse_prometheus_text
+
+        with open(args.prom) as f:
+            parsed = parse_prometheus_text(f.read())
+        rows = attribution.why_rows_from_parsed(parsed, kind)
+    else:
+        from paddle_tpu.observability.collector import \
+            TelemetryCollector
+
+        coll = TelemetryCollector(registry_addr=args.registry,
+                                  period_s=args.period)
+        try:
+            for i in range(max(args.samples, 2)):
+                if i:
+                    _time.sleep(args.period)
+                coll.scrape_once()
+            rows = attribution.why_rows(coll.series, kind,
+                                        window_s=args.window)
+        finally:
+            coll.close()
+    print(attribution.format_why_table(rows))
+    return 0
+
+
+def cmd_trace_of(argv):
+    """`python -m paddle_tpu.cli trace-of --metric serving.request
+    --prom DUMP [--trace-dir DIR]` — resolve a latency outlier to its
+    trace: pick the histogram exemplar nearest the requested quantile
+    (p99 by default) from a federated dump, and, when --trace-dir
+    holds the fleet's trace/flight files, assemble the end-to-end
+    Chrome trace for that trace id."""
+    from paddle_tpu.observability import attribution
+    from paddle_tpu.observability import slo as slo_mod
+    from paddle_tpu.observability.collector import (
+        assemble_traces, parse_prometheus_text)
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.cli trace-of",
+        description="histogram exemplar -> joined Chrome trace "
+        "(docs/observability.md 'Time attribution')")
+    ap.add_argument("--metric", required=True,
+                    help="histogram family (short alias like "
+                    "'serving.request' or full paddle_tpu_* name)")
+    ap.add_argument("--prom", required=True,
+                    help="federated Prometheus dump with exemplars")
+    ap.add_argument("--p99", action="store_true",
+                    help="target the p99 outlier (the default)")
+    ap.add_argument("--q", type=float, default=0.99,
+                    help="target quantile (overrides --p99)")
+    ap.add_argument("--trace-dir", default="",
+                    help="fleet trace dir: also write the joined "
+                    "Chrome trace for the picked trace id")
+    ap.add_argument("--out", default="",
+                    help="output dir for the joined trace "
+                    "(default: --trace-dir)")
+    args = ap.parse_args(argv)
+
+    with open(args.prom) as f:
+        parsed = parse_prometheus_text(f.read())
+    name = args.metric
+    if name not in parsed:
+        name = slo_mod.ALIASES.get(args.metric, name)
+    if name not in parsed and not name.startswith("paddle_tpu_"):
+        name = "paddle_tpu_" + name
+    ex = attribution.pick_exemplar(parsed, name, q=args.q)
+    if ex is None:
+        print(f"trace-of: no exemplars on {name!r} — run the fleet "
+              "with PADDLE_TPU_EXEMPLARS=on and PADDLE_TPU_TRACE=on")
+        return 1
+    qs = ex.get("quantile_s")
+    print(f"metric   {name}")
+    if qs is not None:
+        print(f"p{args.q * 100:g}      {qs:.6g}s")
+    print(f"exemplar {ex['value']:.6g}s  labels={ex['labels']}")
+    print(f"trace_id {ex['trace_id']}")
+    if args.trace_dir:
+        joined = assemble_traces(args.trace_dir,
+                                 args.out or args.trace_dir)
+        path = joined.get(ex["trace_id"])
+        if path:
+            print(f"trace    {path}")
+        else:
+            print(f"trace    (trace_id not found under "
+                  f"{args.trace_dir} — was the member running with "
+                  "PADDLE_TPU_TRACE_DIR pointed there?)")
+            return 1
     return 0
 
 
@@ -1279,7 +1432,8 @@ def main(argv=None):
                    "metrics": cmd_metrics, "trace": cmd_trace,
                    "serve": cmd_serve, "autoscale": cmd_autoscale,
                    "concurrency": cmd_concurrency,
-                   "top": cmd_top, "slo": cmd_slo}
+                   "top": cmd_top, "slo": cmd_slo,
+                   "why": cmd_why, "trace-of": cmd_trace_of}
     if argv and argv[0] in subcommands:
         sys.exit(subcommands[argv[0]](argv[1:]))
     ap = argparse.ArgumentParser(
@@ -1287,7 +1441,7 @@ def main(argv=None):
         description="legacy `paddle train` workflow over Program/Executor"
         " (plus subcommands: `python -m paddle_tpu.cli "
         "verify|analyze|concurrency|metrics|trace|serve|autoscale|"
-        "top|slo --help`)")
+        "top|slo|why|trace-of --help`)")
     ap.add_argument("--config", required=True, help="python config file "
                     "defining build()")
     ap.add_argument("--job", default="train",

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 from paddle_tpu.core.resilience import RetryPolicy
 from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import tracing as obs_tracing
 from paddle_tpu.serving.batching import (RequestDeadlineExceeded,
                                          ServerSaturated)
 from paddle_tpu.serving.generation import GenerationStream
@@ -261,6 +262,19 @@ class ReplicaRouter:
 
     def _run_request(self, stream: GenerationStream, req: dict,
                      expires: Optional[float]):
+        # root span of the fleet-wide request trace: the replica and
+        # its generation server's phases parent under it through the
+        # wire-propagated context, and the latency observe below runs
+        # with it active, so the histogram exemplar names this trace
+        with obs_tracing.span("router.request",
+                              max_new=req["max_new"]):
+            inj = obs_tracing.inject()
+            if inj:
+                req = dict(req, trace=inj)
+            self._run_request_traced(stream, req, expires)
+
+    def _run_request_traced(self, stream: GenerationStream, req: dict,
+                            expires: Optional[float]):
         delivered = 0
         t_start = time.monotonic()
         state = self.policy.begin()

@@ -386,9 +386,12 @@ class FaultInjector:
         try:
             # the moment a chaos fault fires is exactly the window a
             # post-mortem wants preserved — dump the flight ring now
-            # (no-op when no recorder is armed)
-            from paddle_tpu.observability import flightrecorder
-            flightrecorder.on_fault(site, rule.kind)
+            # (no-op when no recorder is armed).  The active trace id
+            # rides along so the post-mortem joins the fault to the
+            # request trace it poisoned (docs/resilience.md)
+            from paddle_tpu.observability import flightrecorder, tracing
+            flightrecorder.on_fault(site, rule.kind,
+                                    trace_id=tracing.current_trace_id())
         except Exception as e:  # recorder trouble must not mask the
             # injected fault the caller is about to raise
             _LOG.debug("flight-recorder fault dump failed: %r", e)

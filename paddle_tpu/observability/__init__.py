@@ -22,7 +22,7 @@ See docs/observability.md for the full tour.
 """
 from __future__ import annotations
 
-from . import collector, exporters, flightrecorder, metrics, slo, timeseries, tracing  # noqa: F401,E501
+from . import attribution, collector, exemplars, exporters, flightrecorder, metrics, slo, timeseries, tracing  # noqa: F401,E501
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -40,6 +40,8 @@ __all__ = [
     "metrics",
     "tracing",
     "exporters",
+    "exemplars",
+    "attribution",
     "timeseries",
     "flightrecorder",
     "slo",

@@ -46,6 +46,7 @@ import time
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
+from . import exemplars as exemplars_mod
 from . import exporters
 from . import metrics as metrics_mod
 from .exporters import _fmt_labels, _fmt_value
@@ -231,11 +232,13 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
     """Parse Prometheus text exposition back into the registry-snapshot
     shape: ``{name: {"type", "help", "samples": [{"labels", "value"}]}}``
     with histogram families reassembled (value = ``{"buckets": [[le,
-    cumulative]...], "sum", "count"}``).  Tolerant of unknown types and
-    of series lacking a # TYPE line (treated as untyped gauges)."""
+    cumulative]...], "sum", "count"}`` plus, when bucket lines carry
+    OpenMetrics exemplars, ``"exemplars": {le: parsed exemplar}``).
+    Tolerant of unknown types and of series lacking a # TYPE line
+    (treated as untyped gauges)."""
     types: Dict[str, str] = {}
     helps: Dict[str, str] = {}
-    raw: List[Tuple[str, Dict[str, str], float]] = []
+    raw: List[Tuple[str, Dict[str, str], float, Optional[dict]]] = []
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -247,6 +250,9 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
             elif len(parts) >= 4 and parts[1] == "HELP":
                 helps[parts[2]] = parts[3]
             continue
+        # the exemplar splits off FIRST: its `# {...}` suffix carries
+        # braces that would otherwise confuse the label-set scan
+        line, ex = exemplars_mod.split_sample_line(line)
         if "{" in line:
             name = line[:line.index("{")]
             rest = line[line.index("{") + 1:]
@@ -257,7 +263,7 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
             name, _, v = line.partition(" ")
             labels = {}
             value = _parse_value(v)
-        raw.append((name, labels, value))
+        raw.append((name, labels, value, ex))
 
     out: Dict[str, dict] = {}
     hist_parts: Dict[str, dict] = {}
@@ -271,16 +277,18 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
                                     "buckets": [], "sum": 0.0,
                                     "count": 0})
 
-    for name, labels, value in raw:
+    for name, labels, value, ex in raw:
         base = None
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix) and \
                     name[: -len(suffix)] in hist_names:
                 base = name[: -len(suffix)]
                 if suffix == "_bucket":
-                    _hist_slot(base, labels)["buckets"].append(
-                        [_parse_value(labels.get("le", "+Inf")),
-                         int(value)])
+                    le = _parse_value(labels.get("le", "+Inf"))
+                    slot = _hist_slot(base, labels)
+                    slot["buckets"].append([le, int(value)])
+                    if ex is not None:
+                        slot.setdefault("exemplars", {})[le] = ex
                 elif suffix == "_sum":
                     _hist_slot(base, labels)["sum"] = value
                 else:
@@ -299,11 +307,15 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
             "samples": []})
         for slot in slots.values():
             slot["buckets"].sort(key=lambda b: b[0])
+            value = {"buckets": slot["buckets"],
+                     "sum": slot["sum"],
+                     "count": slot["count"]}
+            if slot.get("exemplars"):
+                # keyed by le so federation can re-attach each to its
+                # bucket line; absent entirely for exemplar-free dumps
+                value["exemplars"] = slot["exemplars"]
             fam["samples"].append({
-                "labels": slot["labels"],
-                "value": {"buckets": slot["buckets"],
-                          "sum": slot["sum"],
-                          "count": slot["count"]}})
+                "labels": slot["labels"], "value": value})
     return out
 
 
@@ -369,6 +381,13 @@ class TelemetryCollector:
         self._http = None
         self.scrapes = 0
         self.scrape_failures = 0
+        # detector window: wide enough that a single scrape's lifetime
+        # stats already produce a verdict (mean() treats a one-point
+        # series as in-window), tight enough to track live drift
+        self.detector_window_s = max(60.0, 10 * self.period_s)
+        # collector-synthesized families (straggler scores, calibration
+        # ratios), parsed-snapshot shaped, merged into federation_text
+        self._synth: Dict[str, dict] = {}
 
     # -- discovery + scrape -------------------------------------------------
     def _discover(self) -> Dict[str, Tuple[str, str]]:
@@ -424,7 +443,30 @@ class TelemetryCollector:
         for m in targets:
             ok = self._scrape_member(m)
             results[m.member] = ok
+        self.run_detectors()
         return results
+
+    def run_detectors(self) -> Dict[str, dict]:
+        """Recompute the collector-side detectors (comm stragglers,
+        static-vs-measured calibration drift) over the fleet series and
+        publish their synthetic gauges: ingested into the time-series
+        store (SLO-able, `cli top`) and merged into federation_text.
+        Runs after every scrape pass; cheap (label scans + window
+        means).  Detection must never wedge collection."""
+        try:
+            from . import attribution
+
+            synth = attribution.run_detectors(
+                self.series, window_s=self.detector_window_s)
+        except Exception:
+            return dict(self._synth)
+        with self._lock:
+            self._synth = synth
+        for name, fam in synth.items():
+            for s in fam["samples"]:
+                self.series.ingest_value(name, fam["type"],
+                                         s["labels"], s["value"])
+        return synth
 
     def _scrape_member(self, m: _Member) -> bool:
         ts = time.monotonic()
@@ -505,6 +547,7 @@ class TelemetryCollector:
                 m = self._members[member] = _Member(member, kind,
                                                     "push")
         self._ingest(m, parse_prometheus_text(text), time.monotonic())
+        self.run_detectors()
 
     # -- outputs ------------------------------------------------------------
     def members(self) -> List[dict]:
@@ -523,6 +566,10 @@ class TelemetryCollector:
             snapshot = [(m.member, m.kind, dict(m.parsed), m.up)
                         for m in sorted(self._members.values(),
                                         key=lambda m: m.member)]
+            synth = {n: {"type": f["type"], "help": f["help"],
+                         "samples": [(dict(s["labels"]), s["value"])
+                                     for s in f["samples"]]}
+                     for n, f in self._synth.items()}
         lines = []
         for member, kind, parsed, up in snapshot:
             for name, fam in parsed.items():
@@ -539,6 +586,11 @@ class TelemetryCollector:
                                1.0 if up else 0.0)
                               for member, kind, _, up in snapshot]}
         merged["paddle_tpu_member_up"] = up_fam
+        for name, fam in synth.items():
+            slot = merged.setdefault(
+                name, {"type": fam["type"], "help": fam["help"],
+                       "samples": []})
+            slot["samples"].extend(fam["samples"])
         for name in sorted(merged):
             fam = merged[name]
             if fam["help"]:
@@ -546,11 +598,19 @@ class TelemetryCollector:
             lines.append(f"# TYPE {name} {fam['type']}")
             for labels, value in fam["samples"]:
                 if fam["type"] == "histogram":
+                    exs = value.get("exemplars") or {}
                     for le, cum in value["buckets"]:
-                        lines.append(
+                        line = (
                             f"{name}_bucket"
                             f"{_fmt_labels(labels, {'le': _fmt_value(le)})}"  # noqa: E501
                             f" {cum}")
+                        if le in exs:
+                            # federation preserves member exemplars, so
+                            # `cli trace-of` can resolve a fleet-level
+                            # p99 straight to a member's trace id
+                            line += " " + exemplars_mod.render_exemplar(
+                                exs[le])
+                        lines.append(line)
                     lines.append(f"{name}_sum{_fmt_labels(labels)} "
                                  f"{_fmt_value(value['sum'])}")
                     lines.append(f"{name}_count{_fmt_labels(labels)} "

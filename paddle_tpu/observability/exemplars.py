@@ -1,0 +1,172 @@
+"""Histogram exemplars: trace-id samples attached to histogram buckets.
+
+A latency histogram can say the p99 regressed; it cannot say WHICH
+request landed in the top bucket.  Exemplars close that gap: when armed
+(``PADDLE_TPU_EXEMPLARS=on``), every ``Histogram.observe`` that runs
+under an active trace span records (trace_id, value, timestamp) into a
+bounded latest-k reservoir for the bucket the value fell in.  The
+Prometheus exporter renders them in OpenMetrics exemplar syntax —
+
+    name_bucket{le="0.064"} 7 # {trace_id="4bf9..."} 0.0431 1700000000.0
+
+— the collector parses and federates them, and ``cli trace-of`` joins
+an exemplar's trace id against the fleet's trace/flight dumps to pull
+up the actual Chrome trace of a tail request (docs/observability.md
+"Time attribution").
+
+Cost model: recording is gated on :func:`armed` (one module-global
+read) AND on an active span, so un-armed processes pay one boolean
+test per observe; armed processes pay one thread-local read plus a
+deque append.  The reservoir keeps the latest ``PADDLE_TPU_EXEMPLAR_K``
+(default 2) exemplars per bucket — memory is O(buckets * k) per child,
+bounded regardless of traffic.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from . import tracing
+
+__all__ = [
+    "Exemplar",
+    "ExemplarReservoir",
+    "armed",
+    "set_armed",
+    "exemplar_k",
+    "active_trace_id",
+    "format_exemplar",
+    "parse_exemplar",
+    "render_exemplar",
+    "split_sample_line",
+]
+
+
+def _env_on(raw: Optional[str]) -> bool:
+    return (raw or "").strip().lower() in ("1", "on", "true", "yes")
+
+
+_ARMED = _env_on(os.environ.get("PADDLE_TPU_EXEMPLARS"))
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def set_armed(on: bool) -> None:
+    global _ARMED
+    _ARMED = bool(on)
+
+
+def exemplar_k() -> int:
+    """Latest-k reservoir depth per bucket (PADDLE_TPU_EXEMPLAR_K,
+    default 2, floor 1)."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_EXEMPLAR_K", "2")))
+    except ValueError:
+        return 2
+
+
+def active_trace_id() -> Optional[str]:
+    """The current thread's active trace id, or None outside any span."""
+    ctx = tracing.current_context()
+    return ctx.trace_id if ctx is not None else None
+
+
+class Exemplar(NamedTuple):
+    trace_id: str
+    value: float
+    ts: float
+
+
+class ExemplarReservoir:
+    """Per-bucket latest-k exemplars for one histogram child.
+
+    Bucket indices follow the child's per-bucket counts array: index i
+    is the i-th finite bucket, the last index is the +Inf overflow.
+    Bounded by construction — k per bucket, evicting oldest."""
+
+    __slots__ = ("_lock", "_buckets", "_k")
+
+    def __init__(self, k: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._k = int(k) if k is not None else exemplar_k()
+        self._buckets: Dict[int, deque] = {}
+
+    def record(self, bucket_index: int, value: float,
+               trace_id: str) -> None:
+        ex = Exemplar(trace_id, float(value), time.time())
+        with self._lock:
+            d = self._buckets.get(bucket_index)
+            if d is None:
+                d = self._buckets[bucket_index] = deque(maxlen=self._k)
+            d.append(ex)
+
+    def snapshot(self) -> Dict[int, List[Exemplar]]:
+        with self._lock:
+            return {i: list(d) for i, d in self._buckets.items() if d}
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplar wire format
+# ---------------------------------------------------------------------------
+
+# `# {label="value",...} <value> [<timestamp>]` appended to a sample
+# line; only trace_id travels today but the parser keeps the general
+# label set so foreign exposition round-trips
+_EXEMPLAR_RE = re.compile(
+    r"^\{(?P<labels>[^}]*)\}\s+(?P<value>\S+)(?:\s+(?P<ts>\S+))?\s*$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def format_exemplar(ex: Exemplar) -> str:
+    """The OpenMetrics suffix for one exemplar (including the leading
+    ``# ``), appended after a ``_bucket`` sample's value."""
+    return (f'# {{trace_id="{ex.trace_id}"}} '
+            f"{ex.value} {ex.ts}")
+
+
+def parse_exemplar(text: str) -> Optional[dict]:
+    """Parse the part AFTER ``# `` of an exemplar-bearing sample line ->
+    ``{"labels": {...}, "value": float, "ts": float|None}``; None on
+    malformed input (foreign exposition must not kill a scrape)."""
+    m = _EXEMPLAR_RE.match(text.strip())
+    if m is None:
+        return None
+    labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
+              for k, v in _LABEL_RE.findall(m.group("labels"))}
+    try:
+        value = float(m.group("value"))
+        ts = float(m.group("ts")) if m.group("ts") else None
+    except ValueError:
+        return None
+    return {"labels": labels, "value": value, "ts": ts}
+
+
+def render_exemplar(ex: dict) -> str:
+    """Inverse of :func:`parse_exemplar`: the ``# {...} value [ts]``
+    suffix from a parsed exemplar dict — how the collector re-emits
+    exemplars it federated from member scrapes."""
+    labels = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\")
+                         .replace('"', '\\"'))
+        for k, v in ex.get("labels", {}).items())
+    out = f"# {{{labels}}} {ex['value']}"
+    if ex.get("ts") is not None:
+        out += f" {ex['ts']}"
+    return out
+
+
+def split_sample_line(rest: str) -> Tuple[str, Optional[dict]]:
+    """Split a Prometheus sample line's value part from a trailing
+    OpenMetrics exemplar: ``"7 # {trace_id=\\"..\\"} 0.04 170.."`` ->
+    ``("7", {...})``.  Lines without an exemplar pass through as
+    ``(rest, None)``."""
+    if " # " not in rest:
+        return rest, None
+    value_part, _, ex_part = rest.partition(" # ")
+    return value_part.strip(), parse_exemplar(ex_part)

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from . import exemplars as exemplars_mod
 from . import metrics as metrics_mod
 from . import tracing
 
@@ -74,11 +75,20 @@ def prometheus_text(registry: Optional[metrics_mod.MetricsRegistry]
         lines.append(f"# TYPE {m.name} {m.kind}")
         for labels, child in m.samples():
             if m.kind == "histogram":
-                for le, n in child.cumulative_buckets():
-                    lines.append(
-                        f"{m.name}_bucket"
-                        f"{_fmt_labels(labels, {'le': _fmt_value(le)})}"
-                        f" {n}")
+                # exemplars attach to their bucket line in OpenMetrics
+                # syntax (`value # {trace_id="..."} exemplar_value ts`)
+                # — absent unless PADDLE_TPU_EXEMPLARS armed them
+                exs = child.exemplars()
+                for i, (le, n) in enumerate(
+                        child.cumulative_buckets()):
+                    line = (f"{m.name}_bucket"
+                            f"{_fmt_labels(labels, {'le': _fmt_value(le)})}"
+                            f" {n}")
+                    bucket_exs = exs.get(i)
+                    if bucket_exs:
+                        line += " " + exemplars_mod.format_exemplar(
+                            bucket_exs[-1])
+                    lines.append(line)
                 lines.append(f"{m.name}_sum{_fmt_labels(labels)} "
                              f"{_fmt_value(child.sum)}")
                 lines.append(f"{m.name}_count{_fmt_labels(labels)} "

@@ -285,14 +285,20 @@ def note(event: str, /, **data) -> None:
         rec.note(event, **data)
 
 
-def on_fault(site: str, kind: str) -> None:
+def on_fault(site: str, kind: str,
+             trace_id: "str | None" = None) -> None:
     """Called by core/resilience when the chaos injector fires: the
     injected fault is exactly the moment whose surrounding seconds the
     post-mortem wants, so dump eagerly instead of waiting for a flush
-    tick."""
+    tick.  ``trace_id`` (the trace active at the fire site, when any)
+    links the dump's fault event to the request trace it hit — `cli
+    flight`/`trace-of` can then join chaos to its victim."""
     rec = _REC
     if rec is not None:
-        rec.note("fault", site=site, kind=kind)
+        if trace_id is not None:
+            rec.note("fault", site=site, kind=kind, trace_id=trace_id)
+        else:
+            rec.note("fault", site=site, kind=kind)
         rec.write(reason=f"fault:{site}")
 
 

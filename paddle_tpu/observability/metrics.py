@@ -24,6 +24,8 @@ import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import exemplars as _exemplars
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -125,7 +127,8 @@ class _GaugeChild:
 
 
 class _HistogramChild:
-    __slots__ = ("_metric", "_lock", "_counts", "_sum", "_count")
+    __slots__ = ("_metric", "_lock", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, metric: "Histogram"):
         self._metric = metric
@@ -133,6 +136,7 @@ class _HistogramChild:
         self._counts = [0] * (len(metric.buckets) + 1)  # +1: overflow
         self._sum = 0.0
         self._count = 0
+        self._exemplars = None  # ExemplarReservoir, lazily when armed
 
     def observe(self, value: float) -> None:
         if not (_ENABLED or self._metric.always):
@@ -144,10 +148,23 @@ class _HistogramChild:
                 break
         else:
             i = len(buckets)
+        tid = (_exemplars.active_trace_id()
+               if _exemplars.armed() else None)
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if tid is not None:
+                if self._exemplars is None:
+                    self._exemplars = _exemplars.ExemplarReservoir()
+                self._exemplars.record(i, value, tid)
+
+    def exemplars(self) -> Dict[int, list]:
+        """{bucket_index: [Exemplar...]} — latest-k per bucket, index
+        aligned with the per-bucket counts array (last = +Inf)."""
+        with self._lock:
+            res = self._exemplars
+        return res.snapshot() if res is not None else {}
 
     @property
     def sum(self) -> float:
@@ -467,9 +484,52 @@ def gauge(name: str, help: str = "", labelnames: Sequence[str] = (),
         Gauge, name, help, labelnames, always)
 
 
+_ENV_BUCKETS: Optional[Dict[str, Tuple[float, ...]]] = None
+_ENV_BUCKETS_LOCK = threading.Lock()
+
+
+def _env_bucket_overrides() -> Dict[str, Tuple[float, ...]]:
+    """Per-family bucket overrides from PADDLE_TPU_HIST_BUCKETS
+    (``name=0.01,0.1,1,20;other=...``), parsed once.  Lets operators
+    make slow objectives representable — the default ladder tops out at
+    16.384 s, and quantiles clamp at the top finite bucket
+    (docs/observability.md "Time attribution")."""
+    global _ENV_BUCKETS
+    with _ENV_BUCKETS_LOCK:
+        if _ENV_BUCKETS is None:
+            parsed: Dict[str, Tuple[float, ...]] = {}
+            raw = os.environ.get("PADDLE_TPU_HIST_BUCKETS", "")
+            for part in raw.split(";"):
+                name, sep, vals = part.strip().partition("=")
+                if not sep or not name.strip():
+                    continue
+                try:
+                    bs = tuple(float(v) for v in vals.split(",")
+                               if v.strip())
+                except ValueError:
+                    continue  # a typo'd env must not break import
+                if bs:
+                    parsed[name.strip()] = bs
+            _ENV_BUCKETS = parsed
+        return _ENV_BUCKETS
+
+
+def reset_env_bucket_overrides() -> None:
+    """Re-read PADDLE_TPU_HIST_BUCKETS on next use (tests only)."""
+    global _ENV_BUCKETS
+    with _ENV_BUCKETS_LOCK:
+        _ENV_BUCKETS = None
+
+
 def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
               always: bool = False,
               buckets: Optional[Sequence[float]] = None,
               registry: Optional[MetricsRegistry] = None) -> Histogram:
+    # env override wins over the call-site default: the operator tuning
+    # a family's resolution must not need a code change.  Applies at
+    # first registration only (get_or_create returns extant families).
+    env = _env_bucket_overrides().get(name)
+    if env is not None:
+        buckets = env
     return (registry or _REGISTRY).get_or_create(
         Histogram, name, help, labelnames, always, buckets=buckets)

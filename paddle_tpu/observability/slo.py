@@ -73,6 +73,13 @@ ALIASES = {
     "pserver.requests": "paddle_tpu_pserver_requests_total",
     "trainer.step": "paddle_tpu_trainer_step_seconds",
     "trainer.steps": "paddle_tpu_trainer_steps_total",
+    # time-attribution plane (observability/attribution.py)
+    "serving.phases": "paddle_tpu_generation_phase_seconds",
+    "trainer.phases": "paddle_tpu_trainer_phase_seconds",
+    "pserver.phases": "paddle_tpu_pserver_phase_seconds",
+    "comm.endpoint_round": "paddle_tpu_comm_endpoint_round_seconds",
+    "comm.straggler": "paddle_tpu_comm_straggler_score",
+    "calibration": "paddle_tpu_calibration_ratio",
 }
 
 _OPS = {

@@ -283,6 +283,42 @@ class TimeSeriesStore:
             seen = True
         return total if seen else None
 
+    def sum_rate(self, name: str, window_s: float,
+                 labels: Optional[dict] = None,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Per-second slope of a histogram's ``_sum`` over the window,
+        summed across matching series — for a ``*_phase_seconds``
+        family this is "seconds of phase time per wall second", the
+        phase-share signal behind `cli why`.  None when no matching
+        histogram series has a usable window yet."""
+        now = self._clock() if now is None else now
+        total, seen = 0.0, False
+        for s in self._matching(name, labels):
+            if s.kind != "histogram":
+                continue
+            edges = self._edges(s, window_s, now)
+            if edges is None:
+                continue
+            (t0, v0), (t1, v1) = edges
+            if t1 <= t0:
+                continue
+            total += (v1.sum - v0.sum) / (t1 - t0)
+            seen = True
+        return total if seen else None
+
+    def label_values(self, name: str, label: str,
+                     labels: Optional[dict] = None) -> List[str]:
+        """Distinct values of `label` across the series of `name`
+        (optionally restricted to a label subset) — how the attribution
+        layer enumerates phases, endpoints and members it should group
+        by."""
+        out = set()
+        for s in self._matching(name, labels):
+            v = s.labels.get(label)
+            if v is not None:
+                out.add(v)
+        return sorted(out)
+
     def latest(self, name: str, labels: Optional[dict] = None
                ) -> Optional[float]:
         """Most recent value summed across matching series (histograms:

@@ -43,6 +43,7 @@ __all__ = [
     "span",
     "activate",
     "current_context",
+    "current_trace_id",
     "inject",
     "extract",
     "enabled",
@@ -54,6 +55,10 @@ __all__ = [
     "clear",
     "chrome_trace_events",
     "write_chrome_trace",
+    "TailSampler",
+    "arm_tail_sampler",
+    "disarm_tail_sampler",
+    "tail_sampler",
 ]
 
 _TRACE_DIR = os.environ.get("PADDLE_TPU_TRACE_DIR", "")
@@ -81,11 +86,21 @@ def _after_fork_in_child():
     trace/span ids across processes) nor its span buffer (the child
     would re-dump the parent's spans under its own pid), and the buffer
     lock may have been held by a parent thread at fork time."""
-    global _spans, _dropped, _lock
+    global _spans, _dropped, _lock, _TAIL
     _rng.seed()  # fresh OS entropy
     _lock = threading.Lock()
     _spans = []
     _dropped = 0
+    # a forked child shares the parent's tail buffer: re-arm with a
+    # fresh one so the child's dump carries only its own spans
+    t = _TAIL
+    if t is not None:
+        remove_span_listener(t)
+        _TAIL = None
+        arm_tail_sampler(threshold_s=t.threshold_s, out_dir=t._dir,
+                         max_open=t._max_open,
+                         max_spans_per_trace=t._max_spans,
+                         max_kept=t._max_kept, flush_s=t._flush_s)
 
 
 if hasattr(os, "register_at_fork"):  # posix
@@ -138,6 +153,12 @@ def current_context() -> Optional[SpanContext]:
     context), else None."""
     s = _stack()
     return s[-1] if s else None
+
+
+def current_trace_id() -> Optional[str]:
+    """The active trace id on this thread (exemplar hook), else None."""
+    s = _stack()
+    return s[-1].trace_id if s else None
 
 
 def inject() -> Optional[Dict[str, str]]:
@@ -354,6 +375,233 @@ def clear() -> None:
 
 
 # ---------------------------------------------------------------------------
+# tail sampling: keep full span trees only for slow or errored traces
+# ---------------------------------------------------------------------------
+
+
+class TailSampler:
+    """Span listener that retains complete span trees ONLY for traces
+    that breach a latency threshold or carry an error attr — head
+    sampling decides before the outcome is known, tail sampling after.
+
+    Buffering is bounded everywhere: at most `max_open` in-progress
+    traces (oldest evicted first), at most `max_spans_per_trace` spans
+    buffered per trace (extras counted, not stored), at most `max_kept`
+    finalized kept traces (oldest dropped).  A trace is MARKED for
+    keeping the moment any of its finished spans qualifies (duration >=
+    threshold_s, or an `error` attr), and finalized when its root span
+    (parent_id None) completes or it is evicted.  Marked traces —
+    including still-open ones, e.g. the remote half of a cross-process
+    trace whose root lives elsewhere — are flushed to
+    ``<dir>/trace_tail_<pid>.json`` (Chrome-trace JSON, same shape as
+    the atexit dump) on a debounced cadence, so a live replica's tail
+    traces are joinable by the collector without waiting for exit.
+
+    Arm via :func:`arm_tail_sampler` or ``PADDLE_TPU_TAIL_SAMPLE``
+    (``on`` or a threshold in seconds; docs/observability.md "Time
+    attribution")."""
+
+    def __init__(self, threshold_s: float = 0.25,
+                 max_open: int = 256,
+                 max_spans_per_trace: int = 512,
+                 max_kept: int = 64,
+                 out_dir: Optional[str] = None,
+                 flush_s: float = 0.5):
+        self.threshold_s = float(threshold_s)
+        self._max_open = int(max_open)
+        self._max_spans = int(max_spans_per_trace)
+        self._max_kept = int(max_kept)
+        self._dir = out_dir
+        self._flush_s = float(flush_s)
+        self._lock = threading.Lock()
+        # trace_id -> {"spans": [...], "keep": bool, "dropped": int};
+        # plain dicts keep insertion (= first-seen) order for eviction
+        self._open: Dict[str, dict] = {}
+        self._kept: Dict[str, dict] = {}
+        self._kept_total = 0
+        self._evicted_open = 0
+        self._dirty = False
+        self._last_flush = 0.0
+
+    # -- listener hot path --------------------------------------------------
+    def __call__(self, rec: dict) -> None:
+        tid = rec.get("trace_id")
+        if not tid:
+            return
+        qualifies = ((rec.get("dur") or 0.0) >= self.threshold_s
+                     or bool(rec.get("attrs", {}).get("error")))
+        do_flush = False
+        with self._lock:
+            buf = self._open.get(tid)
+            if buf is None:
+                kept = self._kept.get(tid)
+                if kept is not None:
+                    # straggling span of an already-finalized keeper
+                    if len(kept["spans"]) < self._max_spans:
+                        kept["spans"].append(rec)
+                        self._dirty = True
+                    do_flush = self._flush_due_locked()
+                else:
+                    buf = self._open[tid] = {"spans": [rec],
+                                             "keep": qualifies,
+                                             "dropped": 0}
+                    while len(self._open) > self._max_open:
+                        old_tid = next(iter(self._open))
+                        old = self._open.pop(old_tid)
+                        self._evicted_open += 1
+                        if old["keep"]:
+                            self._keep_locked(old_tid, old)
+            if buf is not None:
+                if buf is not self._open.get(tid):
+                    pass  # already finalized by eviction above
+                elif len(buf["spans"]) < self._max_spans:
+                    if buf["spans"][-1] is not rec:
+                        buf["spans"].append(rec)
+                else:
+                    buf["dropped"] += 1
+                if qualifies:
+                    buf["keep"] = True
+                if rec.get("parent_id") is None:
+                    # local root completed: the trace's fate is decided
+                    self._open.pop(tid, None)
+                    if buf["keep"]:
+                        self._keep_locked(tid, buf)
+                elif buf["keep"]:
+                    # cross-process half with a remote root: stream it
+                    # out on the debounce so the fleet join sees it
+                    self._dirty = True
+                do_flush = self._flush_due_locked()
+        if do_flush:
+            self.flush()
+
+    def _keep_locked(self, tid: str, buf: dict) -> None:
+        self._kept[tid] = buf
+        self._kept_total += 1
+        self._dirty = True
+        while len(self._kept) > self._max_kept:
+            self._kept.pop(next(iter(self._kept)))
+
+    def _flush_due_locked(self) -> bool:
+        return (self._dirty and self._dir is not None
+                and time.monotonic() - self._last_flush
+                >= self._flush_s)
+
+    # -- introspection / export --------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "open_traces": len(self._open),
+                "open_spans": sum(len(b["spans"])
+                                  for b in self._open.values()),
+                "kept_traces": len(self._kept),
+                "kept_spans": sum(len(b["spans"])
+                                  for b in self._kept.values()),
+                "kept_total": self._kept_total,
+                "evicted_open": self._evicted_open,
+            }
+
+    def kept_trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._kept)
+
+    def _sampled_spans_locked(self) -> List[dict]:
+        spans: List[dict] = []
+        for buf in self._kept.values():
+            spans.extend(buf["spans"])
+        for buf in self._open.values():
+            if buf["keep"]:
+                spans.extend(buf["spans"])
+        return spans
+
+    def flush(self, path: Optional[str] = None,
+              force: bool = False) -> Optional[str]:
+        """Write the sampled traces as Chrome-trace JSON (atomic tmp +
+        rename).  Default path ``<out_dir>/trace_tail_<pid>.json`` —
+        the ``trace_*`` prefix is what the collector's assemble_traces
+        globs, so tail files join the fleet dump like any other
+        process dump.  Debounced unless `force`."""
+        import json
+
+        with self._lock:
+            if path is None and self._dir is None:
+                return None
+            if not force and not self._dirty:
+                return None
+            self._dirty = False
+            self._last_flush = time.monotonic()
+            spans = self._sampled_spans_locked()
+        out = path or os.path.join(self._dir,
+                                   f"trace_tail_{os.getpid()}.json")
+        events = [{
+            "ph": "X", "cat": "span", "name": s["name"],
+            "ts": s["ts"] * 1e6, "dur": s["dur"] * 1e6,
+            "pid": s["pid"], "tid": s["tid"],
+            "args": {"trace_id": s["trace_id"],
+                     "span_id": s["span_id"],
+                     "parent_id": s["parent_id"], **s["attrs"]},
+        } for s in spans]
+        payload = {"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": {"producer":
+                                 "paddle_tpu.observability.tail",
+                                 "threshold_s": self.threshold_s}}
+        d = os.path.dirname(out)
+        try:
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{out}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, out)
+        except OSError:
+            return None  # best-effort, like the flight recorder
+        return out
+
+
+_TAIL: Optional[TailSampler] = None
+
+
+def arm_tail_sampler(threshold_s: float = 0.25,
+                     out_dir: Optional[str] = None,
+                     **kw) -> TailSampler:
+    """Install the process tail sampler as a span listener (making
+    span() live even with full tracing off, like the flight recorder's
+    tap).  Re-arming replaces the previous sampler.  `out_dir` defaults
+    to the trace dir when one is configured."""
+    global _TAIL
+    disarm_tail_sampler()
+    _TAIL = TailSampler(threshold_s=threshold_s,
+                        out_dir=out_dir or (_TRACE_DIR or None), **kw)
+    add_span_listener(_TAIL)
+    return _TAIL
+
+
+def disarm_tail_sampler() -> None:
+    global _TAIL
+    t, _TAIL = _TAIL, None
+    if t is not None:
+        remove_span_listener(t)
+        t.flush(force=True)
+
+
+def tail_sampler() -> Optional[TailSampler]:
+    return _TAIL
+
+
+def maybe_arm_tail_from_env() -> Optional[TailSampler]:
+    """``PADDLE_TPU_TAIL_SAMPLE=on`` arms at the default threshold;
+    a numeric value is the threshold in seconds."""
+    raw = os.environ.get("PADDLE_TPU_TAIL_SAMPLE", "").strip().lower()
+    if not raw:
+        return None
+    if raw in ("1", "on", "true", "yes"):
+        return arm_tail_sampler()
+    try:
+        return arm_tail_sampler(threshold_s=float(raw))
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
 # Chrome-trace ("catapult") export — open in chrome://tracing or Perfetto
 # ---------------------------------------------------------------------------
 
@@ -447,6 +695,9 @@ def _atexit_dump():
             write_chrome_trace()
         except OSError:
             pass  # exit-time dump is best-effort (read-only FS, etc.)
+    if _TAIL is not None:
+        _TAIL.flush(force=True)
 
 
 atexit.register(_atexit_dump)
+maybe_arm_tail_from_env()

@@ -43,6 +43,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..observability import attribution as obs_attr
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from .pserver import VariableClient
@@ -69,6 +70,15 @@ _M_ROUND_RETRIES = obs_metrics.counter(
     "paddle_tpu_comm_round_retries_total",
     "elastic rounds retried against a fresh cluster view after a "
     "mid-round failure (dead pserver / migrated shard)")
+# per-endpoint round attribution: the straggler detector
+# (observability/attribution.py) compares endpoints' mean round time,
+# so one slow pserver shows up as a z-score instead of hiding inside
+# the all-endpoint round histogram
+_M_EP_ROUND = obs_metrics.histogram(
+    obs_attr.ENDPOINT_ROUND_METRIC,
+    "per-endpoint slice of a fused round: sends + barrier + pull on "
+    "that endpoint's worker (straggler attribution)",
+    ("endpoint",))
 
 
 def _default_client(endpoint: str) -> VariableClient:
@@ -169,13 +179,20 @@ class CommPool:
         def run_ep(ep):
             c = self.client(ep)
             s0, r0 = c.bytes_sent, c.bytes_recv
+            te0 = time.perf_counter()
             with obs_tracing.activate(ctx), \
                     obs_tracing.span("comm.endpoint_round", endpoint=ep):
                 if ep in sends:
                     c.send_vars(sends[ep], bucket_bytes)
-                    c.send_batch_barrier()
-                vals = (c.get_vars(gets[ep], bucket_bytes)
-                        if ep in gets else [])
+                    with obs_attr.phase("trainer", "barrier_wait"):
+                        c.send_batch_barrier()
+                if ep in gets:
+                    with obs_attr.phase("trainer", "get"):
+                        vals = c.get_vars(gets[ep], bucket_bytes)
+                else:
+                    vals = []
+            _M_EP_ROUND.labels(endpoint=ep).observe(
+                time.perf_counter() - te0)
             return vals, c.bytes_sent - s0, c.bytes_recv - r0
 
         eps = sorted(set(sends) | set(gets))
@@ -213,7 +230,9 @@ class CommPool:
         for ep, name in get_items:
             out.append(results[ep][0][idx[ep]])
             idx[ep] += 1
-        _M_ROUND_SECONDS.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _M_ROUND_SECONDS.observe(dt)
+        obs_attr.observe_phase("trainer", "send_round", dt)
         _M_ROUND_BYTES.labels(direction="sent").observe(
             sum(r[1] for r in results.values()))
         _M_ROUND_BYTES.labels(direction="recv").observe(
@@ -229,6 +248,9 @@ class CommPool:
         with self._lock:
             c = self._clients.pop(endpoint, None)
             w = self._workers.pop(endpoint, None)
+        # a forgotten endpoint must not export a stale straggler
+        # series forever (elastic churn)
+        _M_EP_ROUND.remove(endpoint=endpoint)
         # the failed round drained every submitted future before
         # raising, so the worker is idle here
         if w is not None:

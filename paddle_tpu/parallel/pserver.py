@@ -30,6 +30,7 @@ import numpy as np
 from ..core.lod import LoDTensor, SelectedRows
 from ..core.resilience import (RetryPolicy, fault_injector,
                                sched_fault_armed as _sched_fault)
+from ..observability import attribution as obs_attr
 from ..observability import flightrecorder
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
@@ -594,6 +595,11 @@ class VariableServer:
                     return
                 _M_REQUESTS.labels(
                     verb=verb if verb in _KNOWN_VERBS else "other").inc()
+                # chaos hook: a delay fault here makes THIS server a
+                # straggler — every frame it serves stalls, which the
+                # client-side per-endpoint round histogram attributes
+                # to this endpoint alone (the straggler drill's lever)
+                fault_injector().fire("pserver.serve")
                 try:
                     # the handler BUFFERS its reply and sends it only
                     # after the span context manager has exited: the
@@ -621,15 +627,18 @@ class VariableServer:
                             reply = ("OK", "", b"")
                         elif verb == "SEND":
                             tid = self._trainer_id(peer or "anon")
-                            value = deserialize_var(payload, copy=False)
-                            if self.sync:
-                                with self._lock:
-                                    # per-trainer grad rename
-                                    # (listen_and_serv :82)
-                                    self.scope.set_var(
-                                        f"{name}.trainer_{tid}", value)
-                            else:
-                                self._apply_async(name, value)
+                            with obs_attr.phase("pserver", "recv"):
+                                value = deserialize_var(
+                                    payload, copy=False)
+                                if self.sync:
+                                    with self._lock:
+                                        # per-trainer grad rename
+                                        # (listen_and_serv :82)
+                                        self.scope.set_var(
+                                            f"{name}.trainer_{tid}",
+                                            value)
+                                else:
+                                    self._apply_async(name, value)
                             reply = ("OK", "", b"")
                         elif verb == "SEND_BATCH" and self.enable_batch:
                             tid = self._trainer_id(peer or "anon")
@@ -637,14 +646,16 @@ class VariableServer:
                             # lock (views over the frame buffer, no
                             # per-var copies), apply under ONE
                             # acquisition
-                            pairs = deserialize_batch(payload)
-                            if self.sync:
-                                with self._lock:
-                                    for n, v in pairs:
-                                        self.scope.set_var(
-                                            f"{n}.trainer_{tid}", v)
-                            else:
-                                self._apply_async_bucket(pairs)
+                            with obs_attr.phase("pserver", "recv"):
+                                pairs = deserialize_batch(payload)
+                                if self.sync:
+                                    with self._lock:
+                                        for n, v in pairs:
+                                            self.scope.set_var(
+                                                f"{n}.trainer_{tid}",
+                                                v)
+                                else:
+                                    self._apply_async_bucket(pairs)
                             reply = ("OK", "", b"")
                         elif verb == "GET_BATCH" and self.enable_batch:
                             names = json.loads(bytes(payload))
@@ -727,7 +738,9 @@ class VariableServer:
                             reply = ("OK", "", b"")
                         elif verb == "BARRIER":
                             if self.sync:
-                                self._barrier()
+                                with obs_attr.phase("pserver",
+                                                    "barrier"):
+                                    self._barrier()
                             reply = ("OK", "", b"")
                         elif verb == "GET":
                             val = self._blocking_get(name)
@@ -995,6 +1008,7 @@ class VariableServer:
             self._run_optimize_inner()
         dt = _time.perf_counter() - t0
         _M_OPTIMIZE_SECONDS.observe(dt)
+        obs_attr.observe_phase("pserver", "optimize", dt)
         # flight ring: the optimize cadence is the first thing a
         # post-mortem of a killed pserver reads (no-op unless armed)
         flightrecorder.note("pserver.optimize", round=self._round,

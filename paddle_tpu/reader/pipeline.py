@@ -33,6 +33,7 @@ __all__ = ["prefetch_feeder", "PrefetchIterator", "PrefetchReader",
            "stage_to_device"]
 
 from . import _Error
+from ..observability import attribution as obs_attr
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 
@@ -128,14 +129,18 @@ class PrefetchIterator:
 
     def _prepare(self, batch):
         if self._feeder is not None:
-            feed = self._feeder.feed(batch)
+            with obs_attr.phase("trainer", "feed_pack"):
+                feed = self._feeder.feed(batch)
         else:
             feed = batch  # reader already yields feed dicts
-        if self._device_put and isinstance(feed, dict):
-            feed = {k: stage_to_device(v, self._device)
-                    for k, v in feed.items()}
-        elif self._device_put:
-            feed = stage_to_device(feed, self._device)
+        if not self._device_put:
+            return feed
+        with obs_attr.phase("trainer", "h2d"):
+            if isinstance(feed, dict):
+                feed = {k: stage_to_device(v, self._device)
+                        for k, v in feed.items()}
+            else:
+                feed = stage_to_device(feed, self._device)
         return feed
 
     def _work(self, reader):

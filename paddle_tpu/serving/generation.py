@@ -63,6 +63,7 @@ import json
 import os
 import threading
 import time
+import warnings
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
@@ -70,6 +71,7 @@ import numpy as np
 
 from ..core.resilience import (fault_injector,
                                sched_fault_armed as _sched_fault)
+from ..observability import attribution as obs_attr
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from .batching import RequestDeadlineExceeded, ServerSaturated
@@ -256,8 +258,8 @@ class _Seq:
 
     __slots__ = ("stream", "tokens", "prompt_len", "max_new", "eos_id",
                  "temperature", "seed", "cur", "slot", "emitted",
-                 "t_submit", "expires", "trace_ctx", "draft_next",
-                 "prompt_keys")
+                 "t_submit", "t_submit_wall", "expires", "trace_ctx",
+                 "draft_next", "prompt_keys")
 
     def __init__(self, stream, max_new, eos_id, temperature, seed,
                  expires, trace_ctx):
@@ -272,6 +274,7 @@ class _Seq:
         self.slot = -1
         self.emitted = 0
         self.t_submit = time.perf_counter()
+        self.t_submit_wall = time.time()
         self.expires = expires
         self.trace_ctx = trace_ctx
         # next position the DRAFT model's KV is missing (speculative
@@ -749,9 +752,10 @@ class GenerationServer:
                 break
             self._queue.popleft()
             try:
-                table, cached = self._cache.allocate_prefix(
-                    seq, seq.positions_needed,
-                    prompt_keys=seq.prompt_keys)
+                with obs_attr.phase("generation", "kv_alloc"):
+                    table, cached = self._cache.allocate_prefix(
+                        seq, seq.positions_needed,
+                        prompt_keys=seq.prompt_keys)
             except KVPoolExhausted:
                 # can_admit/allocate_prefix disagreeing is a bug, but
                 # an unserved admission must back off (head of queue,
@@ -777,12 +781,13 @@ class GenerationServer:
         self._active[seq.slot] = None
         self._tables[seq.slot] = 0
         seq.slot = -1
-        self._cache.release(seq)
+        with obs_attr.phase("generation", "kv_release"):
+            self._cache.release(seq)
 
     def _loop(self):
         dec = self._decoder
         while True:
-            with self._lock:
+            with obs_attr.phase("generation", "admit"), self._lock:
                 if self._stop:
                     return
                 shed = self._shed_expired_locked(time.monotonic())
@@ -813,10 +818,10 @@ class GenerationServer:
                         self._lock.wait(timeout=self._idle_poll_s)
                 continue
             try:
-                # chaos hook: an error rule fails this tick's sequences
-                # (they are evicted, their streams get the error) but
-                # must never kill the scheduler thread
-                fault_injector().fire("serving.decode")
+                # chaos hook fires inside _tick/_tick_spec, within the
+                # attributed phase block: an error rule fails this
+                # tick's sequences (they are evicted, their streams get
+                # the error) but must never kill the scheduler thread
                 if self._draft is None:
                     nxt = self._tick(seqs)
                 else:
@@ -850,11 +855,23 @@ class GenerationServer:
             temps[seq.slot] = seq.temperature
             seeds[seq.slot] = seq.seed
             active[seq.slot] = True
+        # attribution: dispatch is "prefill" while EVERY ticking
+        # sequence is still teacher-forcing its prompt, else "decode"
+        # (mixed ticks are decode work for at least one stream); the
+        # host-side sync that materializes the sampled tokens is
+        # "sample" — on an async backend that is where the device time
+        # surfaces
+        phase_name = ("prefill" if all(s.cur < s.prompt_len - 1
+                                       for s in seqs) else "decode")
         with obs_tracing.span("serving.decode_tick", active=len(seqs)):
-            nxt, self._pool_k, self._pool_v = self._decoder.step(
-                self._states, self._pool_k, self._pool_v, self._tables,
-                positions, tokens, seeds, temps, active)
-            out = np.asarray(nxt)
+            with obs_attr.phase("generation", phase_name):
+                fault_injector().fire("serving.decode")
+                nxt, self._pool_k, self._pool_v = self._decoder.step(
+                    self._states, self._pool_k, self._pool_v,
+                    self._tables, positions, tokens, seeds, temps,
+                    active)
+            with obs_attr.phase("generation", "sample"):
+                out = np.asarray(nxt)
         self._m_ticks.inc()
         return out
 
@@ -863,20 +880,23 @@ class GenerationServer:
         now = time.perf_counter()
         delivered = 0
         finished = []
-        for seq in seqs:
-            tok = int(nxt[seq.slot])
-            seq.cur += 1
-            if seq.cur < seq.prompt_len:
-                continue          # still prefilling: teacher-forced
-            seq.tokens.append(tok)
-            seq.emitted += 1
-            delivered += 1
-            if metrics_on and seq.emitted == 1:
-                self._m_ttft.observe(now - seq.t_submit)
-            seq.stream._put(tok)
-            if (seq.emitted >= seq.max_new
-                    or (seq.eos_id is not None and tok == seq.eos_id)):
-                finished.append(seq)
+        with obs_attr.phase("generation", "deliver"):
+            for seq in seqs:
+                tok = int(nxt[seq.slot])
+                seq.cur += 1
+                if seq.cur < seq.prompt_len:
+                    continue      # still prefilling: teacher-forced
+                seq.tokens.append(tok)
+                seq.emitted += 1
+                delivered += 1
+                if metrics_on and seq.emitted == 1:
+                    with obs_tracing.activate(seq.trace_ctx):
+                        self._m_ttft.observe(now - seq.t_submit)
+                seq.stream._put(tok)
+                if (seq.emitted >= seq.max_new
+                        or (seq.eos_id is not None
+                            and tok == seq.eos_id)):
+                    finished.append(seq)
         if delivered:
             self._m_tokens.inc(delivered)
         if finished:
@@ -885,9 +905,23 @@ class GenerationServer:
                     self._evict_locked(seq)
                 self._lock.notify_all()
             for seq in finished:
-                if metrics_on:
-                    self._m_latency.observe(now - seq.t_submit)
-                seq.stream._finish()
+                self._finish_seq(seq, now, metrics_on)
+
+    def _finish_seq(self, seq: _Seq, now: float, metrics_on: bool):
+        """Close out a finished sequence: record the end-to-end
+        ``serving.request`` span (child of the submitter's context, so
+        router/replica hops join into one trace) and observe latency
+        with that trace active — the histogram exemplar then points at
+        this request's trace."""
+        dur = now - seq.t_submit
+        ctx = obs_tracing.record_span(
+            "serving.request", seq.t_submit_wall, dur,
+            parent=seq.trace_ctx, server=self._sid,
+            tokens=seq.emitted) or seq.trace_ctx
+        if metrics_on:
+            with obs_tracing.activate(ctx):
+                self._m_latency.observe(dur)
+        seq.stream._finish()
 
     # -- speculative path ---------------------------------------------------
     def _tick_spec(self, seqs: List[_Seq]):
@@ -920,59 +954,63 @@ class GenerationServer:
         # propose but STILL keep the draft warm: the prompt blocks
         # they commit to the prefix cache must hold valid draft KV for
         # the greedy sequences that later share them.
-        while True:
-            todo = []
-            for seq, c, m, teacher, n_prop in plans:
-                end = c + teacher - (1 if n_prop else 0)
-                if seq.draft_next < end:
-                    todo.append((seq, min(end - seq.draft_next, w)))
-            if not todo:
-                break
-            pos = np.zeros(self._slots, np.int32)
-            toks = np.zeros((self._slots, w), np.int32)
-            nv = np.zeros(self._slots, np.int32)
-            for seq, n in todo:
-                pos[seq.slot] = seq.draft_next
-                toks[seq.slot, :n] = seq.tokens[
-                    seq.draft_next:seq.draft_next + n]
-                nv[seq.slot] = n
-            _, self._dpool_k, self._dpool_v = self._draft.step_window(
-                self._draft_states, self._dpool_k, self._dpool_v,
-                self._tables, pos, toks,
-                np.zeros(self._slots, np.uint32),
-                np.zeros(self._slots, np.float32), nv)
-            for seq, n in todo:
-                seq.draft_next += n
+        with obs_attr.phase("generation", "draft_verify"):
+            while True:
+                todo = []
+                for seq, c, m, teacher, n_prop in plans:
+                    end = c + teacher - (1 if n_prop else 0)
+                    if seq.draft_next < end:
+                        todo.append(
+                            (seq, min(end - seq.draft_next, w)))
+                if not todo:
+                    break
+                pos = np.zeros(self._slots, np.int32)
+                toks = np.zeros((self._slots, w), np.int32)
+                nv = np.zeros(self._slots, np.int32)
+                for seq, n in todo:
+                    pos[seq.slot] = seq.draft_next
+                    toks[seq.slot, :n] = seq.tokens[
+                        seq.draft_next:seq.draft_next + n]
+                    nv[seq.slot] = n
+                _, self._dpool_k, self._dpool_v = \
+                    self._draft.step_window(
+                        self._draft_states, self._dpool_k,
+                        self._dpool_v, self._tables, pos, toks,
+                        np.zeros(self._slots, np.uint32),
+                        np.zeros(self._slots, np.float32), nv)
+                for seq, n in todo:
+                    seq.draft_next += n
 
-        # proposal micro-steps: the draft extends each eligible slot
-        # greedily, one position per call, batched across slots; step
-        # i feeds the committed frontier token first, then its own
-        # previous proposal
-        max_prop = max((p[4] for p in plans), default=0)
-        proposals: Dict[object, List[int]] = {p[0]: [] for p in plans}
-        for i in range(max_prop):
-            pos = np.zeros(self._slots, np.int32)
-            toks = np.zeros(self._slots, np.int32)
-            act = np.zeros(self._slots, bool)
-            stepping = []
-            for seq, c, m, teacher, n_prop in plans:
-                if i >= n_prop:
-                    continue
-                base = c + teacher - 1
-                pos[seq.slot] = base + i
-                toks[seq.slot] = (seq.tokens[base] if i == 0
-                                  else proposals[seq][-1])
-                act[seq.slot] = True
-                stepping.append(seq)
-            nxt, self._dpool_k, self._dpool_v = self._draft.step(
-                self._draft_states, self._dpool_k, self._dpool_v,
-                self._tables, pos, toks,
-                np.zeros(self._slots, np.uint32),
-                np.zeros(self._slots, np.float32), act)
-            out = np.asarray(nxt)
-            for seq in stepping:
-                proposals[seq].append(int(out[seq.slot]))
-                seq.draft_next = pos[seq.slot] + 1
+            # proposal micro-steps: the draft extends each eligible
+            # slot greedily, one position per call, batched across
+            # slots; step i feeds the committed frontier token first,
+            # then its own previous proposal
+            max_prop = max((p[4] for p in plans), default=0)
+            proposals: Dict[object, List[int]] = {
+                p[0]: [] for p in plans}
+            for i in range(max_prop):
+                pos = np.zeros(self._slots, np.int32)
+                toks = np.zeros(self._slots, np.int32)
+                act = np.zeros(self._slots, bool)
+                stepping = []
+                for seq, c, m, teacher, n_prop in plans:
+                    if i >= n_prop:
+                        continue
+                    base = c + teacher - 1
+                    pos[seq.slot] = base + i
+                    toks[seq.slot] = (seq.tokens[base] if i == 0
+                                      else proposals[seq][-1])
+                    act[seq.slot] = True
+                    stepping.append(seq)
+                nxt, self._dpool_k, self._dpool_v = self._draft.step(
+                    self._draft_states, self._dpool_k, self._dpool_v,
+                    self._tables, pos, toks,
+                    np.zeros(self._slots, np.uint32),
+                    np.zeros(self._slots, np.float32), act)
+                out = np.asarray(nxt)
+                for seq in stepping:
+                    proposals[seq].append(int(out[seq.slot]))
+                    seq.draft_next = pos[seq.slot] + 1
 
         # ONE target dispatch verifies/extends every slot's window
         pos = np.zeros(self._slots, np.int32)
@@ -989,10 +1027,14 @@ class GenerationServer:
             seeds[seq.slot] = seq.seed
         with obs_tracing.span("serving.decode_tick", active=len(seqs),
                               speculative=True):
-            nxt, self._pool_k, self._pool_v = self._decoder.step_window(
-                self._states, self._pool_k, self._pool_v, self._tables,
-                pos, toks, seeds, temps, nv)
-            preds = np.asarray(nxt)
+            with obs_attr.phase("generation", "draft_verify"):
+                fault_injector().fire("serving.decode")
+                nxt, self._pool_k, self._pool_v = \
+                    self._decoder.step_window(
+                        self._states, self._pool_k, self._pool_v,
+                        self._tables, pos, toks, seeds, temps, nv)
+            with obs_attr.phase("generation", "sample"):
+                preds = np.asarray(nxt)
         self._m_ticks.inc()
         full_plans = [(seq, c, m, teacher, n_prop, proposals[seq])
                       for seq, c, m, teacher, n_prop in plans]
@@ -1010,45 +1052,48 @@ class GenerationServer:
         delivered = 0
         proposed = accepted = 0
         finished = []
-        for seq, c, m, teacher, n_prop, props in plans:
-            n_valid = teacher + n_prop
-            window = seq.tokens[c:c + teacher] + props
-            emitted: List[int] = []
-            j_stop = n_valid - 1     # pure-teacher window: no emission
-            j = m - 1
-            if j < n_valid:
-                while True:
-                    tok = int(preds[seq.slot, j])
-                    emitted.append(tok)
-                    if (seq.emitted + len(emitted) >= seq.max_new
-                            or (seq.eos_id is not None
-                                and tok == seq.eos_id)):
+        with obs_attr.phase("generation", "deliver"):
+            for seq, c, m, teacher, n_prop, props in plans:
+                n_valid = teacher + n_prop
+                window = seq.tokens[c:c + teacher] + props
+                emitted: List[int] = []
+                j_stop = n_valid - 1   # pure-teacher: no emission
+                j = m - 1
+                if j < n_valid:
+                    while True:
+                        tok = int(preds[seq.slot, j])
+                        emitted.append(tok)
+                        if (seq.emitted + len(emitted) >= seq.max_new
+                                or (seq.eos_id is not None
+                                    and tok == seq.eos_id)):
+                            j_stop = j
+                            break
+                        if j + 1 < n_valid and tok == window[j + 1]:
+                            j += 1   # proposal verified: keep going
+                            continue
                         j_stop = j
                         break
-                    if j + 1 < n_valid and tok == window[j + 1]:
-                        j += 1       # proposal verified: keep going
-                        continue
-                    j_stop = j
-                    break
-            seq.cur = c + j_stop + 1
-            proposed += n_prop
-            if n_prop:
-                accepted += min(max(len(emitted) - 1, 0), n_prop)
-            # the draft's KV is valid only where it processed tokens
-            # that ended up committed — never past the bonus token
-            seq.draft_next = min(seq.draft_next, seq.cur)
-            if emitted:
-                if metrics_on and seq.emitted == 0:
-                    self._m_ttft.observe(now - seq.t_submit)
-                seq.tokens.extend(emitted)
-                seq.emitted += len(emitted)
-                delivered += len(emitted)
-                for tok in emitted:
-                    seq.stream._put(tok)
-                if (seq.emitted >= seq.max_new
-                        or (seq.eos_id is not None
-                            and emitted[-1] == seq.eos_id)):
-                    finished.append(seq)
+                seq.cur = c + j_stop + 1
+                proposed += n_prop
+                if n_prop:
+                    accepted += min(max(len(emitted) - 1, 0), n_prop)
+                # the draft's KV is valid only where it processed
+                # tokens that ended up committed — never past the
+                # bonus token
+                seq.draft_next = min(seq.draft_next, seq.cur)
+                if emitted:
+                    if metrics_on and seq.emitted == 0:
+                        with obs_tracing.activate(seq.trace_ctx):
+                            self._m_ttft.observe(now - seq.t_submit)
+                    seq.tokens.extend(emitted)
+                    seq.emitted += len(emitted)
+                    delivered += len(emitted)
+                    for tok in emitted:
+                        seq.stream._put(tok)
+                    if (seq.emitted >= seq.max_new
+                            or (seq.eos_id is not None
+                                and emitted[-1] == seq.eos_id)):
+                        finished.append(seq)
         if delivered:
             self._m_tokens.inc(delivered)
         if proposed:
@@ -1061,9 +1106,7 @@ class GenerationServer:
                     self._evict_locked(seq)
                 self._lock.notify_all()
             for seq in finished:
-                if metrics_on:
-                    self._m_latency.observe(now - seq.t_submit)
-                seq.stream._finish()
+                self._finish_seq(seq, now, metrics_on)
 
     def _install_states(self, pending):
         import jax
@@ -1255,4 +1298,23 @@ def server_from_model_dir(dirname: str, *, block_size: Optional[int] = None,
             core_flags.set_flags({"compilation_cache_dir": prev})
     if armed:
         server.warm_start_dir = cache
+    _publish_static_decode_floor(spec, server)
     return server
+
+
+def _publish_static_decode_floor(spec: dict, server: GenerationServer):
+    """Publish the static roofline floor for the decode phase so the
+    collector's calibration detector can band measured-vs-static
+    (docs/observability.md "Time attribution").  Best-effort: the cost
+    model not covering a spec must never block serving."""
+    try:
+        from ..analysis.cost_model import (analyze_generation_spec,
+                                           roofline_seconds)
+        rows = analyze_generation_spec(
+            spec, slots=server._slots)["kernels"]
+        step = rows[0]
+        obs_attr.publish_static_floor("generation", {
+            "decode": roofline_seconds(step["flops"], step["bytes"]),
+        })
+    except Exception as e:
+        warnings.warn(f"static decode floor unavailable: {e!r}")

@@ -54,6 +54,7 @@ import time
 from typing import Iterator, Optional
 
 from ..core.resilience import fault_injector
+from ..observability import tracing as obs_tracing
 from .batching import RequestDeadlineExceeded, ServerSaturated
 
 __all__ = ["ReplicaServer", "ReplicaError", "ReplicaShed",
@@ -238,6 +239,18 @@ class ReplicaServer:
                 self._inflight -= 1
 
     def _op_generate_inner(self, f, req):
+        # join the router's trace: the propagated context (riding the
+        # request JSON) parents this replica-side span — and, through
+        # submit()'s context capture, the generation server's own
+        # serving.request span — under the front door's root span, so
+        # `cli trace-of` shows one tree across the three processes
+        with obs_tracing.activate(
+                obs_tracing.extract(req.get("trace"))), \
+                obs_tracing.span("replica.generate",
+                                 max_new=int(req["max_new"])):
+            self._op_generate_traced(f, req)
+
+    def _op_generate_traced(self, f, req):
         try:
             stream = self._server.submit(
                 req["prompt"], int(req["max_new"]),

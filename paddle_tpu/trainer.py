@@ -21,6 +21,7 @@ from .core.framework import (
     default_startup_program,
 )
 from .data_feeder import DataFeeder
+from .observability import attribution as obs_attr
 from .observability import flightrecorder
 from .observability import metrics as obs_metrics
 from .observability import tracing as obs_tracing
@@ -389,9 +390,18 @@ class Trainer:
         lazy = sync_every_n > 1
         def make_feeds(rd):
             if prefetch > 0:
+                # feed_pack/h2d attribution happens on the prefetch
+                # worker (reader/pipeline.py)
                 return prefetch_feeder(rd, feeder, self.place,
                                        depth=prefetch)()
-            return (feeder.feed(b) for b in rd())
+
+            def packed():
+                for b in rd():
+                    with obs_attr.phase("trainer", "feed_pack"):
+                        feed = feeder.feed(b)
+                    yield feed
+            return packed()
+        self._publish_static_floor()
         if resume_from is not None and checkpoint_dir is None:
             checkpoint_dir = resume_from
         first_pass, skip_batches = 0, 0
@@ -454,9 +464,11 @@ class Trainer:
                     with obs_tracing.span("trainer.step",
                                           pass_id=pass_id,
                                           batch_id=batch_id):
-                        outs = self.exe.run(self.main_program, feed=feed,
-                                            fetch_list=fetches,
-                                            return_numpy=not lazy)
+                        with obs_attr.phase("trainer", "compute"):
+                            outs = self.exe.run(
+                                self.main_program, feed=feed,
+                                fetch_list=fetches,
+                                return_numpy=not lazy)
                     if lazy:
                         cost = LazyFetch(outs[0])
                         # metrics stay RAW device arrays: jax arrays are
@@ -519,6 +531,24 @@ class Trainer:
             if checkpoint_dir is not None and checkpoint_every_n_passes > 0 \
                     and (pass_id + 1) % checkpoint_every_n_passes == 0:
                 _save(pass_id + 1, 0)
+
+    def _publish_static_floor(self):
+        """Static roofline floor for the compute phase, for the
+        collector's calibration-drift detector (docs/observability.md
+        "Time attribution").  Best-effort and gated: never slows or
+        breaks an uninstrumented run."""
+        if not obs_metrics.enabled():
+            return
+        try:
+            from .analysis.cost_model import (estimate_program,
+                                              roofline_seconds)
+            est = estimate_program(self.main_program)
+            obs_attr.publish_static_floor("trainer", {
+                "compute": roofline_seconds(est.total_flops,
+                                            est.total_bytes),
+            })
+        except Exception:
+            pass
 
     def test(self, reader: Callable, feeder: Optional[DataFeeder] = None,
              fetch_list: Optional[Sequence] = None):

@@ -75,6 +75,34 @@ def role_pserver(args):
     return 0
 
 
+def role_comm_trainer(args):
+    """Trainer driving FUSED rounds through a CommPool against SEVERAL
+    pservers (--endpoint ep1,ep2) — the per-endpoint round histogram
+    the straggler detector z-scores only exists on this path."""
+    import numpy as np
+
+    import paddle_tpu as fluid  # noqa: F401 (registers the series)
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.observability.collector import maybe_announce
+    from paddle_tpu.parallel.comm import CommPool
+
+    maybe_announce("trainer")
+    eps = [e for e in args.endpoint.split(",") if e]
+    pool = CommPool()
+    for i in range(args.rounds):
+        with tracing.span("trainer.step", batch_id=i):
+            pool.send_round(
+                [(ep, "w@GRAD", np.full(8, 0.1, np.float32))
+                 for ep in eps],
+                [(ep, "w") for ep in eps])
+        print(f"TRAINER_ROUND {i}", flush=True)
+        time.sleep(0.1)
+    print("TRAINER_DONE", flush=True)
+    time.sleep(args.linger_s)  # stay scrape-able until the driver kills
+    pool.close()
+    return 0
+
+
 def role_trainer(args):
     import numpy as np
 
@@ -412,15 +440,295 @@ def drill_autoscale(args):
         telem_registry.close()
 
 
+# ---------------------------------------------------------------------------
+# time-attribution drill (tools/ci_check.sh step 13)
+# ---------------------------------------------------------------------------
+
+_PHASE_OVERHEAD_PROBE = r"""
+import json, time
+import numpy as np
+from paddle_tpu.observability import attribution, exemplars, metrics, tracing
+
+assert not metrics.enabled() and not tracing.enabled()
+x = np.random.RandomState(0).rand(512, 512)
+n = 100
+
+
+def step_light():
+    return float(x.sum())          # ~100 us: worst case for noop sites
+
+
+def step_tick():
+    return float((x @ x)[0, 0])    # ~ms: a realistic serving-tick body
+
+
+def plain(step):
+    acc = 0.0
+    for _ in range(n):
+        acc += step()
+    return acc
+
+
+def attributed(step, traced):
+    acc = 0.0
+    for _ in range(n):
+        if traced:
+            with tracing.span("probe.tick"):
+                with attribution.phase("generation", "decode"):
+                    acc += step()
+                for ph in ("sample", "deliver", "kv_alloc", "admit"):
+                    with attribution.phase("generation", ph):
+                        pass
+        else:
+            with attribution.phase("generation", "decode"):
+                acc += step()
+            for ph in ("sample", "deliver", "kv_alloc", "admit"):
+                with attribution.phase("generation", ph):
+                    pass
+    return acc
+
+
+def measure(step, traced):
+    plain(step)  # warm both paths
+    attributed(step, traced)
+    ratios = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        plain(step)
+        t_plain = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        attributed(step, traced)
+        t_attr = time.perf_counter() - t0
+        ratios.append(t_attr / t_plain)
+        tracing.clear()
+    return min(ratios) - 1.0, [round(r, 3) for r in ratios]
+
+# (1) whole stack off: five noop phase() sites on the ~100 us step
+off, off_ratios = measure(step_light, traced=False)
+# (2) everything armed — metrics + tracing + exemplars + tail sampler —
+# on a tick-sized step, each iteration under a root span so every
+# histogram observation records an exemplar and the sampler sees the
+# full span tree (threshold high enough that nothing is ever kept:
+# steady-state cost, not flush cost)
+metrics.set_enabled(True)
+tracing.set_enabled(True)
+exemplars.set_armed(True)
+tracing.arm_tail_sampler(threshold_s=3600.0)
+on, on_ratios = measure(step_tick, traced=True)
+print(json.dumps({"overhead_off": off, "off_ratios": off_ratios,
+                  "overhead_on": on, "on_ratios": on_ratios}))
+"""
+
+
+def _phase_overhead_guard(attempts=2):
+    """Both ends of the attribution cost spectrum must stay < 5%:
+    five disarmed phase() sites on a ~100 us step (noop path), and the
+    fully armed plane — metrics + tracing + exemplars + tail sampler —
+    on a tick-sized step.  Same fresh-subprocess + one-retry ladder as
+    the tests/test_observability.py guards (noise only ever INFLATES a
+    round, so min-of-rounds + best-of-attempts is the honest floor)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_TPU_METRICS",
+                                "PADDLE_TPU_TRACE",
+                                "PADDLE_TPU_FLIGHT",
+                                "PADDLE_TPU_EXEMPLARS",
+                                "PADDLE_TPU_TAIL_SAMPLE"))}
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    import json
+    best = None
+    for _ in range(attempts):
+        out = subprocess.run(
+            [sys.executable, "-c", _PHASE_OVERHEAD_PROBE], text=True,
+            capture_output=True, env=env, timeout=180)
+        assert out.returncode == 0, out.stderr
+        verdict = json.loads(out.stdout.strip().splitlines()[-1])
+        verdict["worst"] = max(verdict["overhead_off"],
+                               verdict["overhead_on"])
+        if best is None or verdict["worst"] < best["worst"]:
+            best = verdict
+        if best["worst"] < 0.05:
+            break
+    assert best["worst"] < 0.05, \
+        (f"attribution overhead: off {best['overhead_off']:.1%} "
+         f"({best['off_ratios']}), armed {best['overhead_on']:.1%} "
+         f"({best['on_ratios']})")
+    print(f"  [drill] attribution overhead: disarmed "
+          f"{best['overhead_off']:.1%}, fully armed "
+          f"{best['overhead_on']:.1%} (< 5% guard)")
+
+
+def drill_attribution(args):
+    """Time-attribution acceptance (docs/observability.md "Time
+    attribution"): a mini-fleet with the attribution plane armed —
+    2 pservers (one delay-faulted into a straggler), a CommPool
+    trainer, a decode-delay-faulted serving replica with exemplars +
+    tail sampling on.  Asserts per-phase series federate from all
+    three member kinds, the `cli why` table shows the decode-delay
+    fault as the dominant generation phase, a latency exemplar
+    resolves through `cli trace-of` to a JOINED Chrome trace, the
+    straggler endpoint is flagged within one collector window, and
+    the plane stays under the 5% overhead guard both disarmed and
+    fully armed (exemplars + tail sampling on).  The
+    federated dump goes to --out for the `cli slo --check --prom`
+    gate that follows in ci_check."""
+    import json
+
+    from paddle_tpu import cli as cli_mod
+    from paddle_tpu.cloud.registry import Registry
+    from paddle_tpu.observability import attribution
+    from paddle_tpu.observability.collector import (TelemetryCollector,
+                                                    assemble_traces,
+                                                    parse_prometheus_text)
+    from paddle_tpu.serving.replica import replica_stream
+
+    _phase_overhead_guard()
+
+    workdir = tempfile.mkdtemp(prefix="paddle_attr_drill_")
+    trace_dir = os.path.join(workdir, "traces")
+    print(f"attribution drill workdir: {workdir}")
+
+    registry = Registry()
+    reg_addr = f"127.0.0.1:{registry.serve(0)}"
+    coll = TelemetryCollector(registry_addr=reg_addr, period_s=0.3,
+                              scrape_timeout_s=1.0)
+
+    base_env = dict(os.environ,
+                    JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
+                                                 "cpu"),
+                    PADDLE_TPU_METRICS="on",
+                    PADDLE_TPU_TELEMETRY_REGISTRY=reg_addr,
+                    PADDLE_TPU_TRACE_DIR=trace_dir,
+                    PADDLE_TPU_EXEMPLARS="on",
+                    PADDLE_TPU_TAIL_SAMPLE="0.05")
+    logf = open(os.path.join(workdir, "children.log"), "w")
+    me = [sys.executable, os.path.abspath(__file__)]
+    procs = []
+    try:
+        # pserver A healthy; pserver B serves every frame 50 ms late —
+        # the client-side per-endpoint round histogram pins the drift
+        # on B alone
+        ports = []
+        for fault in ("", "pserver.serve:delay:1:1000000000:0.05"):
+            env = dict(base_env)
+            if fault:
+                env["PADDLE_TPU_FAULTS"] = fault
+            p = _spawn(me + ["--role", "pserver", "--run_s", "600"],
+                       env, logf)
+            procs.append(p)
+            ports.append(int(_wait_line(
+                p, "PSERVER_PORT", 180,
+                f"pserver{'B' if fault else 'A'}")[1]))
+        straggler_ep = f"127.0.0.1:{ports[1]}"
+        coll.start()
+
+        trainer = _spawn(
+            me + ["--role", "comm_trainer", "--endpoint",
+                  ",".join(f"127.0.0.1:{p}" for p in ports),
+                  "--rounds", str(args.rounds)], base_env, logf)
+        procs.append(trainer)
+
+        # the replica's decode phase eats a 30 ms injected delay per
+        # tick: `cli why` must show decode dominating, and every
+        # request is slow enough for the tail sampler to keep
+        model_dir = _build_model_dir(workdir)
+        env = dict(base_env,
+                   PADDLE_TPU_FAULTS="serving.decode:delay:1:"
+                   "1000000000:0.03")
+        replica = _spawn([sys.executable, "-m", "paddle_tpu.cli",
+                          "serve", model_dir, "--use_tpu", "0"],
+                         env, logf)
+        procs.append(replica)
+        replica_addr = _wait_line(replica, "serving ", 300,
+                                  "replica")[3]
+
+        for i in range(4):
+            toks = list(replica_stream(
+                replica_addr,
+                {"op": "generate", "prompt": [1, 2, 3], "max_new": 5},
+                timeout_s=300))
+            assert toks, "replica generated nothing"
+            time.sleep(0.4)
+        _wait_line(trainer, "TRAINER_DONE", 180, "trainer")
+
+        time.sleep(1.2)  # tail-sampler flush cadence + a scrape period
+        coll.scrape_once()  # deterministic final sweep + detector pass
+
+        text = coll.federation_text()
+        # (a) per-phase series federated from all three member kinds
+        for kind in ("generation", "trainer", "pserver"):
+            series = f"paddle_tpu_{kind}_phase_seconds"
+            assert series in text, f"missing {series}"
+            assert f'kind="{kind}"' in text, f"no {kind} member"
+        parsed = parse_prometheus_text(text)
+        rows = attribution.why_rows_from_parsed(parsed)
+        print()
+        print(attribution.format_why_table(rows))
+        print()
+        gen = {r["phase"]: r for r in rows
+               if r["kind"] == "generation"}
+        assert gen["decode"]["share"] > 0.35, \
+            f"decode-delay fault invisible in why-table: {gen}"
+        assert rows[0] is not None and len(
+            {r["kind"] for r in rows}) == 3
+
+        # (b) straggler flagged within one collector window
+        strag = parsed.get(attribution.STRAGGLER_METRIC)
+        assert strag, "no straggler scores in federation"
+        scores = {s["labels"]["endpoint"]: s["value"]
+                  for s in strag["samples"]}
+        assert scores.get(straggler_ep, 0.0) >= 3.0, \
+            f"straggler {straggler_ep} not flagged: {scores}"
+        footer = cli_mod.format_straggler_lines(coll)
+        assert "STRAGGLER" in footer, footer
+        print(footer)
+
+        # (c) exemplar -> joined end-to-end Chrome trace
+        ex = attribution.pick_exemplar(
+            parsed, "paddle_tpu_serving_generation_seconds")
+        assert ex, "no exemplar on the generation latency histogram"
+        joined = assemble_traces(trace_dir)
+        assert ex["trace_id"] in joined, \
+            (ex["trace_id"], sorted(joined))
+        with open(joined[ex["trace_id"]]) as f:
+            names = {e["name"]
+                     for e in json.load(f)["traceEvents"]}
+        assert "serving.request" in names, names
+        print(f"  [drill] p99 exemplar {ex['value']:.3f}s -> trace "
+              f"{ex['trace_id']} -> {joined[ex['trace_id']]} "
+              f"({len(names)} span names)")
+
+        out = coll.write_federation(args.out)
+        print(f"federated Prometheus dump -> {out}")
+
+        # the `cli trace-of` surface end to end, off the written dump
+        rc = cli_mod.cmd_trace_of(
+            ["--metric", "paddle_tpu_serving_generation_seconds",
+             "--prom", out, "--p99", "--trace-dir", trace_dir])
+        assert rc == 0, "cli trace-of failed"
+        print("attribution drill: all green")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        coll.close()
+        registry.close()
+        logf.close()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--role", default="driver",
-                    choices=["driver", "pserver", "trainer"])
+                    choices=["driver", "pserver", "trainer",
+                             "comm_trainer"])
     ap.add_argument("--drill", default="telemetry",
-                    choices=["telemetry", "autoscale"],
+                    choices=["telemetry", "autoscale", "attribution"],
                     help="telemetry: the step-11 federation smoke; "
                     "autoscale: the step-12 scale-out/SIGKILL/"
-                    "scale-in chaos drill")
+                    "scale-in chaos drill; attribution: the step-13 "
+                    "time-attribution drill (phases, exemplars, "
+                    "stragglers)")
     ap.add_argument("--out", default="/tmp/paddle_tpu_fleet.prom")
     ap.add_argument("--endpoint", default="")
     ap.add_argument("--rounds", type=int, default=8)
@@ -436,8 +744,12 @@ def main(argv=None):
         return role_pserver(args)
     if args.role == "trainer":
         return role_trainer(args)
+    if args.role == "comm_trainer":
+        return role_comm_trainer(args)
     if args.drill == "autoscale":
         return drill_autoscale(args)
+    if args.drill == "attribution":
+        return drill_attribution(args)
     return driver(args)
 
 
