@@ -50,7 +50,13 @@ KV-quantization residency table, and a Prometheus dump at
 BENCH_SERVING_PROM if set.  Knobs: BENCH_SERVING_PREFIX_POOL/
 _PREFIX_LEN/_PREFIX_HIT shape the shared-prefix workload,
 BENCH_SERVING_SPEC_K sets the draft length, BENCH_SERVING_SPEC=0 /
-BENCH_SERVING_QUANT=0 skip those sections), BENCH_SERVING_RAMP=1
+BENCH_SERVING_QUANT=0 / BENCH_SERVING_KERNELS=0 skip those sections),
+BENCH_KERNELS=1 (serving-kernel microbench: each fused Pallas kernel —
+paged-attention decode fp32+int8, MoE gate+dispatch, fused bucket
+update — vs its XLA oracle path, best-of-BENCH_KERNELS_TRIALS
+throughput plus the kernel-backed static bytes-moved rows; off-TPU the
+Pallas legs run interpret mode, so the CPU numbers demonstrate the
+path, the bytes delta is the TPU argument), BENCH_SERVING_RAMP=1
 (open-loop load ramp against a LIVE autoscaling fleet — router +
 autoscaler + `cli serve` replicas from a warm-start model dir: rate
 ramps up then down, reporting per-phase tokens/s and p99, the scaling
@@ -435,6 +441,167 @@ def run_comm_bench(n_grads=64, dim=16, rounds=4, pservers=2, trials=3):
             "params_identical": identical}
 
 
+# serving-kernel microbench decoders are cached at module level: both
+# trials AND any later bench section reuse the same compiled step —
+# no per-row rebuilds (the PR 8 compile-budget discipline)
+_KERNEL_DECODERS = {}
+
+
+def run_kernels_bench(trials=None, ticks=None):
+    """Serving-kernel microbench (BENCH_KERNELS=1): each fused Pallas
+    kernel against the XLA oracle path it replaces — paged-attention
+    decode (fp32 + quantized int8 KV), fused MoE gate+dispatch, fused
+    per-bucket optimizer update.  Rows are best-of-`trials` measured
+    throughput plus the kernel-backed static bytes-moved from
+    analysis/cost_model.py (what each path charges the roofline).
+
+    Off-TPU the Pallas rows run in interpret mode, so measured CPU
+    throughput favors XLA by construction — those rows demonstrate the
+    PATH and its numerics; the bytes-moved delta is the TPU argument
+    (docs/performance.md "Serving kernels")."""
+    import jax
+    import jax.numpy as jnp
+
+    from run_serving import VOCAB, _build_decoder, _build_kernel_decoder
+    from paddle_tpu.analysis.cost_model import serving_kernel_cost
+    from paddle_tpu.kernels import (build_fused_bucket_update,
+                                    build_moe_gate_dispatch)
+    from paddle_tpu.parallel.moe import moe_gate
+
+    trials = trials or int(os.environ.get("BENCH_KERNELS_TRIALS", "2"))
+    ticks = ticks or int(os.environ.get("BENCH_KERNELS_TICKS", "8"))
+    d_model, n_heads, n_layers, bs, nb, slots = 128, 4, 2, 8, 12, 4
+    rng = np.random.RandomState(0)
+
+    def best_rate(fn, units):
+        b = 0.0
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            fn()
+            b = max(b, units / (time.perf_counter() - t0))
+        return round(b, 1)
+
+    # -- paged-attention decode: full decode tick, gather vs fused ----
+    att = {}
+    for kv_dtype in ("fp32", "int8"):
+        spec = dict(d_model=d_model, n_layers=n_layers,
+                    n_heads=n_heads, vocab_size=VOCAB, block_size=bs,
+                    max_blocks_per_seq=nb, kv_dtype=kv_dtype)
+        row = {}
+        for label, build in (("xla", _build_decoder),
+                             ("pallas", _build_kernel_decoder)):
+            key = (label, kv_dtype)
+            if key not in _KERNEL_DECODERS:
+                _KERNEL_DECODERS[key] = build(
+                    d_model, n_layers, n_heads, bs, nb,
+                    kv_dtype=kv_dtype)
+            dec, states = _KERNEL_DECODERS[key]
+            sj = {k: jnp.asarray(v) for k, v in states.items()}
+            tables = jnp.zeros((slots, nb), jnp.int32)
+            positions = jnp.full((slots,), bs * nb // 2, jnp.int32)
+            zi = jnp.zeros((slots,), jnp.int32)
+            temps = jnp.zeros((slots,), jnp.float32)
+            act = jnp.ones((slots,), bool)
+
+            def run(dec=dec, sj=sj):
+                # pools re-initialized per trial: step() donates them
+                pk, pv = dec.init_pool(nb)
+                for _ in range(ticks):
+                    toks, pk, pv = dec.step(sj, pk, pv, tables,
+                                            positions, zi, zi, temps,
+                                            act)
+                jax.block_until_ready(toks)
+
+            run()  # warmup: compile outside the timed trials
+            est = serving_kernel_cost(
+                "paged_decode_step", spec, slots=slots,
+                context=bs * nb // 2, kv_dtype=kv_dtype, backend=label)
+            row[label] = {
+                "tokens_per_sec": best_rate(run, slots * ticks),
+                "est_bytes_per_tick": est["bytes"],
+                "kernel": dec.kernels.get("paged_attention_decode")}
+        row["bytes_ratio_pallas_vs_xla"] = round(
+            row["pallas"]["est_bytes_per_tick"]
+            / row["xla"]["est_bytes_per_tick"], 3)
+        att[kv_dtype] = row
+    out = {"paged_attention_decode": att}
+
+    # -- fused MoE gate+dispatch vs the oracle op chain ---------------
+    T, D, E, C, top_k = 64, 64, 4, 24, 2
+    x = jnp.asarray(rng.standard_normal((T, D)).astype(np.float32))
+    gw = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+
+    @jax.jit
+    def moe_oracle(x, gw):
+        dispatch, combine, aux = moe_gate(x, gw, E, C, top_k=top_k)
+        expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                               dispatch).astype(x.dtype)
+        return expert_in, combine, aux
+
+    fused = jax.jit(build_moe_gate_dispatch(
+        tokens=T, d_model=D, num_experts=E, capacity=C, top_k=top_k,
+        interpret=True))
+    moe_iters = 4 * ticks
+    est = serving_kernel_cost(
+        "moe_gate_dispatch", {"d_model": D, "n_heads": 1,
+                              "n_layers": 1, "vocab_size": VOCAB},
+        tokens=T, num_experts=E, capacity=C, top_k=top_k)
+
+    def run_moe(fn):
+        def go():
+            for _ in range(moe_iters):
+                r = fn(x, gw)
+            jax.block_until_ready(r)
+        go()  # warmup
+        return best_rate(go, T * moe_iters)
+
+    out["moe_gate_dispatch"] = {
+        "xla": {"tokens_per_sec": run_moe(moe_oracle)},
+        "pallas": {"tokens_per_sec": run_moe(fused)},
+        "est_bytes": est["bytes"],
+        "routing_bytes_avoided": est["routing_bytes_avoided"],
+        "tokens": T, "num_experts": E, "capacity": C, "top_k": top_k}
+
+    # -- fused bucket update vs the per-parameter chain ---------------
+    n_params, per = 16, 4096
+    numel = n_params * per
+    parts = [jnp.asarray(rng.standard_normal(per).astype(np.float32))
+             for _ in range(n_params)]
+    gparts = [jnp.asarray(rng.standard_normal(per).astype(np.float32))
+              for _ in range(n_params)]
+    lr = jnp.float32(0.01)
+
+    @jax.jit
+    def chain(ps, gs, lr):
+        return [p - lr * g for p, g in zip(ps, gs)]
+
+    upd = build_fused_bucket_update(numel=numel, interpret=True)
+
+    @jax.jit
+    def fused_upd(ps, gs, lr):
+        return upd(jnp.concatenate(ps), jnp.concatenate(gs), lr)
+
+    upd_iters = 8 * ticks
+
+    def run_upd(fn):
+        def go():
+            for _ in range(upd_iters):
+                r = fn(parts, gparts, lr)
+            jax.block_until_ready(r)
+        go()  # warmup
+        return best_rate(go, numel * upd_iters)
+
+    est = serving_kernel_cost("fused_bucket_update", {}, numel=numel,
+                              n_params=n_params)
+    out["fused_bucket_update"] = {
+        "xla_chain": {"elems_per_sec": run_upd(chain)},
+        "pallas": {"elems_per_sec": run_upd(fused_upd)},
+        "est_bytes": est["bytes"],
+        "launches_replaced": est["launches_replaced"],
+        "numel": numel, "n_params": n_params}
+    return out
+
+
 def main():
     import paddle_tpu as fluid
     from harness import gated_time_program
@@ -499,7 +666,13 @@ def main():
             with_spec=env("BENCH_SERVING_SPEC", "1").lower() not in (
                 "0", "false", "no", "off"),
             with_quant=env("BENCH_SERVING_QUANT", "1").lower() not in (
-                "0", "false", "no", "off"))
+                "0", "false", "no", "off"),
+            with_kernels=env("BENCH_SERVING_KERNELS",
+                             "1").lower() not in ("0", "false", "no",
+                                                  "off"))
+    if os.environ.get("BENCH_KERNELS", "0").lower() in ("1", "true",
+                                                        "yes", "on"):
+        out["kernels"] = run_kernels_bench()
     if os.environ.get("BENCH_SERVING_RAMP", "0").lower() in (
             "1", "true", "yes", "on"):
         from run_serving import run_fleet_ramp_bench

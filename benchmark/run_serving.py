@@ -20,6 +20,12 @@ hit ratio — the millions-of-users shape) through the ablation ladder:
   prefix         + block-level prefix caching
   spec           + speculative decoding (draft model)
   prefix+spec    both
+  kernels        + the Pallas serving-kernel tier (serving_kernels=on:
+                 fused paged-attention decode instead of the XLA
+                 gather composition; interpret mode off-TPU, so the
+                 CPU row demonstrates the PATH and its bit-identical
+                 numerics, not kernel speed — the speed argument is
+                 the static roofline section below)
 
 Every row runs the same request set and reports sustained tokens/s,
 p50/p99 request latency, shed rate, peak/mean KV-pool utilization,
@@ -33,11 +39,18 @@ A final section sizes KV QUANTIZATION: same device byte budget, pool
 blocks re-derived per kv_dtype, long-lived requests — reporting how
 many sequences each precision holds resident at once.
 
+The ROOFLINE section closes the loop on the serving-kernel tier:
+before/after static rows for the decode step (XLA gather composition
+vs fused Pallas paged attention) on the quantized-KV mix, plus a
+static_vs_measured calibration of the kernel-backed estimates against
+XLA's per-step cost analysis (band: flops [0.5, 2.5]x, bytes
+[0.4, 3]x — tests/test_cost_model.py's documented tolerance).
+
 Usage: python benchmark/run_serving.py [--requests 48] [--rate 0]
        [--slots 4] [--kv-blocks 56] [--block-size 8] [--d-model 128]
        [--layers 2] [--heads 4] [--prefix-pool 3] [--prefix-len 24]
        [--prefix-hit 0.75] [--spec-k 4] [--no-spec] [--no-quant]
-       [--prom_out serving_prom.txt]
+       [--no-kernels] [--prom_out serving_prom.txt]
 (--rate 0 = saturation: the whole request set arrives up front.)
 """
 from __future__ import annotations
@@ -239,6 +252,7 @@ def run_load(dec, states, reqs, *, static_batch=False, slots=4,
         "resident_peak": int(max(resident_samples))
         if resident_samples else None,
         "decode_ticks": stats["ticks"],
+        "decode_kernel": stats.get("decode_kernel", "xla"),
         "prefix_hit_rate": round(stats["prefix_hits"] / lookups, 3)
         if lookups else None,
         "draft_accept_rate": round(
@@ -291,13 +305,118 @@ def _quant_residency(d_model, n_layers, n_heads, block_size, max_blocks,
     return rows
 
 
+def _build_kernel_decoder(d_model, n_layers, n_heads, block_size,
+                          max_blocks, kv_dtype=None, states=None):
+    """`_build_decoder` with the serving-kernel tier forced ON for the
+    duration of the build (kernel selection happens at build time),
+    restoring the user's flag after."""
+    from paddle_tpu.core import flags as core_flags
+
+    prev = core_flags.get_flag("serving_kernels")
+    core_flags.set_flags({"serving_kernels": "on"})
+    try:
+        return _build_decoder(d_model, n_layers, n_heads, block_size,
+                              max_blocks, kv_dtype=kv_dtype,
+                              states=states)
+    finally:
+        core_flags.set_flags({"serving_kernels": prev})
+
+
+def _measured_step_cost(d_model, n_layers, n_heads, block_size,
+                        max_blocks, kv_dtype, slots, kernels_on):
+    """XLA-measured (flops, bytes accessed) for ONE compiled decode
+    tick of a freshly built decoder — the calibration denominator.
+
+    The probe right-sizes the KV pool (`max_blocks` blocks) and parks
+    every cursor at full context: XLA's accounting is per-OP (a gather
+    "accesses" its whole operand), so an oversized pool inflates
+    measured bytes with buffer size — traffic the per-step static
+    model deliberately does not charge."""
+    import jax.numpy as jnp
+
+    build = _build_kernel_decoder if kernels_on else _build_decoder
+    dec, states = build(d_model, n_layers, n_heads, block_size,
+                        max_blocks, kv_dtype=kv_dtype)
+    sj = {n: jnp.asarray(v) for n, v in states.items()}
+    pool_k, pool_v = dec.init_pool(max_blocks)
+    tables = jnp.zeros((slots, max_blocks), jnp.int32)
+    positions = jnp.full((slots,), block_size * max_blocks - 1,
+                         jnp.int32)
+    zi = jnp.zeros((slots,), jnp.int32)
+    lowered = dec.step.lower(sj, pool_k, pool_v, tables, positions,
+                             zi, zi, jnp.zeros((slots,), jnp.float32),
+                             jnp.ones((slots,), bool))
+    ca = lowered.compile().cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    backend = dec.kernels.get("paged_attention_decode", "xla")
+    return (float((ca or {}).get("flops", 0.0)),
+            float((ca or {}).get("bytes accessed", 0.0)), backend)
+
+
+def kernel_roofline(d_model, n_layers, n_heads, block_size, max_blocks,
+                    slots, kv_dtypes=("fp32", "int8"), calibrate=True):
+    """Before/after roofline rows for the decode step — the XLA gather
+    composition vs the fused Pallas paged-attention kernel — on the
+    quantized-KV mix, plus the static_vs_measured calibration of the
+    kernel-backed estimates.  Band per tests/test_cost_model.py:
+    flops within [0.5, 2.5]x and bytes within [0.4, 3]x of XLA's
+    per-step cost analysis (estimated / measured)."""
+    from paddle_tpu.analysis.cost_model import (roofline_seconds,
+                                                serving_kernel_cost)
+
+    ctx = block_size * max_blocks
+    out = {"slots": slots, "context": ctx, "rows": [],
+           "band": {"flops": [0.5, 2.5], "bytes": [0.4, 3.0]},
+           "pallas_vs_xla_bytes": {}}
+    in_band = True
+    for kv_dtype in kv_dtypes:
+        spec = dict(d_model=d_model, n_layers=n_layers,
+                    n_heads=n_heads, vocab_size=VOCAB,
+                    block_size=block_size,
+                    max_blocks_per_seq=max_blocks, kv_dtype=kv_dtype)
+        pair = {}
+        for kernels_on, backend in ((False, "xla"), (True, "pallas")):
+            est = serving_kernel_cost(
+                "paged_decode_step", spec, slots=slots, context=ctx,
+                kv_dtype=kv_dtype, backend=backend)
+            row = {"kv_dtype": kv_dtype, "backend": backend,
+                   "est_flops": est["flops"],
+                   "est_bytes": est["bytes"],
+                   "ai_flop_per_byte": est["ai_flop_per_byte"],
+                   "bound": est["bound"],
+                   "floor_s": roofline_seconds(est["flops"],
+                                               est["bytes"])}
+            if calibrate:
+                mf, mb, built = _measured_step_cost(
+                    d_model, n_layers, n_heads, block_size,
+                    max_blocks, kv_dtype, slots, kernels_on)
+                fr = est["flops"] / mf if mf else None
+                br = est["bytes"] / mb if mb else None
+                row.update(
+                    xla_flops=mf, xla_bytes=mb, built_kernel=built,
+                    flops_ratio=round(fr, 3) if fr else None,
+                    bytes_ratio=round(br, 3) if br else None)
+                ok = (fr is not None and br is not None
+                      and 0.5 < fr < 2.5 and 0.4 < br < 3.0)
+                row["in_band"] = ok
+                in_band = in_band and ok
+            out["rows"].append(row)
+            pair[backend] = est
+        out["pallas_vs_xla_bytes"][kv_dtype] = round(
+            pair["pallas"]["bytes"] / pair["xla"]["bytes"], 3)
+    if calibrate:
+        out["static_vs_measured_ok"] = in_band
+    return out
+
+
 def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
                       block_size=8, max_blocks=12, d_model=128,
                       n_layers=2, n_heads=4, deadline_ms=None,
                       prom_out="", trials=2, prefix_pool=3,
                       prefix_len=24, prefix_hit=0.75, spec_k=4,
                       draft_d_model=32, draft_layers=1, with_spec=True,
-                      with_quant=True):
+                      with_quant=True, with_kernels=True):
     """BENCH_SERVING entry point (bench.py): the scheduler ablation
     ladder over the same shared-prefix mixed-length open-loop request
     set; best-of-`trials` per mode; optional Prometheus dump of the
@@ -345,11 +464,27 @@ def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
                                      draft_states=draft_states,
                                      spec_k=spec_k)),
             ]
+        kdec = None
+        if with_kernels:
+            # kernel selection happens at BUILD time; same trained
+            # weights through the same unique-name discipline, so the
+            # rung isolates the attention path swap
+            kdec, _ = _build_kernel_decoder(
+                d_model, n_layers, n_heads, block_size, max_blocks,
+                states=states)
+            kkw = dict(prefix_cache=True)
+            if with_spec:
+                kkw.update(draft=draft, draft_states=draft_states,
+                           spec_k=spec_k)
+            ladder.append(("kernels", kkw))
         rows = {}
         for label, kw in ladder:
             best = None
-            for _ in range(trials):
-                row = run_load(dec, states, reqs, slots=slots,
+            # the kernels rung runs Pallas in interpret mode off-TPU:
+            # one trial — the row demonstrates the path, not CPU speed
+            for _ in range(1 if label == "kernels" else trials):
+                row = run_load(kdec if label == "kernels" else dec,
+                               states, reqs, slots=slots,
                                kv_blocks=kv_blocks, rate_rps=rate_rps,
                                deadline_ms=deadline_ms,
                                mode_label=label, **kw)
@@ -380,11 +515,19 @@ def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
             out["stacked_speedup"] = round(
                 rows["prefix+spec"]["tokens_per_sec"]
                 / max(base, 1e-9), 2)
+        if with_kernels:
+            out["kernels_vs_continuous"] = round(
+                rows["kernels"]["tokens_per_sec"] / max(base, 1e-9), 2)
+            out["roofline"] = kernel_roofline(
+                d_model, n_layers, n_heads, block_size, max_blocks,
+                slots)
         if with_quant:
             out["kv_quantization"] = _quant_residency(
                 d_model, n_layers, n_heads, block_size, max_blocks,
                 states, kv_blocks)
-        out["phase_breakdown"] = phase_breakdown()
+        out["phase_breakdown"] = phase_breakdown(
+            decode_backend=rows["kernels"]["decode_kernel"]
+            if with_kernels else None)
         if prom_out:
             out["prometheus_dump"] = exporters.write_prometheus(prom_out)
         return out
@@ -392,19 +535,33 @@ def run_serving_bench(requests=48, rate_rps=0.0, slots=4, kv_blocks=56,
         obs_metrics.set_enabled(metrics_were_on)
 
 
-def phase_breakdown():
+def phase_breakdown(decode_backend=None):
     """This process's per-phase attribution (lifetime sums of the
     paddle_tpu_*_phase_seconds families), as rows plus the rendered
     `cli why` table — the artifact's "where did the bench spend its
-    time" section."""
+    time" section.
+
+    `decode_backend` (the kernels rung's selection, "pallas" or a
+    fallback reason) is stamped onto the generation decode/draft_verify
+    rows so `cli why` readers see WHAT ran the attention math, not just
+    where the time went."""
     from paddle_tpu.observability import attribution, exporters
     from paddle_tpu.observability.collector import parse_prometheus_text
 
     try:
         parsed = parse_prometheus_text(exporters.prometheus_text())
         rows = attribution.why_rows_from_parsed(parsed)
-        return {"rows": rows,
-                "table": attribution.format_why_table(rows)}
+        if decode_backend:
+            for r in rows:
+                if (r.get("kind") == "generation"
+                        and r.get("phase") in ("decode",
+                                               "draft_verify")):
+                    r["backend"] = decode_backend
+        out = {"rows": rows,
+               "table": attribution.format_why_table(rows)}
+        if decode_backend:
+            out["decode_backend"] = decode_backend
+        return out
     except Exception as e:  # attribution must never fail the bench
         return {"error": f"{type(e).__name__}: {e}"}
 
@@ -706,6 +863,9 @@ def main():
                     "brief target/draft training they need)")
     ap.add_argument("--no-quant", action="store_true",
                     help="skip the KV-quantization residency section")
+    ap.add_argument("--no-kernels", action="store_true",
+                    help="skip the serving-kernel rung and the "
+                    "roofline before/after + calibration section")
     ap.add_argument("--prom_out", default="",
                     help="write the Prometheus text dump here")
     ap.add_argument("--ramp", action="store_true",
@@ -746,7 +906,7 @@ def main():
         prefix_pool=a.prefix_pool, prefix_len=a.prefix_len,
         prefix_hit=a.prefix_hit, spec_k=a.spec_k,
         with_spec=not a.no_spec, with_quant=not a.no_quant,
-        prom_out=a.prom_out)
+        with_kernels=not a.no_kernels, prom_out=a.prom_out)
     if a.artifact_dir:
         out["artifact"] = write_bench_artifact(out, a.artifact_dir)
     print(json.dumps(out))

@@ -1010,19 +1010,108 @@ def _paged_attention_gather_cost(spec: Dict, slots: int = 1,
     """Gather-through-block-table attention for ONE query position per
     slot: K/V [n_layers, blocks, block_size, d_model] gathered through
     the table to `context` logical positions, dequantized, then QK^T +
-    att*V (2*ctx*d each, per layer)."""
+    att*V (2*ctx*d each, per layer).
+
+    Bytes charge BOTH legs of the composition: the pool reads in
+    storage precision AND the logical-order f32 gathered copy the XLA
+    path materializes (written, then re-read by the einsums) — the
+    traffic the fused `paged_attention_decode` kernel deletes."""
     d, h, layers, v, di, bs, nb = _spec_dims(spec)
     ctx = int(context if context is not None else bs * nb)
     kvb = _kv_elem_bytes(kv_dtype, bs, d)
     flops = slots * layers * 4.0 * ctx * d
-    gather_bytes = slots * layers * 2.0 * ctx * d * kvb
+    pool_bytes = slots * layers * 2.0 * ctx * d * kvb
+    copy_bytes = slots * layers * 2.0 * ctx * d * 8.0
     return {
         "kernel": "paged_attention_gather",
         "shapes": {"pool": f"[{layers}, blocks, {bs}, {d}] x2 ({kv_dtype})",
                    "tables": f"[{slots}, {nb}] int32",
                    "query": f"[{slots}, {h}, {d // max(h, 1)}]"},
-        "flops": flops, "bytes": gather_bytes,
+        "flops": flops, "bytes": pool_bytes + copy_bytes,
+        "pool_bytes": pool_bytes, "copy_bytes": copy_bytes,
         "context": ctx, "slots": slots,
+    }
+
+
+@register_serving_kernel("paged_attention_decode")
+def _paged_attention_decode_cost(spec: Dict, slots: int = 1,
+                                 context: Optional[int] = None,
+                                 kv_dtype: str = "fp32",
+                                 window: int = 1, **_) -> Dict:
+    """The fused Pallas decode-attention kernel
+    (kernels/paged_attention.py): K/V blocks stream through the block
+    table straight into VMEM, dequantized in-lane — same flops as the
+    gather composition, but the XLA path's logical-order f32 copy of
+    the gathered context (written then re-read in HBM) never exists.
+    `gather_copy_bytes_avoided` quantifies that saved traffic."""
+    d, h, layers, v, di, bs, nb = _spec_dims(spec)
+    ctx = int(context if context is not None else bs * nb)
+    kvb = _kv_elem_bytes(kv_dtype, bs, d)
+    flops = slots * window * layers * 4.0 * ctx * d
+    # pool-block reads only, in storage precision: q/out traffic is the
+    # step row's act_bytes, and the oracle's logical-order f32 copy
+    # (write + re-read) simply never exists on this path
+    pool_bytes = slots * layers * 2.0 * ctx * d * kvb
+    return {
+        "kernel": "paged_attention_decode",
+        "backend": "pallas",
+        "shapes": {"pool": f"[{layers}, blocks, {bs}, {d}] x2 ({kv_dtype})",
+                   "tables": f"[{slots}, {nb}] int32",
+                   "query": f"[{slots}, {window}, {d}]"},
+        "flops": flops, "bytes": pool_bytes,
+        # what the oracle pays on top: the dequantized logical-order
+        # copy, f32, materialized (write) and consumed (read) per layer
+        "gather_copy_bytes_avoided": slots * layers * 2.0 * ctx * d
+        * 8.0,
+        "fused_dequant": kv_dtype != "fp32",
+        "context": ctx, "slots": slots, "window": window,
+    }
+
+
+@register_serving_kernel("moe_gate_dispatch")
+def _moe_gate_dispatch_cost(spec: Dict, tokens: int = 0,
+                            num_experts: int = 0, capacity: int = 0,
+                            top_k: int = 1, **_) -> Dict:
+    """The fused MoE gate+dispatch kernel (kernels/moe_dispatch.py):
+    gate logits, softmax, top-k routing, capacity cumsum and the
+    dispatch contraction in one launch.  Emits only expert_in/combine;
+    `routing_bytes_avoided` is the [T, E]/[T, E, C] routing traffic the
+    oracle materializes in HBM between its ~15 ops."""
+    d, _, _, _, _, _, _ = _spec_dims(spec)
+    T = int(tokens or spec.get("tokens") or 0)
+    E = int(num_experts or spec.get("num_experts") or 0)
+    C = int(capacity or max(1, int(1.25 * top_k * T / max(E, 1))))
+    flops = (2.0 * T * d * E              # gate logits
+             + 2.0 * T * E * C * d * top_k)  # dispatch contraction
+    bytes_ = 4.0 * (T * d + d * E + E * C * d + T * E * C)
+    return {
+        "kernel": "moe_gate_dispatch",
+        "backend": "pallas",
+        "shapes": {"x": f"[{T}, {d}]", "gate_w": f"[{d}, {E}]",
+                   "expert_in": f"[{E}, {C}, {d}]",
+                   "combine": f"[{T}, {E}, {C}]"},
+        "flops": flops, "bytes": bytes_,
+        "routing_bytes_avoided": 4.0 * (T * E * C + 6.0 * T * E),
+        "tokens": T, "num_experts": E, "capacity": C, "top_k": top_k,
+    }
+
+
+@register_serving_kernel("fused_bucket_update")
+def _fused_bucket_update_cost(spec: Dict, numel: int = 0,
+                              n_params: int = 1, **_) -> Dict:
+    """The fused per-bucket optimizer update (kernels/fused_update.py):
+    p -= lr*g over one concatenated flat bucket — the bytes are the
+    same as the per-parameter chain (read p, read g, write p), the win
+    is `launches_replaced` dispatches collapsing into one."""
+    n = int(numel or spec.get("numel") or 0)
+    return {
+        "kernel": "fused_bucket_update",
+        "backend": "pallas",
+        "shapes": {"flat_params": f"[{n}] f32",
+                   "flat_grads": f"[{n}] f32"},
+        "flops": 2.0 * n, "bytes": 12.0 * n,
+        "launches_replaced": int(n_params),
+        "numel": n,
     }
 
 
@@ -1031,20 +1120,31 @@ def _paged_decode_step_cost(spec: Dict, slots: int = 1,
                             context: Optional[int] = None,
                             kv_dtype: str = "fp32",
                             window: int = 1,
-                            device: str = DEFAULT_DEVICE, **_) -> Dict:
+                            device: str = DEFAULT_DEVICE,
+                            backend: str = "xla", **_) -> Dict:
     """One decode tick: `window` teacher-forced positions per slot in a
     single dispatch (window=1 is `decoder.step`, window=k+1 is the
     speculative-verify / chunked-prefill `step_window`).  Parameters
     stream from HBM ONCE per dispatch — which is why AI scales with
     slots*window and speculative decoding pays: the roofline argument,
-    statically."""
+    statically.
+
+    `backend` picks the attention sub-cost: "xla" (default) is the
+    gather composition, "pallas" the fused paged-attention kernel —
+    the row then reflects what the serving-kernel tier actually
+    runs."""
     d, h, layers, v, di, bs, nb = _spec_dims(spec)
     ctx = int(context if context is not None else bs * nb)
     kvb = _kv_elem_bytes(kv_dtype, bs, d)
     per_pos = layers * (8.0 * d * d + 4.0 * d * di) + 2.0 * d * v
-    att = serving_kernel_cost("paged_attention_gather", spec,
-                              slots=slots * window, context=ctx,
-                              kv_dtype=kv_dtype)
+    if backend == "pallas":
+        att = serving_kernel_cost("paged_attention_decode", spec,
+                                  slots=slots, context=ctx,
+                                  kv_dtype=kv_dtype, window=window)
+    else:
+        att = serving_kernel_cost("paged_attention_gather", spec,
+                                  slots=slots * window, context=ctx,
+                                  kv_dtype=kv_dtype)
     flops = slots * window * per_pos + att["flops"]
     param_bytes = _lm_param_bytes(spec)
     kv_write = slots * window * layers * 2.0 * d * kvb
@@ -1055,6 +1155,7 @@ def _paged_decode_step_cost(spec: Dict, slots: int = 1,
     return {
         "kernel": ("paged_decode_step" if window == 1
                    else f"paged_decode_step_window(W={window})"),
+        "backend": backend,
         "shapes": {"tokens": f"[{slots}, {window}] int32",
                    "positions": f"[{slots}] int32",
                    "logits": f"[{slots}, {window}, {v}]"},
@@ -1066,6 +1167,30 @@ def _paged_decode_step_cost(spec: Dict, slots: int = 1,
         "flops_per_token": flops / max(slots * window, 1),
         "slots": slots, "window": window, "kv_dtype": kv_dtype,
     }
+
+
+def _resolve_decode_backend(spec: Dict, kv_dtype: str) -> str:
+    """What the serving-kernel tier would actually run for this spec on
+    THIS process's platform (docs/performance.md "Serving kernels") —
+    so the analyze report's rows reflect reality, not aspiration.
+    Best-effort: a static analyzer must never fail on registry
+    absence."""
+    try:
+        from ..kernels import registry as kreg
+        from ..kernels.paged_attention import paged_attention_supports
+        import jax
+
+        platform = jax.default_backend()
+        if not kreg.kernels_armed(platform):
+            return "xla"
+        d, h, layers, v, di, bs, nb = _spec_dims(spec)
+        reason = paged_attention_supports(
+            d_model=d, n_heads=h, block_size=bs,
+            max_blocks_per_seq=nb, kv_dtype=kv_dtype,
+            platform=platform)
+        return "xla" if reason else "pallas"
+    except Exception:
+        return "xla"
 
 
 def analyze_generation_spec(spec: Dict, slots: Optional[int] = None,
@@ -1080,14 +1205,21 @@ def analyze_generation_spec(spec: Dict, slots: Optional[int] = None,
     s = int(slots or spec.get("slots") or 8)
     kd = str(kv_dtype or spec.get("kv_dtype") or "fp32")
     ctx = bs * nb
+    backend = _resolve_decode_backend(spec, kd)
     rows = [serving_kernel_cost("paged_decode_step", spec, slots=s,
                                 context=ctx // 2, kv_dtype=kd,
-                                device=device)]
+                                device=device, backend=backend)]
     spec_k = int(spec.get("spec_k") or 0)
     if spec.get("draft") or spec_k:
         rows.append(serving_kernel_cost(
             "paged_decode_step", spec, slots=s, context=ctx // 2,
-            kv_dtype=kd, window=(spec_k or 4) + 1, device=device))
+            kv_dtype=kd, window=(spec_k or 4) + 1, device=device,
+            backend=backend))
+    if backend == "pallas":
+        rows.append(serving_kernel_cost("paged_attention_decode",
+                                        spec, slots=s,
+                                        context=ctx // 2,
+                                        kv_dtype=kd))
     rows.append(serving_kernel_cost("paged_attention_gather", spec,
                                     slots=s, context=ctx // 2,
                                     kv_dtype=kd))

@@ -211,6 +211,21 @@ define_flag("serving_kv_dtype", "",
             "same HBM budget holds ~2x the sequences K+V vs bf16 and "
             "~4x vs fp32).  Read at BUILD time; the model-dir spec's "
             "kv_dtype and explicit builder/server args override it")
+define_flag("serving_kernels", "auto",
+            "Pallas serving-kernel tier selection "
+            "(docs/performance.md 'Serving kernels'): 'auto' (default) "
+            "arms the paged-attention decode / fused MoE dispatch / "
+            "fused bucket-update kernels on TPU backends only; 'on' "
+            "arms everywhere (non-TPU backends run them under Pallas "
+            "interpret mode — a correctness harness, not a fast "
+            "path); 'off' keeps the XLA oracle path.  Env alias "
+            "PADDLE_TPU_SERVING_KERNELS.  Armed-but-unsupported "
+            "shape/dtype/platform combinations fall back to the "
+            "oracle per op, silently but counted "
+            "(paddle_tpu_kernel_fallbacks_total{kernel,reason}).  "
+            "Read at BUILD time by build_lm_paged_decoder (like "
+            "serving_kv_dtype) and at TRACE time by "
+            "ParallelExecutor/moe_dense")
 define_flag("serving_spec_k", 4,
             "default speculative-decoding draft length: how many "
             "tokens the draft model proposes per scheduler tick for "

@@ -1,0 +1,212 @@
+"""Paged-attention decode as a Pallas TPU kernel (vLLM-style).
+
+The paged decoder (models/transformer.build_lm_paged_decoder) is the
+serving hot path and the top entry on the static analyzer's
+memory-bound worklist: its XLA lowering gathers K/V through the block
+table into a logical-order [S, ctx, d] copy in HBM every tick, and
+quantized pools additionally pay a full dequantization round-trip on
+that copy.  This kernel reads K/V blocks DIRECTLY through the block
+table — the table rides the scalar-prefetch lane, so each grid step's
+BlockSpec index map addresses one physical pool block and Pallas
+streams exactly the blocks a slot owns into VMEM, dequantizing in-lane
+(bf16 cast / int8 per-(layer, block) scale) on the way.  No
+logical-order copy of the pool ever exists in HBM.
+
+Grid = (slots, max_blocks_per_seq), block index innermost so one
+slot's K/V blocks accumulate into a VMEM scratch of the logical
+context; the last block step runs the attention math for that slot.
+The math is POSITION-FOR-POSITION the oracle's (gather + QK^T +
+-inf mask + jax.nn.softmax + att@V, f32 accumulation), which is what
+makes greedy decode through this kernel bit-identical to the XLA paged
+path — tests/test_serving_kernels.py pins it for fp32/bf16/int8 under
+Pallas interpret mode on CPU.
+
+`window > 1` is the teacher-forced multi-position variant: the same
+kernel body scores a [W, ctx] tile per slot (causal within the window
+via the position offsets), so speculative-decoding verification and
+chunked prefill ride the same kernel as single-token decode.
+
+Selection and fallback accounting live in kernels/registry.py
+("paged_attention_decode"); unsupported shape/dtype/platform
+combinations route back to the oracle, counted.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from .registry import register_kernel
+
+__all__ = ["paged_attention_supports", "build_paged_attention"]
+
+# VMEM budget for the per-slot K+V logical-context scratch: past this
+# the context must be tiled with an online softmax, which trades away
+# the oracle's exact math — out of scope for the serving tier, so the
+# registry falls back instead
+_SCRATCH_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def paged_attention_supports(*, d_model: int, n_heads: int,
+                             block_size: int, max_blocks_per_seq: int,
+                             kv_dtype: str, window: int = 1,
+                             platform: str = "cpu",
+                             **_) -> Optional[str]:
+    """None when the decode shape runs on the Pallas path, else a short
+    fallback reason (the {kernel,reason} counter label)."""
+    if kv_dtype not in ("fp32", "bf16", "int8"):
+        return "kv_dtype"
+    if d_model % n_heads:
+        return "head_split"
+    ctx = max_blocks_per_seq * block_size
+    if 2 * ctx * d_model * 4 > _SCRATCH_BUDGET_BYTES:
+        return "vmem_scratch"
+    if int(window) < 1:
+        return "window"
+    if platform == "tpu":
+        # Mosaic tiling: last dim on the 128-lane grid, K/V block rows
+        # on the 8-sublane grid; the per-head slice must stay
+        # lane-aligned
+        if d_model % 128:
+            return "lane_misaligned"
+        if (d_model // n_heads) % 128:
+            return "head_dim_misaligned"
+        if block_size % 8:
+            return "sublane_misaligned"
+    if pltpu is None:
+        return "no_pallas_tpu"
+    return None
+
+
+def _decode_kernel(tables_ref, pos_ref, q_ref, kv_refs, vv_refs,
+                   o_ref, k_s, v_s, *, nb, bs, n_heads, d_head, scale,
+                   kv_dtype):
+    """Grid step (s, i): dequantize-copy pool block `tables[s, i]` into
+    the logical-context scratch; at the slot's last block, run the
+    oracle's attention math on the assembled [ctx, d] tiles.
+
+    `kv_refs`/`vv_refs` mirror the pool pytree: a bare block ref for
+    fp32/bf16, a (payload, scale) ref pair for int8."""
+    s, i = pl.program_id(0), pl.program_id(1)
+    ctx_len = nb * bs
+
+    if kv_dtype == "int8":
+        kq_ref, ks_ref = kv_refs
+        vq_ref, vs_ref = vv_refs
+        k_s[pl.ds(i * bs, bs), :] = (kq_ref[0, 0].astype(jnp.float32)
+                                     * ks_ref[0, 0])
+        v_s[pl.ds(i * bs, bs), :] = (vq_ref[0, 0].astype(jnp.float32)
+                                     * vs_ref[0, 0])
+    else:
+        k_s[pl.ds(i * bs, bs), :] = kv_refs[0, 0].astype(jnp.float32)
+        v_s[pl.ds(i * bs, bs), :] = vv_refs[0, 0].astype(jnp.float32)
+
+    @pl.when(i == nb - 1)
+    def _attend():
+        # the math below is TOKEN-FOR-TOKEN the oracle's gather block
+        # (same einsum contractions, same mask/softmax order) — that,
+        # not just closeness, is what the bit-identity pins rely on
+        w_n = q_ref.shape[1]
+        kh = k_s[...].reshape(ctx_len, n_heads, d_head)
+        vh = v_s[...].reshape(ctx_len, n_heads, d_head)
+        if w_n == 1:
+            # single-token decode: mirror step()'s windowless einsums —
+            # a size-1 q-dim contraction is NOT bitwise the same, so
+            # the branch is static on the block shape
+            qh = q_ref[0, 0].astype(jnp.float32).reshape(n_heads,
+                                                         d_head)
+            sc = jnp.einsum("hd,shd->hs", qh, kh) * scale
+            cols = jax.lax.broadcasted_iota(jnp.int32, (1, ctx_len), 1)
+            keep = (cols <= pos_ref[s])[0]
+            sc = jnp.where(keep[None, :], sc, -jnp.inf)
+            w_att = jax.nn.softmax(sc, axis=-1)
+            ctxh = jnp.einsum("hs,shd->hd", w_att, vh)
+            o_ref[0, 0] = ctxh.reshape(n_heads * d_head)
+        else:
+            qh = q_ref[0].astype(jnp.float32).reshape(
+                w_n, n_heads, d_head)
+            sc = jnp.einsum("qhd,shd->qhs", qh, kh) * scale
+            # absolute position of window row w is pos[s] + w; row w
+            # attends to logical positions <= it, matching
+            # step_window's teacher-forced causal mask
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (w_n, ctx_len), 1)
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (w_n, ctx_len), 0)
+            keep = cols <= pos_ref[s] + rows
+            sc = jnp.where(keep[:, None, :], sc, -jnp.inf)
+            w_att = jax.nn.softmax(sc, axis=-1)
+            ctxh = jnp.einsum("qhs,shd->qhd", w_att, vh)
+            o_ref[0] = ctxh.reshape(w_n, n_heads * d_head)
+
+
+@register_kernel("paged_attention_decode", paged_attention_supports)
+def build_paged_attention(*, d_model: int, n_heads: int,
+                          block_size: int, max_blocks_per_seq: int,
+                          kv_dtype: str, window: int = 1,
+                          interpret: bool = False, platform: str = "cpu",
+                          **_):
+    """-> attend(q, pool_k, pool_v, tables, positions, layer) where
+    q is [S, W, d_model] f32 (the window W is taken from q's shape at
+    trace time — the single-token step passes W=1, speculative verify
+    its draft window), pools are the paged decoder's layer-major pool
+    pytrees, and the result is the pre-output-projection context
+    [S, W, d_model] f32 — a drop-in for the oracle's
+    gather/einsum/softmax block."""
+    nb, bs = int(max_blocks_per_seq), int(block_size)
+    d_head = d_model // n_heads
+    scale = 1.0 / math.sqrt(d_head)
+
+    kern = functools.partial(
+        _decode_kernel, nb=nb, bs=bs, n_heads=n_heads, d_head=d_head,
+        scale=scale, kv_dtype=kv_dtype)
+
+    def _pool_specs(layer):
+        # one physical pool block per grid step, addressed THROUGH the
+        # prefetched table — the kernel never sees a logical-order copy
+        def blk(s, i, tab, pos):
+            return (layer, tab[s, i], 0, 0)
+
+        if kv_dtype == "int8":
+            def scl(s, i, tab, pos):
+                return (layer, tab[s, i])
+
+            return (pl.BlockSpec((1, 1, bs, d_model), blk),
+                    pl.BlockSpec((1, 1), scl))
+        return pl.BlockSpec((1, 1, bs, d_model), blk)
+
+    def attend(q, pool_k, pool_v, tables, positions, layer):
+        s_n, w_n = q.shape[0], q.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s_n, nb),
+            in_specs=[
+                pl.BlockSpec((1, w_n, d_model),
+                             lambda s, i, tab, pos: (s, 0, 0)),
+                _pool_specs(layer),
+                _pool_specs(layer),
+            ],
+            out_specs=pl.BlockSpec((1, w_n, d_model),
+                                   lambda s, i, tab, pos: (s, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((nb * bs, d_model), jnp.float32),
+                pltpu.VMEM((nb * bs, d_model), jnp.float32),
+            ],
+        )
+        return pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((s_n, w_n, d_model),
+                                           jnp.float32),
+            interpret=interpret,
+        )(tables, positions, q, pool_k, pool_v)
+
+    return attend

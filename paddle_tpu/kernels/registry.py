@@ -1,0 +1,168 @@
+"""Serving-kernel registry: selection + per-op fallback accounting.
+
+The flash-attention kernel picks Pallas-vs-XLA inside its own entry
+point; the serving tier (paged-attention decode, fused MoE dispatch,
+fused optimizer update) instead routes every selection through ONE
+registry so the policy is uniform and observable:
+
+  * each kernel registers a `supports(**ctx) -> None | reason` predicate
+    over the shapes/dtypes it can run and a `build(**ctx)` factory;
+  * `select(name, **ctx)` resolves the `serving_kernels` flag
+    (PADDLE_TPU_SERVING_KERNELS: "auto" arms on TPU backends only, "on"
+    arms everywhere — CPU runs the kernels under Pallas interpret mode,
+    which is how tier-1 exercises them — "off" never arms);
+  * an armed-but-unsupported combination falls back to the XLA oracle
+    path SILENTLY BUT COUNTED: the
+    ``paddle_tpu_kernel_fallbacks_total{kernel,reason}`` series records
+    it (always-counted, like the serving stats counters), and the
+    Selection that counted it reclaims its series on close — the same
+    label-lifecycle contract GenerationServer.close follows.
+
+The XLA path stays the numerics oracle: a kernel is only ever an
+implementation swap, never a semantics change
+(tests/test_serving_kernels.py pins greedy-decode bit-identity).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..observability import metrics as obs_metrics
+
+__all__ = [
+    "register_kernel",
+    "kernels_mode",
+    "kernels_armed",
+    "interpret_mode",
+    "Selection",
+    "select",
+    "FALLBACK_METRIC",
+]
+
+FALLBACK_METRIC = "paddle_tpu_kernel_fallbacks_total"
+
+# always=True: fallback routing is a correctness-adjacent signal (an
+# operator must be able to see that the armed kernel never ran), so it
+# counts even with the metrics gate off — exported only when on
+_M_FALLBACKS = obs_metrics.counter(
+    FALLBACK_METRIC,
+    "serving-kernel selections routed to the XLA oracle path",
+    ("kernel", "reason"), always=True)
+
+
+class _KernelDef:
+    __slots__ = ("name", "supports", "build")
+
+    def __init__(self, name, supports, build):
+        self.name = name
+        self.supports = supports
+        self.build = build
+
+
+_REGISTRY: Dict[str, _KernelDef] = {}
+
+
+def register_kernel(name: str, supports: Callable[..., Optional[str]]):
+    """Register `build(**ctx) -> callable` as the Pallas implementation
+    of `name`; `supports(**ctx)` returns None when the context (shapes,
+    dtypes, platform) is runnable and a short fallback reason otherwise.
+    """
+
+    def deco(build):
+        _REGISTRY[name] = _KernelDef(name, supports, build)
+        return build
+
+    return deco
+
+
+def kernels_mode() -> str:
+    """The `serving_kernels` flag, normalized to auto/on/off."""
+    from ..core.flags import get_flag
+
+    v = str(get_flag("serving_kernels")).strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return "on"
+    if v in ("0", "false", "no", "off"):
+        return "off"
+    return "auto"
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def kernels_armed(platform: Optional[str] = None) -> bool:
+    """Whether selection should even try the Pallas tier: "on" arms
+    everywhere (CPU runs interpret mode), "auto" arms only on TPU —
+    interpret mode is a correctness harness, not a fast path, so a CPU
+    process under the default must keep the XLA oracle."""
+    mode = kernels_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return (platform or _platform()) == "tpu"
+
+
+def interpret_mode(platform: Optional[str] = None) -> bool:
+    """Pallas interpret mode: anywhere the Mosaic TPU compiler is
+    absent, i.e. every non-TPU backend."""
+    return (platform or _platform()) != "tpu"
+
+
+class Selection:
+    """One consumer's kernel choices plus its fallback-series ledger.
+
+    A builder (paged decoder, ParallelExecutor, moe_dense) makes its
+    selections through one Selection so (a) introspection shows what
+    actually runs (`chosen`: kernel name -> "pallas" or
+    "xla:<reason>") and (b) `close()` reclaims exactly the
+    {kernel,reason} series this consumer counted."""
+
+    def __init__(self):
+        self.chosen: Dict[str, str] = {}
+        self._counted: List[Tuple[str, str]] = []
+
+    def pick(self, name: str, **ctx):
+        """-> the built kernel callable, or None for the XLA path.
+
+        Disarmed (flag off, or auto on a non-TPU backend) returns None
+        without counting — nothing fell back, the oracle was the plan.
+        Armed but unsupported counts one fallback and returns None."""
+        kdef = _REGISTRY.get(name)
+        platform = ctx.pop("platform", None) or _platform()
+        if kdef is None:
+            raise KeyError(f"unknown serving kernel {name!r}; "
+                           f"registered: {sorted(_REGISTRY)}")
+        if not kernels_armed(platform):
+            self.chosen[name] = "xla:disarmed"
+            return None
+        reason = kdef.supports(platform=platform, **ctx)
+        if reason is not None:
+            self.chosen[name] = f"xla:{reason}"
+            self._counted.append((name, reason))
+            _M_FALLBACKS.labels(kernel=name, reason=reason).inc()
+            return None
+        self.chosen[name] = "pallas"
+        return kdef.build(platform=platform,
+                          interpret=interpret_mode(platform), **ctx)
+
+    def close(self):
+        """Drop this consumer's fallback series (the {kernel,reason}
+        children it incremented).  Safe to call twice; a series shared
+        with a still-live consumer disappears from exports but keeps
+        counting from zero if either increments again."""
+        seen = set()
+        for key in self._counted:
+            if key in seen:
+                continue
+            seen.add(key)
+            _M_FALLBACKS.remove(kernel=key[0], reason=key[1])
+        self._counted = []
+
+
+def select(name: str, **ctx):
+    """One-off selection with no reclamation ledger (prefer a Selection
+    for anything with a close() lifecycle)."""
+    return Selection().pick(name, **ctx)

@@ -581,6 +581,19 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
         raise ValueError(
             f"kv_dtype {kv_dtype!r} not in ('fp32', 'bf16', 'int8')")
 
+    # serving-kernel selection, read at BUILD time like kv_dtype: when
+    # armed and supported, attention reads K/V straight through the
+    # block table inside the Pallas kernel (fused dequant, no
+    # logical-order gather copy); otherwise the XLA gather below stays
+    # the oracle (docs/performance.md "Serving kernels")
+    from ..kernels import registry as _kernel_registry
+
+    kernel_selection = _kernel_registry.Selection()
+    _attend = kernel_selection.pick(
+        "paged_attention_decode", d_model=d_model, n_heads=n_heads,
+        block_size=int(block_size),
+        max_blocks_per_seq=int(max_blocks_per_seq), kv_dtype=kv_dtype)
+
     startup, shapes, tok_emb, pos_tab, lns, weights, biases = (
         _lm_param_structure(vocab_size, max_len, d_model, n_heads,
                             n_layers, d_inner))
@@ -676,19 +689,28 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
             vv = h @ wv + bv
             pool_k = _write(pool_k, l, wb, wi, kk)
             pool_v = _write(pool_v, l, wb, wi, vv)
-            # gather-based attention over the block table: [S, NB, BS, D]
-            # in table order IS logical order, so after the reshape the
-            # math is the dense cache's math on the same values
-            kh = _gather(pool_k, l, tables).reshape(
-                s_n, nb * bs, n_heads, d_head)
-            vh = _gather(pool_v, l, tables).reshape(
-                s_n, nb * bs, n_heads, d_head)
-            qh = q.reshape(s_n, n_heads, d_head)
-            sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
-            sc = jnp.where(pos_mask[:, None, :], sc, -jnp.inf)
-            w_att = jax.nn.softmax(sc, axis=-1)
-            ctxh = jnp.einsum("bhs,bshd->bhd", w_att, vh)
-            x = x + (ctxh.reshape(s_n, d_model) @ wo + bo)
+            if _attend is not None:
+                # Pallas path: block-table reads + dequant + attention
+                # in one kernel; bit-identical to the gather branch
+                # (tests/test_serving_kernels.py)
+                ctx_av = _attend(q[:, None, :], pool_k, pool_v, tables,
+                                 positions, l)[:, 0]
+            else:
+                # gather-based attention over the block table:
+                # [S, NB, BS, D] in table order IS logical order, so
+                # after the reshape the math is the dense cache's math
+                # on the same values
+                kh = _gather(pool_k, l, tables).reshape(
+                    s_n, nb * bs, n_heads, d_head)
+                vh = _gather(pool_v, l, tables).reshape(
+                    s_n, nb * bs, n_heads, d_head)
+                qh = q.reshape(s_n, n_heads, d_head)
+                sc = jnp.einsum("bhd,bshd->bhs", qh, kh) * scale
+                sc = jnp.where(pos_mask[:, None, :], sc, -jnp.inf)
+                w_att = jax.nn.softmax(sc, axis=-1)
+                ctxh = jnp.einsum("bhs,bshd->bhd", w_att, vh)
+                ctx_av = ctxh.reshape(s_n, d_model)
+            x = x + (ctx_av @ wo + bo)
             h2 = ln(x, 2 * l + 1)
             w1, b1 = W(6 * l + 4)
             w2, b2 = W(6 * l + 5)
@@ -748,16 +770,23 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
             for j in range(w_n):
                 pool_k = _write(pool_k, l, wb[:, j], wi[:, j], kk[:, j])
                 pool_v = _write(pool_v, l, wb[:, j], wi[:, j], vv[:, j])
-            kh = _gather(pool_k, l, tables).reshape(
-                s_n, nb * bs, n_heads, d_head)
-            vh = _gather(pool_v, l, tables).reshape(
-                s_n, nb * bs, n_heads, d_head)
-            qh = q.reshape(s_n, w_n, n_heads, d_head)
-            sc = jnp.einsum("bqhd,bshd->bqhs", qh, kh) * scale
-            sc = jnp.where(pos_mask[:, :, None, :], sc, -jnp.inf)
-            w_att = jax.nn.softmax(sc, axis=-1)
-            ctxh = jnp.einsum("bqhs,bshd->bqhd", w_att, vh)
-            x = x + (ctxh.reshape(s_n, w_n, d_model) @ wo + bo)
+            if _attend is not None:
+                # speculative verify rides the SAME kernel as decode:
+                # the window dim comes from q's shape at trace time
+                ctx_av = _attend(q, pool_k, pool_v, tables, positions,
+                                 l)
+            else:
+                kh = _gather(pool_k, l, tables).reshape(
+                    s_n, nb * bs, n_heads, d_head)
+                vh = _gather(pool_v, l, tables).reshape(
+                    s_n, nb * bs, n_heads, d_head)
+                qh = q.reshape(s_n, w_n, n_heads, d_head)
+                sc = jnp.einsum("bqhd,bshd->bqhs", qh, kh) * scale
+                sc = jnp.where(pos_mask[:, :, None, :], sc, -jnp.inf)
+                w_att = jax.nn.softmax(sc, axis=-1)
+                ctxh = jnp.einsum("bqhs,bshd->bqhd", w_att, vh)
+                ctx_av = ctxh.reshape(s_n, w_n, d_model)
+            x = x + (ctx_av @ wo + bo)
             h2 = ln(x, 2 * l + 1)
             w1, b1 = W(6 * l + 4)
             w2, b2 = W(6 * l + 5)
@@ -807,7 +836,9 @@ def build_lm_paged_decoder(vocab_size, block_size, max_blocks_per_seq,
         state_names=sorted(shapes), state_shapes=shapes, block_size=bs,
         max_blocks_per_seq=nb, max_len=max_len, n_layers=n_layers,
         d_model=d_model, vocab_size=vocab_size, kv_dtype=kv_dtype,
-        bytes_per_block=bytes_per_block)
+        bytes_per_block=bytes_per_block,
+        kernel_selection=kernel_selection,
+        kernels=dict(kernel_selection.chosen))
     return startup, decoder
 
 

@@ -676,6 +676,8 @@ class GenerationServer:
                "kv_blocks_total": self._cache.num_blocks,
                "kv_pool_utilization": self._cache.utilization(),
                "kv_dtype": getattr(self._decoder, "kv_dtype", "fp32"),
+               "decode_kernel": getattr(self._decoder, "kernels", {})
+               .get("paged_attention_decode", "xla"),
                "kv_bytes_resident": (self._cache.used_blocks
                                      * self._cache.bytes_per_block),
                "draft_proposed": int(self._m_proposed.value),
@@ -718,6 +720,12 @@ class GenerationServer:
             fam.remove(server=self._sid)
         for reason in ("saturated", "deadline"):
             _M_SHED.remove(server=self._sid, reason=reason)
+        # serving-kernel fallback series counted by this server's
+        # decoders (kernels/registry.py Selection contract)
+        for dec in (self._decoder, self._draft):
+            sel = getattr(dec, "kernel_selection", None)
+            if sel is not None:
+                sel.close()
 
     # -- scheduler ----------------------------------------------------------
     def _shed_expired_locked(self, now: float) -> List[_Seq]:
@@ -1309,10 +1317,26 @@ def _publish_static_decode_floor(spec: dict, server: GenerationServer):
     model not covering a spec must never block serving."""
     try:
         from ..analysis.cost_model import (analyze_generation_spec,
-                                           roofline_seconds)
+                                           roofline_seconds,
+                                           serving_kernel_cost)
         rows = analyze_generation_spec(
             spec, slots=server._slots)["kernels"]
         step = rows[0]
+        # band against the backend the DECODER actually selected (the
+        # registry's spec-level resolution can disagree with a build
+        # that fell back on shape) — the calibration ratio must compare
+        # measured time to the floor of what runs, not of the oracle
+        backend = ("pallas" if getattr(server._decoder, "kernels", {})
+                   .get("paged_attention_decode") == "pallas"
+                   else "xla")
+        if step.get("backend") != backend:
+            step = serving_kernel_cost(
+                "paged_decode_step", spec, slots=server._slots,
+                context=(int(spec.get("block_size", 16))
+                         * int(spec.get("max_blocks_per_seq", 64)))
+                // 2,
+                kv_dtype=str(spec.get("kv_dtype") or "fp32"),
+                backend=backend)
         obs_attr.publish_static_floor("generation", {
             "decode": roofline_seconds(step["flops"], step["bytes"]),
         })

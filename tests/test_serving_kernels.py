@@ -1,0 +1,380 @@
+"""Serving-kernel tier (paddle_tpu/kernels/ + registry selection).
+
+Pins the tier's two contracts:
+
+  * a kernel is an IMPLEMENTATION swap, never a semantics change —
+    greedy decode through the Pallas paged-attention path (interpret
+    mode on CPU) is bit-identical to the XLA oracle for fp32/bf16/int8
+    KV, speculative verify rides the same kernel through step_window,
+    the fused MoE gate+dispatch matches the oracle op chain exactly,
+    and the fused bucket update reproduces the per-parameter SGD chain
+    bit-for-bit;
+  * an armed-but-unsupported combination routes to the oracle
+    SILENTLY BUT COUNTED: never crashes, never changes numerics, and
+    the ``paddle_tpu_kernel_fallbacks_total{kernel,reason}`` series
+    records the routing and is reclaimed on close.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.core.framework as fw
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.kernels import registry as kreg
+from paddle_tpu.observability import exporters
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.serving import GenerationServer
+
+V = 29
+
+_DECODERS = {}
+
+
+def _decoder(kv_dtype=None, kernels="auto", block_size=4, max_blocks=4,
+             d_model=32, n_heads=2, n_layers=2):
+    """Build (or reuse) a paged decoder under a given `serving_kernels`
+    mode.  Every variant of one geometry shares the SAME parameter
+    values (the fp32/auto entry is built first — reset unique names
+    make the param set reproducible across builds), so an on/off
+    comparison swaps the attention path, never the model."""
+    from paddle_tpu.models.transformer import build_lm_paged_decoder
+
+    geo = (block_size, max_blocks, d_model, n_heads, n_layers)
+    key = (kv_dtype, kernels) + geo
+    base = (None, "auto") + geo
+    if key not in _DECODERS:
+        if key != base and base not in _DECODERS:
+            _decoder(block_size=block_size, max_blocks=max_blocks,
+                     d_model=d_model, n_heads=n_heads,
+                     n_layers=n_layers)
+        prev = get_flag("serving_kernels")
+        set_flags({"serving_kernels": kernels})
+        try:
+            fw.reset_unique_names()
+            startup, dec = build_lm_paged_decoder(
+                V, block_size, max_blocks, d_model=d_model,
+                n_heads=n_heads, n_layers=n_layers, kv_dtype=kv_dtype)
+        finally:
+            set_flags({"serving_kernels": prev})
+        if key != base:
+            states = _DECODERS[base][1]
+        else:
+            scope = fluid.Scope()
+            fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+            states = {n: np.asarray(scope.find_var(n))
+                      for n in dec.state_names}
+        _DECODERS[key] = (dec, states)
+    return _DECODERS[key]
+
+
+def _serve(dec, states, prompts, max_news, **kw):
+    """The PR 8 staggered mixed-length harness: first wave mid-decode
+    when the second arrives, early finishers evicted under load."""
+    srv = GenerationServer(dec, states, slots=3, kv_blocks=12,
+                           place=fluid.CPUPlace(), **kw)
+    try:
+        first = [srv.submit(p, m)
+                 for p, m in zip(prompts[:3], max_news[:3])]
+        while srv.stats()["generated_tokens"] == 0:
+            time.sleep(0.002)
+        rest = [srv.submit(p, m)
+                for p, m in zip(prompts[3:], max_news[3:])]
+        out = [s.result(timeout=120) for s in first + rest]
+        stats = srv.stats()
+    finally:
+        srv.close()
+    return out, stats
+
+
+# ---------------------------------------------------------------------------
+# paged-attention decode: bit-identity vs the XLA oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "bf16", "int8"])
+def test_greedy_decode_bit_identical_pallas_vs_xla(kv_dtype):
+    """Greedy decode through the fused kernel (interpret mode on CPU)
+    produces the oracle's exact token streams — same einsum forms, same
+    softmax, fused dequant included — under staggered mixed-length
+    serving."""
+    dec_x, states = _decoder(kv_dtype=kv_dtype)
+    dec_p, _ = _decoder(kv_dtype=kv_dtype, kernels="on")
+    assert dec_x.kernels["paged_attention_decode"] == "xla:disarmed"
+    assert dec_p.kernels["paged_attention_decode"] == "pallas"
+
+    r = np.random.RandomState(2)
+    prompts = [list(r.randint(0, V, n)) for n in (3, 6, 2, 5, 4)]
+    max_news = [6, 9, 12, 4, 8]
+    want, _ = _serve(dec_x, states, prompts, max_news)
+    got, st = _serve(dec_p, states, prompts, max_news)
+    assert got == want
+    assert st["decode_kernel"] == "pallas"
+    assert all(len(o) == m for o, m in zip(got, max_news))
+
+
+def test_spec_verify_rides_the_same_kernel():
+    """step_window (speculative verify: spec_k+1 positions per slot in
+    one dispatch) uses the same kernel via its multi-position variant —
+    accepted streams stay bit-identical to the plain XLA server."""
+    dec_x, states = _decoder()
+    dec_p, _ = _decoder(kernels="on")
+    draft, dstates = _decoder(d_model=16, n_heads=2, n_layers=1)
+
+    r = np.random.RandomState(3)
+    prompts = [list(r.randint(0, V, n)) for n in (3, 5, 2, 6)]
+    max_news = [6, 8, 10, 5]
+    want, _ = _serve(dec_x, states, prompts, max_news)
+    got, st = _serve(dec_p, states, prompts, max_news,
+                     draft_decoder=draft, draft_states=dstates,
+                     spec_k=3)
+    assert got == want
+    assert st["draft_proposed"] > 0
+    assert st["decode_kernel"] == "pallas"
+
+
+def test_sampled_decode_identical_through_kernel():
+    """The (seed, position) PRNG rides on top of the kernel's logits:
+    sampled streams match the oracle server's exactly."""
+    dec_x, states = _decoder()
+    dec_p, _ = _decoder(kernels="on")
+    outs = []
+    for dec in (dec_x, dec_p):
+        srv = GenerationServer(dec, states, slots=2, kv_blocks=8,
+                               place=fluid.CPUPlace())
+        try:
+            outs.append(srv.submit([3, 1, 4], 6, temperature=0.7,
+                                   seed=11).result(timeout=120))
+        finally:
+            srv.close()
+    assert outs[0] == outs[1]
+
+
+# ---------------------------------------------------------------------------
+# fallback registry: armed-but-unsupported is silent-but-counted
+# ---------------------------------------------------------------------------
+
+
+def _with_metrics_and_mode(mode):
+    prev_flag = get_flag("serving_kernels")
+    prev_metrics = obs_metrics.enabled()
+    set_flags({"serving_kernels": mode})
+    obs_metrics.set_enabled(True)
+
+    def restore():
+        set_flags({"serving_kernels": prev_flag})
+        obs_metrics.set_enabled(prev_metrics)
+
+    return restore
+
+
+def test_mode_normalization_and_disarmed_is_uncounted():
+    restore = _with_metrics_and_mode("off")
+    try:
+        assert kreg.kernels_mode() == "off"
+        sel = kreg.Selection()
+        assert sel.pick("paged_attention_decode", d_model=32,
+                        n_heads=2, block_size=4, max_blocks_per_seq=4,
+                        kv_dtype="fp32") is None
+        assert sel.chosen["paged_attention_decode"] == "xla:disarmed"
+        # the oracle was the PLAN, not a fallback: no sample counted
+        # (the family header may exist from other consumers' traffic)
+        assert (kreg.FALLBACK_METRIC
+                + '{kernel="paged_attention_decode"'
+                not in exporters.prometheus_text())
+        set_flags({"serving_kernels": "1"})
+        assert kreg.kernels_mode() == "on"
+        set_flags({"serving_kernels": "anything-else"})
+        assert kreg.kernels_mode() == "auto"
+    finally:
+        restore()
+
+
+def test_unsupported_shape_counts_fallback_and_reclaims_on_close():
+    restore = _with_metrics_and_mode("on")
+    try:
+        sel = kreg.Selection()
+        # 2 * (64*512) * 64 * 4B = 16 MiB of VMEM scratch: over budget
+        k = sel.pick("paged_attention_decode", d_model=64, n_heads=2,
+                     block_size=64, max_blocks_per_seq=512,
+                     kv_dtype="fp32")
+        assert k is None
+        assert sel.chosen["paged_attention_decode"] == \
+            "xla:vmem_scratch"
+        text = exporters.prometheus_text()
+        assert (kreg.FALLBACK_METRIC
+                + '{kernel="paged_attention_decode",'
+                'reason="vmem_scratch"} 1') in text
+        sel.close()
+        assert "vmem_scratch" not in exporters.prometheus_text()
+        sel.close()  # idempotent
+    finally:
+        restore()
+
+
+def test_armed_but_unsupported_moe_never_crashes_or_drifts():
+    """Golden fallback path end-to-end: bf16 tokens are outside the
+    fused MoE kernel's dtype support, so the armed call must run the
+    oracle chain (same outputs as disarmed) and count exactly one
+    {moe_gate_dispatch, dtype} fallback, reclaimed on close."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.moe import moe_dense
+
+    r = np.random.RandomState(0)
+    T, D, E, H = 16, 8, 4, 16
+    x = jnp.asarray(r.standard_normal((T, D)).astype(np.float32))
+    gw = jnp.asarray(r.standard_normal((D, E)).astype(np.float32))
+    w_in = jnp.asarray(r.standard_normal((E, D, H)).astype(np.float32))
+    w_out = jnp.asarray(r.standard_normal((E, H, D)).astype(np.float32))
+
+    restore = _with_metrics_and_mode("off")
+    try:
+        y_ref, aux_ref = moe_dense(x.astype(jnp.bfloat16), gw,
+                                   w_in, w_out, top_k=2)
+        set_flags({"serving_kernels": "on"})
+        sel = kreg.Selection()
+        y, aux = moe_dense(x.astype(jnp.bfloat16), gw, w_in, w_out,
+                           top_k=2, selection=sel)
+        assert sel.chosen["moe_gate_dispatch"] == "xla:dtype"
+        np.testing.assert_array_equal(np.asarray(y),
+                                      np.asarray(y_ref))
+        np.testing.assert_array_equal(np.asarray(aux),
+                                      np.asarray(aux_ref))
+        assert ('kernel="moe_gate_dispatch",reason="dtype"'
+                in exporters.prometheus_text())
+        sel.close()
+        assert ('kernel="moe_gate_dispatch"'
+                not in exporters.prometheus_text())
+
+        # and the SUPPORTED path is exact, too (f32, fused vs oracle)
+        y_f, aux_f = moe_dense(x, gw, w_in, w_out, top_k=2)
+        set_flags({"serving_kernels": "off"})
+        y_o, aux_o = moe_dense(x, gw, w_in, w_out, top_k=2)
+        np.testing.assert_array_equal(np.asarray(y_f),
+                                      np.asarray(y_o))
+        np.testing.assert_array_equal(np.asarray(aux_f),
+                                      np.asarray(aux_o))
+    finally:
+        restore()
+
+
+# ---------------------------------------------------------------------------
+# fused bucket update through the overlap executor
+# ---------------------------------------------------------------------------
+
+FEATS, CLS, HIDDEN = 16, 4, 32
+
+
+def _mlp(optimizer):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[FEATS],
+                              dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=HIDDEN, act="relu")
+        logits = fluid.layers.fc(input=h, size=CLS)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        optimizer().minimize(loss)
+    params = [p.name for p in main.global_block().all_parameters()]
+    return main, startup, loss, params
+
+
+def _batches(steps=4):
+    r = np.random.RandomState(5)
+    return [(r.rand(16, FEATS).astype(np.float32),
+             r.randint(0, CLS, (16, 1)).astype(np.int64))
+            for _ in range(steps)]
+
+
+def _train_overlap(optimizer, mode):
+    fw.reset_unique_names()
+    main, startup, loss, params = _mlp(optimizer)
+    t = fluid.ShardingTranspiler()
+    t.transpile(program=main, startup_program=startup, mesh={"dp": 8},
+                overlap="bucketed", shard_optimizer_states=False)
+    prev = get_flag("serving_kernels")
+    # the flag is read at TRACE time (first run), not build time — it
+    # must cover the training loop
+    set_flags({"serving_kernels": mode})
+    losses = []
+    try:
+        pe = t.build_executor(["x", "y"], [loss])
+        try:
+            for x, y in _batches():
+                out = pe.run({"x": x, "y": y})
+                losses.append(float(np.asarray(out[0]).ravel()[0]))
+            final = {n: np.asarray(pe.state(n)) for n in params}
+            info = dict(pe.overlap_info)
+        finally:
+            pe.close()
+    finally:
+        set_flags({"serving_kernels": prev})
+    return losses, final, info
+
+
+def test_fused_bucket_update_bit_identical_to_per_op_chain():
+    """dp-8 bucketed overlap, plain dense SGD: the fused one-kernel-
+    per-bucket update reproduces the per-parameter op chain exactly
+    (losses and every final parameter byte-equal)."""
+    l_ref, p_ref, i_ref = _train_overlap(
+        lambda: fluid.SGD(learning_rate=0.1), "off")
+    l_fus, p_fus, i_fus = _train_overlap(
+        lambda: fluid.SGD(learning_rate=0.1), "on")
+    assert i_ref["update"] == "xla:disarmed"
+    assert i_fus["update"] == "fused"
+    assert l_fus == l_ref
+    for n in p_ref:
+        np.testing.assert_array_equal(p_fus[n], p_ref[n], err_msg=n)
+
+
+def test_momentum_chain_falls_back_counted_and_reclaimed():
+    """A non-SGD update chain is armed-but-unsupported: the executor
+    runs the per-op oracle chain (training works), records the
+    structural reason, and close() reclaims the series."""
+    restore = _with_metrics_and_mode("on")
+    try:
+        losses, _, info = _train_overlap(
+            lambda: fluid.Momentum(learning_rate=0.1, momentum=0.9),
+            "on")
+        assert info["update"] == "xla:op_mix"
+        assert len(losses) == 4 and np.isfinite(losses).all()
+        # executor closed inside _train_overlap -> series reclaimed
+        assert ('kernel="fused_bucket_update"'
+                not in exporters.prometheus_text())
+    finally:
+        restore()
+
+
+# ---------------------------------------------------------------------------
+# analyzer: the rows reflect what runs
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_rows_follow_the_armed_backend():
+    from paddle_tpu import analysis
+
+    spec = {"vocab_size": V, "d_model": 32, "n_heads": 2,
+            "n_layers": 2, "block_size": 4, "max_blocks_per_seq": 4,
+            "kv_dtype": "int8"}
+    prev = get_flag("serving_kernels")
+    try:
+        set_flags({"serving_kernels": "off"})
+        rep = analysis.analyze_generation_spec(spec, slots=4)
+        assert rep["kernels"][0]["backend"] == "xla"
+        assert all(r["kernel"] != "paged_attention_decode"
+                   for r in rep["kernels"])
+        set_flags({"serving_kernels": "on"})
+        rep = analysis.analyze_generation_spec(spec, slots=4)
+        assert rep["kernels"][0]["backend"] == "pallas"
+        fused = [r for r in rep["kernels"]
+                 if r["kernel"] == "paged_attention_decode"]
+        assert fused and fused[0]["fused_dequant"]
+        # the fused path deletes the oracle's logical-order f32 copy
+        gather = [r for r in rep["kernels"]
+                  if r["kernel"] == "paged_attention_gather"][0]
+        assert fused[0]["bytes"] < gather["bytes"]
+    finally:
+        set_flags({"serving_kernels": prev})

@@ -12,17 +12,17 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 export PADDLE_TPU_DATASET="${PADDLE_TPU_DATASET:-synthetic}"
 
-echo "== [1/13] repo lint (tools/lint.py) =="
+echo "== [1/14] repo lint (tools/lint.py) =="
 python tools/lint.py
 
-echo "== [2/13] static verification of example programs =="
+echo "== [2/14] static verification of example programs =="
 python -m paddle_tpu.cli verify \
     examples/transformer_lm.py \
     examples/pipeline_transformer_lm.py \
     examples/serve_image_classifier.py \
     examples/dist_ckpt_worker.py
 
-echo "== [3/13] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
+echo "== [3/14] fast tier-1 subset with PADDLE_TPU_VERIFY=error =="
 # (TestSoftmax::test_grad is back in: its constant-loss degeneracy — the
 # old finite-difference flake — is fixed via grad_output_weights)
 PADDLE_TPU_VERIFY=error python -m pytest \
@@ -35,7 +35,7 @@ PADDLE_TPU_VERIFY=error python -m pytest \
     tests/test_debugger.py \
     -q -m 'not slow' -p no:cacheprovider
 
-echo "== [4/13] observability + comm subset with PADDLE_TPU_METRICS=on =="
+echo "== [4/14] observability + comm subset with PADDLE_TPU_METRICS=on =="
 # the instrumented hot paths must behave identically with the metric
 # instruments armed (docs/observability.md); test_comm.py also pins the
 # bucketed wire path's backward compatibility both directions
@@ -47,7 +47,7 @@ PADDLE_TPU_METRICS=on python -m pytest \
     tests/test_comm.py \
     -q -m 'not slow' -p no:cacheprovider
 
-echo "== [5/13] memory layer: fast book subset + memory plan with the optimizer armed =="
+echo "== [5/14] memory layer: fast book subset + memory plan with the optimizer armed =="
 # the whole-program memory layer (donation plan, dead-var freeing,
 # rename pass — docs/performance.md 'Memory') must leave training
 # semantics untouched with the verifier also armed: the book models
@@ -61,7 +61,7 @@ PADDLE_TPU_MEMORY_OPTIMIZE=on PADDLE_TPU_VERIFY=error python -m pytest \
     -q -p no:cacheprovider
 
 
-echo "== [6/13] elastic cluster: fast subset under chaos + metrics =="
+echo "== [6/14] elastic cluster: fast subset under chaos + metrics =="
 # the elastic runtime (docs/resilience.md "Elastic clusters") must hold
 # with the fault injector armed and the metric instruments on: the
 # injected first-rebalance failure is retried by the controller's watch
@@ -99,7 +99,7 @@ ctl.close()
 print("elastic telemetry visible in Prometheus dump")
 EOF
 
-echo "== [7/13] generation serving: fast subset + Prometheus series =="
+echo "== [7/14] generation serving: fast subset + Prometheus series =="
 # the continuous-batching serving layer (docs/serving.md) must behave
 # identically with the metric instruments armed, and every serving
 # process must expose the generation series a fleet dashboard scrapes
@@ -151,7 +151,7 @@ print("generation serving series visible in Prometheus dump "
       "(incl. prefix-cache + speculative-decoding series)")
 EOF
 
-echo "== [8/13] multichip sharding: spmd transpiler on the 8-device virtual mesh =="
+echo "== [8/14] multichip sharding: spmd transpiler on the 8-device virtual mesh =="
 # the mainline sharding path (docs/performance.md "Multichip sharding"):
 # annotated Programs lower through ShardingTranspiler onto the proven
 # dp/tp/pp executors, match serial + the composite.py oracle, and the
@@ -161,7 +161,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     tests/test_spmd_sharding.py \
     -q -m 'not slow' -p no:cacheprovider
 
-echo "== [9/13] static cost analyzer: budget gate over the example configs =="
+echo "== [9/14] static cost analyzer: budget gate over the example configs =="
 # the compile-free perf-regression gate (docs/analysis.md 'Budget
 # gate'): every example config's static roofline / peak-HBM estimate
 # must stay inside its checked-in budget, its bound verdict must not
@@ -180,7 +180,7 @@ python -m paddle_tpu.cli verify --json examples/transformer_lm.py \
 assert not d['failed'] and d['programs'], d"
 
 
-echo "== [10/13] concurrency analyzer: repo-wide lint + schedule-checked protocols =="
+echo "== [10/14] concurrency analyzer: repo-wide lint + schedule-checked protocols =="
 # the threaded runtimes (pserver wire protocol, elastic controller,
 # serving scheduler, comm workers — docs/analysis.md 'Concurrency
 # analysis') must stay free of unsuppressed error-severity concurrency
@@ -191,7 +191,7 @@ echo "== [10/13] concurrency analyzer: repo-wide lint + schedule-checked protoco
 # explores
 python -m paddle_tpu.cli concurrency --sched
 
-echo "== [11/13] fleet telemetry: mini-fleet federation + SLO gate =="
+echo "== [11/14] fleet telemetry: mini-fleet federation + SLO gate =="
 # the fleet telemetry plane (docs/observability.md "Fleet telemetry"):
 # a real 1-trainer x 1-pserver + 1-replica fleet under
 # PADDLE_TPU_METRICS=on, every member announcing its /metrics endpoint
@@ -207,7 +207,7 @@ rm -f "$FLEET_PROM"
 
 
 
-echo "== [12/13] autoscaling fleet: scale-out / SIGKILL / scale-in drill =="
+echo "== [12/14] autoscaling fleet: scale-out / SIGKILL / scale-in drill =="
 # the ROADMAP-4 acceptance (docs/serving.md "Autoscaling"): an
 # open-loop load ramp against a live router+autoscaler fleet triggers
 # scale-out (warm-start replicas deserialize their executables), a
@@ -224,7 +224,7 @@ rm -f "$DRILL_PROM"
 
 
 
-echo "== [13/13] time attribution: phase / exemplar / straggler drill =="
+echo "== [13/14] time attribution: phase / exemplar / straggler drill =="
 # the time-attribution acceptance (docs/observability.md "Time
 # attribution"): phase() overhead stays under 5% when the stack is
 # off, a decode-delay fault on one replica dominates the fleet
@@ -238,4 +238,74 @@ PADDLE_TPU_METRICS=on python tools/mini_fleet.py --drill attribution \
 python -m paddle_tpu.cli slo --check --spec tools/slo.json \
     --prom "$ATTR_PROM"
 rm -f "$ATTR_PROM"
+
+echo "== [14/14] serving kernels: Pallas/XLA parity + fallback accounting =="
+# the serving-kernel tier (docs/performance.md "Serving kernels"):
+# greedy decode through the fused paged-attention path must be
+# BIT-identical to the XLA oracle under interpret mode on CPU with
+# runtime verification armed, and armed-but-unsupported selections
+# must surface as the counted fallback series, reclaimed on close
+JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=error python -m pytest \
+    tests/test_serving_kernels.py \
+    -q -m 'not slow' -p no:cacheprovider
+JAX_PLATFORMS=cpu PADDLE_TPU_VERIFY=error PADDLE_TPU_METRICS=on \
+    python - <<'EOF_KERNELS'
+import numpy as np
+import paddle_tpu as fluid
+import paddle_tpu.core.framework as fw
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.kernels import registry as kreg
+from paddle_tpu.models.transformer import build_lm_paged_decoder
+from paddle_tpu.observability import exporters
+from paddle_tpu.serving import GenerationServer
+
+
+def build(mode, kv_dtype=None):
+    prev = get_flag("serving_kernels")
+    set_flags({"serving_kernels": mode})
+    try:
+        fw.reset_unique_names()
+        startup, dec = build_lm_paged_decoder(
+            23, 4, 4, d_model=16, n_heads=2, n_layers=1,
+            kv_dtype=kv_dtype)
+    finally:
+        set_flags({"serving_kernels": prev})
+    return startup, dec
+
+
+startup, dec_x = build("off")
+scope = fluid.Scope()
+fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+states = {n: np.asarray(scope.find_var(n)) for n in dec_x.state_names}
+_, dec_p = build("on")
+assert dec_p.kernels["paged_attention_decode"] == "pallas", dec_p.kernels
+
+outs = []
+for dec in (dec_x, dec_p):
+    srv = GenerationServer(dec, states, slots=2, kv_blocks=8,
+                           place=fluid.CPUPlace())
+    outs.append([srv.generate([1, 2, 3, 4], 8, timeout=120),
+                 srv.generate([5, 1, 2], 6, timeout=120)])
+    srv.close()
+assert outs[0] == outs[1], "Pallas decode diverged from the XLA oracle"
+
+# armed-but-unsupported: counted fallback series, reclaimed on close
+prev = get_flag("serving_kernels")
+set_flags({"serving_kernels": "on"})
+try:
+    sel = kreg.Selection()
+    assert sel.pick("paged_attention_decode", d_model=64, n_heads=2,
+                    block_size=64, max_blocks_per_seq=512,
+                    kv_dtype="fp32") is None
+    series = (kreg.FALLBACK_METRIC
+              + '{kernel="paged_attention_decode",reason="vmem_scratch"}')
+    assert series in exporters.prometheus_text(), "fallback not counted"
+    sel.close()
+    assert series not in exporters.prometheus_text(), "series leaked"
+finally:
+    set_flags({"serving_kernels": prev})
+print("serving kernels: greedy decode bit-identical (fp32), "
+      "fallback series counted and reclaimed")
+EOF_KERNELS
+
 echo "ci_check: all green"
